@@ -1,0 +1,41 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Test harness configuration.
+
+Mirrors the reference test strategy (SURVEY.md §4): tests run on a virtual
+8-device CPU mesh so the multi-device sharding paths are exercised without
+TPU hardware — the analogue of the reference's 2-process Gloo pool
+(reference ``tests/unittests/conftest.py:26-68``).
+"""
+import os
+
+# must be set before jax initializes its backends
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_PROCESSES = 2  # parity with reference conftest NUM_PROCESSES
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def seed_all(seed: int = 42) -> None:
+    """Pin python/numpy seeds (reference ``tests/unittests/_helpers/__init__.py:22-27``)."""
+    import random
+
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    seed_all(42)
+    yield

@@ -1,0 +1,89 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Aggregator + CompositionalMetric tests (reference
+``tests/unittests/bases/test_aggregation.py`` / ``test_composition.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, RunningMean, RunningSum, SumMetric
+
+
+def test_sum_metric():
+    m = SumMetric()
+    m.update(1.0)
+    m.update(jnp.asarray([2.0, 3.0]))
+    assert float(m.compute()) == 6.0
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(2.0, weight=1.0)
+    m.update(4.0, weight=3.0)
+    assert float(m.compute()) == pytest.approx((2 + 12) / 4)
+
+
+def test_max_min_metric():
+    mx, mn = MaxMetric(), MinMetric()
+    for v in [3.0, 1.0, 5.0, 2.0]:
+        mx.update(v)
+        mn.update(v)
+    assert float(mx.compute()) == 5.0
+    assert float(mn.compute()) == 1.0
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update([1.0, 2.0])
+    m.update(3.0)
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_nan_strategies():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, jnp.nan, 2.0]))
+    assert float(m.compute()) == 3.0
+    m = SumMetric(nan_strategy=10.0)
+    m.update(jnp.asarray([1.0, jnp.nan]))
+    assert float(m.compute()) == 11.0
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([jnp.nan]))
+
+
+def test_running_sum_window():
+    m = RunningSum(window=3)
+    outs = []
+    for i in range(6):
+        m.update(jnp.asarray([float(i)]))
+        outs.append(float(m.compute()))
+    # windowed sums: 0,1,3,6,9,12
+    assert outs == [0.0, 1.0, 3.0, 6.0, 9.0, 12.0]
+
+
+def test_running_mean_forward():
+    m = RunningMean(window=2)
+    vals = [m(float(i)) for i in range(4)]
+    assert [float(v) for v in vals] == [0.0, 1.0, 2.0, 3.0]  # forward = batch value
+    assert float(m.compute()) == pytest.approx((2.0 + 3.0) / 2)
+
+
+def test_composition_arithmetic():
+    a, b = SumMetric(), SumMetric()
+    c = a + b
+    c.update(2.0)
+    assert float(c.compute()) == 4.0
+    d = a * 2.0
+    assert float(d.compute()) == 4.0
+    e = abs(-1.0 * a)
+    assert float(e.compute()) == 2.0
+
+
+def test_composition_reset_propagates():
+    a = SumMetric()
+    c = a + 1.0
+    c.update(1.0)
+    assert float(c.compute()) == 2.0
+    c.reset()
+    c.update(2.0)
+    assert float(c.compute()) == 3.0

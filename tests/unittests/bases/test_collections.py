@@ -1,0 +1,121 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MetricCollection + compute-group tests (reference
+``tests/unittests/bases/test_collections.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection, Metric
+
+
+class TPCounter(Metric):
+    """Toy metric family sharing one state layout (models stat_scores)."""
+
+    full_state_update = False
+
+    def __init__(self, mode="sum", scale=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.mode = mode
+        self.scale = scale
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        self.total = self.total + self.scale * x.sum()
+        self.count = self.count + x.size
+
+    def compute(self):
+        return self.total if self.mode == "sum" else self.total / self.count
+
+
+class SumM(TPCounter):
+    def __init__(self, **kw):
+        super().__init__(mode="sum", **kw)
+
+
+class MeanM(TPCounter):
+    def __init__(self, **kw):
+        super().__init__(mode="mean", **kw)
+
+
+def test_collection_basic():
+    col = MetricCollection([SumM(), MeanM()])
+    col.update(jnp.asarray([1.0, 2.0]))
+    res = col.compute()
+    assert set(res) == {"SumM", "MeanM"}
+    assert float(res["SumM"]) == 3.0
+    assert float(res["MeanM"]) == 1.5
+
+
+def test_collection_compute_groups_merge():
+    col = MetricCollection([SumM(), MeanM()])
+    col.update(jnp.asarray([1.0]))
+    # identical states -> one group
+    assert len(col.compute_groups) == 1
+    col.update(jnp.asarray([2.0, 3.0]))  # only leader updates
+    res = col.compute()
+    assert float(res["SumM"]) == 6.0
+    assert float(res["MeanM"]) == 2.0
+
+
+def test_collection_groups_split_on_different_states():
+    col = MetricCollection({"a": SumM(), "b": SumM(scale=2.0)})
+    col.update(jnp.asarray([1.0]))
+    assert len(col.compute_groups) == 2
+    col.update(jnp.asarray([1.0]))
+    res = col.compute()
+    assert float(res["a"]) == 2.0
+    assert float(res["b"]) == 4.0
+
+
+def test_collection_prefix_postfix_clone():
+    col = MetricCollection([SumM()], prefix="train_", postfix="_v1")
+    col.update(jnp.asarray([1.0]))
+    assert list(col.compute()) == ["train_SumM_v1"]
+    col2 = col.clone(prefix="val_")
+    assert list(col2.compute()) == ["val_SumM_v1"]
+
+
+def test_collection_forward():
+    col = MetricCollection([SumM(), MeanM()])
+    out = col(jnp.asarray([2.0, 4.0]))
+    assert float(out["SumM"]) == 6.0
+    out = col(jnp.asarray([1.0]))
+    assert float(out["SumM"]) == 1.0  # batch value
+    assert float(col.compute()["SumM"]) == 7.0
+
+
+def test_collection_reset():
+    col = MetricCollection([SumM()])
+    col.update(jnp.asarray([1.0]))
+    col.reset()
+    col.update(jnp.asarray([2.0]))
+    assert float(col.compute()["SumM"]) == 2.0
+
+
+def test_collection_disable_compute_groups():
+    col = MetricCollection([SumM(), MeanM()], compute_groups=False)
+    col.update(jnp.asarray([1.0, 2.0]))
+    col.update(jnp.asarray([3.0]))
+    assert col.compute_groups == {}
+    assert float(col.compute()["SumM"]) == 6.0
+
+
+def test_collection_getitem_and_iteration():
+    col = MetricCollection([SumM(), MeanM()])
+    assert isinstance(col["SumM"], SumM)
+    assert sorted(col.keys()) == ["MeanM", "SumM"]
+    assert len(col) == 2
+
+
+def test_collection_state_dict_roundtrip():
+    col = MetricCollection([SumM()])
+    for m in col.values():
+        m.persistent(True)
+    col.update(jnp.asarray([5.0]))
+    sd = col.state_dict()
+    col2 = MetricCollection([SumM()])
+    col2.load_state_dict(sd)
+    assert float(col2["SumM"].total) == 5.0

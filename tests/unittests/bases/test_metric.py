@@ -1,0 +1,203 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Core Metric runtime tests (reference ``tests/unittests/bases/test_metric.py``)."""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+
+class DummySum(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+class DummyCat(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(jnp.asarray(x, jnp.float32))
+
+    def compute(self):
+        from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+        return dim_zero_cat(self.vals)
+
+
+def test_update_compute_reset():
+    m = DummySum()
+    assert m._update_count == 0
+    m.update(1.0)
+    m.update(2.0)
+    assert m._update_count == 2
+    assert float(m.compute()) == 3.0
+    m.reset()
+    assert m._update_count == 0
+    assert float(m.x) == 0.0
+
+
+def test_compute_cache():
+    m = DummySum()
+    m.update(1.0)
+    v1 = m.compute()
+    assert m._computed is not None
+    m.update(1.0)
+    assert m._computed is None  # update invalidates cache
+    assert float(m.compute()) == 2.0
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    m = DummySum()
+    v = m(2.0)
+    assert float(v) == 2.0
+    v = m(3.0)
+    assert float(v) == 3.0  # batch-local value
+    assert float(m.compute()) == 5.0  # global accumulation
+
+
+def test_forward_full_state_update_path():
+    class FullSum(DummySum):
+        full_state_update = True
+
+    m = FullSum()
+    assert float(m(2.0)) == 2.0
+    assert float(m(3.0)) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_forward_cat_state():
+    m = DummyCat()
+    v = m([1.0, 2.0])
+    np.testing.assert_array_equal(np.asarray(v), [1.0, 2.0])
+    m([3.0])
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_pickle_roundtrip():
+    m = DummySum()
+    m.update(5.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 5.0
+    m2.update(1.0)
+    assert float(m2.compute()) == 6.0
+
+
+def test_clone_independent():
+    m = DummySum()
+    m.update(1.0)
+    m2 = m.clone()
+    m2.update(10.0)
+    assert float(m.compute()) == 1.0
+    assert float(m2.compute()) == 11.0
+
+
+def test_state_dict_persistent():
+    m = DummySum()
+    assert m.state_dict() == {}  # not persistent by default
+    m._persistent["x"] = True
+    m.update(4.0)
+    sd = m.state_dict()
+    assert float(sd["x"]) == 4.0
+    m2 = DummySum()
+    m2._persistent["x"] = True
+    m2.load_state_dict(sd)
+    assert float(m2.x) == 4.0
+
+
+def test_hash_changes_with_state():
+    m1, m2 = DummySum(), DummySum()
+    assert hash(m1) == hash(m2)
+    m1.update(1.0)
+    assert hash(m1) != hash(m2)
+
+
+def test_metric_state_property():
+    m = DummySum()
+    assert set(m.metric_state) == {"x"}
+
+
+def test_unsync_without_sync_raises():
+    m = DummySum()
+    with pytest.raises(TorchMetricsUserError):
+        m.unsync()
+
+
+def test_sync_with_fake_dist():
+    """Simulate a 2-process world via a pluggable dist_sync_fn
+    (the reference's ``dist_sync_fn`` hook, ``metric.py:129``)."""
+
+    def fake_gather(x, group=None):
+        return [x, x + 1]  # pretend the other rank has x+1
+
+    m = DummySum(dist_sync_fn=fake_gather, distributed_available_fn=lambda: True)
+    m.update(1.0)
+    assert float(m.compute()) == 3.0  # 1 + 2
+    # after compute, local state restored by unsync
+    assert float(m.x) == 1.0
+
+
+def test_sync_cat_empty_rank():
+    def fake_gather(x, group=None):
+        return [x, x]
+
+    m = DummyCat(dist_sync_fn=fake_gather, distributed_available_fn=lambda: True)
+    m.update([1.0])
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 1.0])
+
+
+def test_double_sync_raises():
+    m = DummySum(distributed_available_fn=lambda: True, dist_sync_fn=lambda x, group=None: [x])
+    m.update(1.0)
+    m.sync(dist_sync_fn=m.dist_sync_fn)
+    with pytest.raises(TorchMetricsUserError):
+        m.sync(dist_sync_fn=m.dist_sync_fn)
+    m.unsync()
+
+
+def test_set_dtype():
+    m = DummySum()
+    m.update(1.0)
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16
+
+
+def test_unknown_kwarg_raises():
+    with pytest.raises(ValueError, match="Unexpected keyword arguments"):
+        DummySum(bogus=1)
+
+
+def test_jit_bridge():
+    """The whole update step can be jitted through the state-tree bridge."""
+    import jax
+
+    m = DummySum()
+
+    @jax.jit
+    def step(state, x):
+        m.load_state_tree(state)
+        m.__class__.update(m, x)
+        return m.state_tree()
+
+    state = m.state_tree()
+    for i in range(3):
+        state = step(state, jnp.asarray(float(i)))
+    m.load_state_tree(state)
+    m._update_count = 3
+    assert float(m.compute()) == 3.0

@@ -1,0 +1,120 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""stat_scores kernels vs sklearn oracles (reference test:
+``tests/unittests/classification/test_stat_scores.py``)."""
+import numpy as np
+import pytest
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusion_matrix
+
+from tests.conftest import NUM_CLASSES, THRESHOLD
+from torchmetrics_tpu.functional.classification import (
+    binary_stat_scores,
+    multiclass_stat_scores,
+    multilabel_stat_scores,
+)
+
+N = 64
+
+
+def _sk_binary(preds, target, ignore_index=None):
+    preds, target = preds.copy().reshape(-1), target.copy().reshape(-1)
+    if preds.dtype.kind == "f":
+        if not ((preds >= 0) & (preds <= 1)).all():
+            preds = 1 / (1 + np.exp(-preds))
+        preds = (preds > THRESHOLD).astype(int)
+    if ignore_index is not None:
+        keep = target != ignore_index
+        preds, target = preds[keep], target[keep]
+    cm = sk_confusion_matrix(target, preds, labels=[0, 1])
+    tn, fp, fn, tp = cm.ravel()
+    return np.array([tp, fp, tn, fn, tp + fn])
+
+
+@pytest.mark.parametrize("dtype", ["int", "prob", "logit"])
+@pytest.mark.parametrize("ignore_index", [None, -1])
+def test_binary_stat_scores(dtype, ignore_index):
+    rng = np.random.RandomState(0)
+    target = rng.randint(0, 2, size=(N,))
+    if ignore_index is not None:
+        target[rng.rand(N) < 0.1] = ignore_index
+    if dtype == "int":
+        preds = rng.randint(0, 2, size=(N,))
+    elif dtype == "prob":
+        preds = rng.rand(N)
+    else:
+        preds = rng.randn(N) * 3
+    res = np.asarray(binary_stat_scores(preds, target, ignore_index=ignore_index))
+    expected = _sk_binary(preds, target, ignore_index)
+    np.testing.assert_array_equal(res, expected)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+def test_multiclass_stat_scores(average, ignore_index):
+    rng = np.random.RandomState(1)
+    target = rng.randint(0, NUM_CLASSES, size=(N,))
+    preds = rng.randint(0, NUM_CLASSES, size=(N,))
+    res = np.asarray(multiclass_stat_scores(preds, target, NUM_CLASSES, average=average, ignore_index=ignore_index))
+
+    t, p = target.copy(), preds.copy()
+    if ignore_index is not None:
+        keep = t != ignore_index
+        t, p = t[keep], p[keep]
+    cm = sk_confusion_matrix(t, p, labels=list(range(NUM_CLASSES)))
+    tp = np.diag(cm)
+    fp = cm.sum(0) - tp
+    fn = cm.sum(1) - tp
+    tn = cm.sum() - tp - fp - fn
+    per_class = np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        np.testing.assert_array_equal(res, per_class.sum(0))
+    elif average == "macro":
+        np.testing.assert_allclose(res, per_class.astype(float).mean(0), rtol=1e-5)
+    elif average == "weighted":
+        w = (tp + fn) / (tp + fn).sum()
+        np.testing.assert_allclose(res, (per_class * w[:, None]).sum(0), rtol=1e-5)
+    else:
+        np.testing.assert_array_equal(res, per_class)
+
+
+def test_multiclass_stat_scores_probs_topk():
+    rng = np.random.RandomState(2)
+    target = rng.randint(0, NUM_CLASSES, size=(N,))
+    logits = rng.randn(N, NUM_CLASSES)
+    res1 = np.asarray(multiclass_stat_scores(logits, target, NUM_CLASSES, average=None))
+    res_argmax = np.asarray(multiclass_stat_scores(logits.argmax(1), target, NUM_CLASSES, average=None))
+    np.testing.assert_array_equal(res1, res_argmax)
+    # top_k=NUM_CLASSES means every prediction hits -> fn == 0
+    res_full = np.asarray(multiclass_stat_scores(logits, target, NUM_CLASSES, average=None, top_k=NUM_CLASSES))
+    assert (res_full[:, 3] == 0).all()
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", None])
+def test_multilabel_stat_scores(average):
+    rng = np.random.RandomState(3)
+    num_labels = 4
+    target = rng.randint(0, 2, size=(N, num_labels))
+    preds = rng.rand(N, num_labels)
+    res = np.asarray(multilabel_stat_scores(preds, target, num_labels, average=average))
+    cms = sk_multilabel_confusion_matrix(target, (preds > THRESHOLD).astype(int))
+    tp = cms[:, 1, 1]
+    fp = cms[:, 0, 1]
+    tn = cms[:, 0, 0]
+    fn = cms[:, 1, 0]
+    per_label = np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        np.testing.assert_array_equal(res, per_label.sum(0))
+    elif average == "macro":
+        np.testing.assert_allclose(res, per_label.astype(float).mean(0), rtol=1e-5)
+    else:
+        np.testing.assert_array_equal(res, per_label)
+
+
+def test_samplewise():
+    rng = np.random.RandomState(4)
+    target = rng.randint(0, 2, size=(8, 16))
+    preds = rng.randint(0, 2, size=(8, 16))
+    res = np.asarray(binary_stat_scores(preds, target, multidim_average="samplewise"))
+    for i in range(8):
+        np.testing.assert_array_equal(res[i], _sk_binary(preds[i], target[i]))

@@ -1,0 +1,35 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""TorchMetrics-TPU: TPU-native (JAX/XLA/Pallas) machine-learning metrics.
+
+A brand-new framework with the capabilities of TorchMetrics (reference at
+``/root/reference``), designed TPU-first: metric states are immutable pytrees,
+every kernel is jit/shard_map-safe with static shapes, and distribution runs
+over ``jax.sharding`` meshes with XLA collectives instead of process groups.
+"""
+from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import CompositionalMetric, Metric
+
+__all__ = [
+    "__version__",
+    "CatMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "MinMetric",
+    "RunningMean",
+    "RunningSum",
+    "SumMetric",
+    "MetricCollection",
+    "CompositionalMetric",
+    "Metric",
+]

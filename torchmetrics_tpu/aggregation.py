@@ -1,0 +1,182 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Aggregation metrics: turn raw streamed values into metrics.
+
+Capability parity with reference ``src/torchmetrics/aggregation.py`` (727 LoC):
+``BaseAggregator`` with NaN strategies, ``MaxMetric``/``MinMetric``/
+``SumMetric``/``CatMetric``/``MeanMetric`` (weighted), and windowed
+``RunningMean``/``RunningSum``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.wrappers.running import Running
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for aggregation metrics (reference ``aggregation.py:30``).
+
+    ``nan_strategy``: ``"error"|"warn"|"ignore"|"disable"`` or a float used to
+    impute NaNs (reference ``aggregation.py:75-107``). The imputation/masking
+    is done with jnp.where so the update stays jit-safe; "error"/"warn" probe
+    the value on host and therefore only fire in eager mode.
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, (int, float)):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.state_name = state_name
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None):
+        """Cast to float array and handle NaNs per strategy (reference ``aggregation.py:75``)."""
+        x = jnp.asarray(x, dtype=jnp.float32) if not isinstance(x, jax.Array) else x.astype(jnp.float32)
+        if weight is not None:
+            weight = jnp.asarray(weight, dtype=jnp.float32) if not isinstance(weight, jax.Array) else weight.astype(jnp.float32)
+            weight = jnp.broadcast_to(weight, x.shape)
+        else:
+            weight = jnp.ones_like(x)
+        if self.nan_strategy == "disable":
+            return x, weight
+        nan_mask = jnp.isnan(x)
+        if self.nan_strategy in ("error", "warn"):
+            import numpy as np
+
+            if not isinstance(x, jax.core.Tracer) and bool(np.any(np.asarray(nan_mask))):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                x = x[~np.asarray(nan_mask)]
+                weight = weight[~np.asarray(nan_mask)]
+            return x, weight
+        if self.nan_strategy == "ignore":
+            # jit-safe masking: zero weight on NaN entries, replace value by 0
+            weight = jnp.where(nan_mask, 0.0, weight)
+            x = jnp.where(nan_mask, 0.0, x)
+            return x, weight
+        # float imputation
+        x = jnp.where(nan_mask, jnp.asarray(float(self.nan_strategy), x.dtype), x)
+        return x, weight
+
+    def update(self, value: Union[float, Array]) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return getattr(self, self.state_name)
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference ``aggregation.py:114``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf), nan_strategy, state_name="max_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if self.nan_strategy == "ignore":
+            value = jnp.where(jnp.isnan(jnp.asarray(value)), -jnp.inf, value)
+        if value.size:
+            self.max_value = jnp.maximum(self.max_value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference ``aggregation.py:219``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf), nan_strategy, state_name="min_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if self.nan_strategy == "ignore":
+            value = jnp.where(jnp.isnan(jnp.asarray(value)), jnp.inf, value)
+        if value.size:
+            self.min_value = jnp.minimum(self.min_value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference ``aggregation.py:324``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.sum_value = self.sum_value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference ``aggregation.py:429``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, state_name="value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        return dim_zero_cat(self.value) if isinstance(self.value, list) and self.value else self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:493``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.asarray(0.0), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        if value.size == 0:
+            return
+        self.mean_value = self.mean_value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.mean_value / self.weight
+
+
+class RunningMean(Running):
+    """Mean over the last ``window`` updates (reference ``aggregation.py:616``)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)
+
+
+class RunningSum(Running):
+    """Sum over the last ``window`` updates (reference ``aggregation.py:673``)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

@@ -1,0 +1,386 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""``MetricCollection`` — dict-of-metrics with one call signature and
+compute-group deduplication.
+
+Capability parity with reference ``src/torchmetrics/collections.py`` (673 LoC).
+Compute groups (reference ``:238-317``) dedupe metrics whose ``update`` writes
+identical states (e.g. Precision/Recall/F1 all riding on stat_scores): only
+the group leader updates. The reference shares state between members *by
+mutable reference*; with immutable jnp arrays we instead copy the leader's
+state tree into members lazily right before their ``compute``/inspection —
+same observable behavior, no aliasing hazards.
+
+Group discovery keys on the cheap state-spec signature first (names,
+reductions, shapes, dtypes — instead of the reference's O(n²) value
+comparison, see SURVEY §7) and falls back to value equality within a
+signature bucket after the first update.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _rebuild_collection(cls, raw_metrics, attrs):
+    obj = cls.__new__(cls)
+    obj.__dict__.update(attrs)
+    for k, v in raw_metrics.items():
+        dict.__setitem__(obj, k, v)
+    return obj
+
+
+class MetricCollection(dict):
+    """A dict of metrics updated/computed with a single call (reference ``collections.py:35``)."""
+
+    _modules: Dict[str, Metric]
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        super().__init__()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._state_is_copy: bool = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # --------------------------------------------------------------- plumbing
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
+        if copy_state:
+            self._compute_groups_create_state_ref(copy=True)
+        if self.prefix:
+            key = key.removeprefix(self.prefix)
+        if self.postfix:
+            key = key.removesuffix(self.postfix)
+        return dict.__getitem__(self, key)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        object.__setattr__(self, name, value)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return dict.__len__(self)
+
+    def __reduce__(self):
+        # dict-subclass pickling would go through the overridden (prefixed)
+        # ``items``; rebuild from raw keys instead (used by pickle AND deepcopy)
+        raw = {k: dict.__getitem__(self, k) for k in dict.keys(self)}
+        return (_rebuild_collection, (self.__class__, raw, dict(self.__dict__)))
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        for k in sorted(dict.keys(self)):
+            repr_str += f"\n  ({k}): {dict.__getitem__(self, k)!r}"
+        return repr_str + "\n)"
+
+    # ------------------------------------------------------------ add metrics
+    def add_metrics(self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric) -> None:
+        """Add new metrics to the collection (reference ``collections.py:434``)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                " with first passed dictionary."
+            )
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    dict.__setitem__(self, name, metric)
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        dict.__setitem__(self, f"{name}_{k}", v)
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `Metric` or `MetricCollection`")
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if dict.__contains__(self, name):
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    dict.__setitem__(self, name, metric)
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v.postfix = metric.postfix
+                        v.prefix = metric.prefix
+                        dict.__setitem__(self, k, v)
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {}
+
+    def _init_compute_groups(self) -> None:
+        """Initial group assignment (reference ``collections.py:_init_compute_groups``).
+
+        User-specified groups are trusted; otherwise every metric starts in
+        its own group and groups merge after the first update.
+        """
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
+                        )
+            self._groups_checked = True
+        else:
+            self._groups = {i: [str(k)] for i, k in enumerate(sorted(dict.keys(self)))}
+
+    # ---------------------------------------------------------------- update
+    @property
+    def _base_metrics(self) -> Dict[str, Metric]:
+        return {k: dict.__getitem__(self, k) for k in sorted(dict.keys(self))}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each metric, deduped via compute groups (reference ``collections.py:205``)."""
+        if self._state_is_copy:
+            self._compute_groups_create_state_ref(copy=False)
+            self._state_is_copy = False
+        if self._enable_compute_groups and self._groups_checked:
+            for cg in self._groups.values():
+                m0 = dict.__getitem__(self, cg[0])
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+                for k in cg[1:]:
+                    m = dict.__getitem__(self, k)
+                    m._update_count = m0._update_count
+                    m._computed = None
+        else:
+            for m in self._base_metrics.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups and not self._groups_checked:
+                self._merge_compute_groups()
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Merge groups whose metrics ended the first update with identical
+        states (reference ``collections.py:238-272``); candidates are
+        pre-bucketed by state-spec signature so comparisons stay cheap."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = dict.__getitem__(self, cg_members1[0])
+                    metric2 = dict.__getitem__(self, cg_members2[0])
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            else:
+                break
+            if len(self._groups) == num_groups:
+                break
+            num_groups = len(self._groups)
+        # rename group keys 0..N
+        self._groups = dict(enumerate(self._groups.values()))
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """True when two metrics have identical state values (reference ``collections.py:274-297``)."""
+        if not metric1._defaults or not metric2._defaults:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) != type(state2):  # noqa: E721
+                return False
+            if isinstance(state1, list):
+                if len(state1) != len(state2):
+                    return False
+                if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            else:
+                if state1.shape != state2.shape or not allclose(state1, state2):
+                    return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Propagate the leader's state to group members (reference ``collections.py:299-317``).
+
+        With immutable arrays "sharing by reference" and "copying" coincide;
+        the flag only tracks whether members are currently safe to mutate.
+        """
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = dict.__getitem__(self, cg[0])
+                for k in cg[1:]:
+                    mi = dict.__getitem__(self, k)
+                    mi.load_state_tree(m0._copy_state_dict())
+                    mi._update_count = m0._update_count
+        self._state_is_copy = copy
+
+    # ------------------------------------------------------------- fwd/compute
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call forward on each metric (compute groups do not apply,
+        reference ``docs overview.rst:396``)."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._base_metrics.items()}
+        res = _flatten_dict(res)[0]
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Compute/forward every metric and flatten results (reference ``collections.py:323-368``)."""
+        self._compute_groups_create_state_ref()
+        result = {}
+        for k, m in self._base_metrics.items():
+            if method_name == "compute":
+                res = m.compute()
+            else:
+                res = m(*args, **m._filter_kwargs(**kwargs))
+            result[k] = res
+        _, duplicates = _flatten_dict(result)
+        flattened_results = {}
+        for k, res in result.items():
+            if isinstance(res, dict):
+                for sub_k, sub_v in res.items():
+                    new_key = f"{k}_{sub_k}" if duplicates else sub_k
+                    flattened_results[new_key] = sub_v
+            else:
+                flattened_results[k] = res
+        return {self._set_name(k): v for k, v in flattened_results.items()}
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def reset(self) -> None:
+        """Reset all metrics (reference ``collections.py:391``)."""
+        for m in self._base_metrics.values():
+            m.reset()
+        if self._enable_compute_groups and self._groups_checked:
+            self._state_is_copy = False
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Deep copy with optional new prefix/postfix (reference ``collections.py:399``)."""
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._base_metrics.values():
+            m.persistent(mode)
+
+    # -------------------------------------------------------------- dict API
+    def keys(self, keep_base: bool = False):  # type: ignore[override]
+        if keep_base:
+            return [k for k in sorted(dict.keys(self))]
+        return [self._set_name(k) for k in sorted(dict.keys(self))]
+
+    def items(self, keep_base: bool = False, copy_state: bool = True):  # type: ignore[override]
+        """Return (name, metric) pairs; propagates group state first
+        (reference ``collections.py:533-558``)."""
+        if copy_state:
+            self._compute_groups_create_state_ref(copy=True)
+        if keep_base:
+            return [(k, dict.__getitem__(self, k)) for k in sorted(dict.keys(self))]
+        return [(self._set_name(k), dict.__getitem__(self, k)) for k in sorted(dict.keys(self))]
+
+    def values(self, copy_state: bool = True):  # type: ignore[override]
+        if copy_state:
+            self._compute_groups_create_state_ref(copy=True)
+        return [dict.__getitem__(self, k) for k in sorted(dict.keys(self))]
+
+    # ---------------------------------------------------------- serialization
+    def state_dict(self) -> Dict[str, Any]:
+        self._compute_groups_create_state_ref(copy=True)
+        destination: Dict[str, Any] = {}
+        for k, m in self._base_metrics.items():
+            m.state_dict(destination=destination, prefix=f"{k}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for k, m in self._base_metrics.items():
+            m.load_state_dict(state_dict, strict=strict, prefix=f"{k}.")
+
+    def set_dtype(self, dst_type) -> "MetricCollection":
+        for m in self._base_metrics.values():
+            m.set_dtype(dst_type)
+        return self
+
+    def to(self, device=None) -> "MetricCollection":
+        for m in self._base_metrics.values():
+            m.to(device)
+        return self
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Current compute-group assignment (reference ``collections.py`` property)."""
+        return self._groups
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None, together: bool = False):
+        """Plot all metrics in the collection (reference ``collections.py`` plot)."""
+        import matplotlib.pyplot as plt
+
+        if together:
+            val = val or self.compute()
+            from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+            return [plot_single_or_multi_val(val, ax=ax)]
+        vals = val or self.compute()
+        figaxs = []
+        for k, m in self.items(copy_state=True):
+            f, a = m.plot(vals[k] if isinstance(vals, dict) else None)
+            figaxs.append((f, a))
+        return figaxs

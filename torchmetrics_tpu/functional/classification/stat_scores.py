@@ -1,0 +1,524 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""stat_scores: the root state machine of the classification suite.
+
+Capability parity with reference
+``src/torchmetrics/functional/classification/stat_scores.py`` (tp/fp/tn/fn via
+confusion-matrix bincount at ``:412-418``, one-hot path for top_k/samplewise at
+``:363-393``). TPU-first re-design: the reference removes ``ignore_index``
+elements by boolean indexing (dynamic shapes); here ignored positions are
+masked arithmetically so every kernel is jit/shard_map-safe with static shapes
+and lowers to a single fused XLA reduction.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+from torchmetrics_tpu.utilities.data import _bincount, select_topk
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------- binary
+
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    zero_division: float = 0,
+) -> None:
+    """Validate non-tensor args (reference ``stat_scores.py:25``)."""
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if zero_division not in [0, 1]:
+        raise ValueError(f"Expected argument `zero_division` to be 0 or 1, but got {zero_division}.")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate tensor shapes/values (reference ``stat_scores.py:56``)."""
+    _check_same_shape(preds, target)
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+    if _is_concrete(target):
+        unique_ok = (target == 0) | (target == 1)
+        if ignore_index is not None:
+            unique_ok = unique_ok | (target == ignore_index)
+        if not bool(jnp.all(unique_ok)):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {jnp.unique(target)} but expected only"
+                f" the following values {[0, 1] + ([ignore_index] if ignore_index is not None else [])}."
+            )
+    if not jnp.issubdtype(preds.dtype, jnp.floating) and _is_concrete(preds):
+        if not bool(jnp.all((preds == 0) | (preds == 1))):
+            raise RuntimeError(
+                "Detected non-floating point predictions that are not binary. If you want to"
+                " use logits or probabilities, please pass a float tensor."
+            )
+
+
+def _binary_stat_scores_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Flatten to ``(N, X)`` and threshold probabilities (reference ``stat_scores.py:96``).
+
+    Ignored positions are encoded as ``-1`` in the target (masked later)
+    instead of being filtered out, keeping shapes static.
+    """
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    preds = preds.reshape(preds.shape[0], -1).astype(jnp.int32)
+    target = target.reshape(target.shape[0], -1).astype(jnp.int32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _binary_stat_scores_update(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn with masked arithmetic (reference ``stat_scores.py:128``)."""
+    valid = target >= 0
+    axis: Union[None, int] = None if multidim_average == "global" else 1
+    tp = ((target == preds) & (target == 1) & valid).sum(axis=axis)
+    fn = ((target != preds) & (target == 1) & valid).sum(axis=axis)
+    fp = ((target != preds) & (target == 0) & valid).sum(axis=axis)
+    tn = ((target == preds) & (target == 0) & valid).sum(axis=axis)
+    return tp, fp, tn, fn
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    """Stack into ``[tp, fp, tn, fn, sup]`` (reference ``stat_scores.py:141``)."""
+    return jnp.stack([tp, fp, tn, fn, tp + fn], axis=0 if multidim_average == "global" else -1).squeeze()
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for binary tasks (reference ``stat_scores.py:151-218``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# ------------------------------------------------------------------ multiclass
+
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    zero_division: float = 0,
+) -> None:
+    """Validate non-tensor args (reference ``stat_scores.py:223``)."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not isinstance(top_k, int) or top_k < 1:
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if zero_division not in [0, 1]:
+        raise ValueError(f"Expected argument `zero_division` to be 0 or 1, but got {zero_division}.")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate tensor shapes/values (reference ``stat_scores.py:261``)."""
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                " equal to number of classes."
+            )
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        if multidim_average != "global" and preds.ndim < 3:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should "
+                " at least 3D when multidim_average is set to `samplewise`"
+            )
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError(
+                "When `preds` and `target` have the same shape, the shape of `preds` should "
+                " at least 2D when multidim_average is set to `samplewise`"
+            )
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    if _is_concrete(target):
+        check_value = num_classes if ignore_index is None else num_classes + 1
+        for t, name in ((target, "target"),) + (((preds, "preds"),) if not jnp.issubdtype(preds.dtype, jnp.floating) else ()):
+            unique_values = jnp.unique(t)
+            if len(unique_values) > check_value:
+                raise RuntimeError(
+                    f"Detected more unique values in `{name}` than expected. Expected only {check_value} but found"
+                    f" {len(unique_values)} in `{name}`. Found values: {unique_values}."
+                )
+
+
+def _multiclass_stat_scores_format(
+    preds: Array,
+    target: Array,
+    top_k: int = 1,
+) -> Tuple[Array, Array]:
+    """Argmax probabilities and flatten extra dims (reference ``stat_scores.py:325``)."""
+    if preds.ndim == target.ndim + 1 and top_k == 1:
+        preds = jnp.argmax(preds, axis=1)
+    preds = preds.reshape(*preds.shape[:2], -1) if top_k != 1 else preds.reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    return preds, target
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """The hot kernel (reference ``stat_scores.py:344-418``).
+
+    - one-hot path when ``top_k != 1`` or samplewise;
+    - micro fast path;
+    - otherwise the bincount confusion-matrix trick
+      ``unique_mapping = target * C + preds`` (reference ``:412-418``),
+      with ignored positions routed to an extra trash bin (static shapes).
+    """
+    if multidim_average == "samplewise" or top_k != 1:
+        ignore_mask = (target == ignore_index) if ignore_index is not None else None
+        if top_k > 1:
+            preds_oh = jnp.moveaxis(select_topk(preds, topk=top_k, dim=1), 1, -1)
+        else:
+            preds_clipped = jnp.clip(preds.astype(jnp.int32), 0, num_classes - 1)
+            preds_oh = jax.nn.one_hot(preds_clipped, num_classes, dtype=jnp.int32)
+            if ignore_mask is not None:
+                # positions where *preds* equal an out-of-range ignore_index
+                # should not one-hot anywhere
+                pred_ignore = preds == ignore_index if not (0 <= ignore_index <= num_classes - 1) else None
+                if pred_ignore is not None:
+                    preds_oh = jnp.where(pred_ignore[..., None], 0, preds_oh)
+        target_clipped = jnp.clip(target.astype(jnp.int32), 0, num_classes - 1)
+        target_oh = jax.nn.one_hot(target_clipped, num_classes, dtype=jnp.int32)
+        if ignore_mask is not None:
+            # ignored positions get target_oh = -1 everywhere so they match
+            # neither the ==1 nor the ==0 comparisons (reference ``:384-390``)
+            target_oh = jnp.where(ignore_mask[..., None], -1, target_oh)
+        sum_dims = (0, 1) if multidim_average == "global" else (1,)
+        tp = (((target_oh == preds_oh) & (target_oh == 1)).sum(sum_dims)).astype(jnp.int32)
+        fn = (((target_oh != preds_oh) & (target_oh == 1)).sum(sum_dims)).astype(jnp.int32)
+        fp = (((target_oh != preds_oh) & (target_oh == 0)).sum(sum_dims)).astype(jnp.int32)
+        tn = (((target_oh == preds_oh) & (target_oh == 0)).sum(sum_dims)).astype(jnp.int32)
+        return tp, fp, tn, fn
+    if average == "micro":
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+        valid = target != ignore_index if ignore_index is not None else jnp.ones_like(target, dtype=bool)
+        tp = ((preds == target) & valid).sum()
+        fp = ((preds != target) & valid).sum()
+        fn = fp
+        tn = num_classes * valid.sum() - (fp + fn + tp)
+        return tp, fp, tn, fn
+    preds = preds.reshape(-1).astype(jnp.int32)
+    target = target.reshape(-1).astype(jnp.int32)
+    if ignore_index is not None:
+        valid = target != ignore_index
+        unique_mapping = jnp.where(valid, target * num_classes + jnp.clip(preds, 0, num_classes - 1), num_classes**2)
+        bins = _bincount(unique_mapping, minlength=num_classes**2 + 1)[: num_classes**2]
+    else:
+        unique_mapping = target * num_classes + preds
+        bins = _bincount(unique_mapping, minlength=num_classes**2)
+    confmat = bins.reshape(num_classes, num_classes)
+    tp = jnp.diag(confmat)
+    fp = confmat.sum(0) - tp
+    fn = confmat.sum(1) - tp
+    tn = confmat.sum() - (fp + fn + tp)
+    return tp, fp, tn, fn
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+) -> Array:
+    """Stack stats + support and apply the average strategy (reference ``stat_scores.py:422-448``)."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_dim = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        return res.sum(sum_dim) if res.ndim > 1 else res
+    if average == "macro":
+        return res.astype(jnp.float32).mean(sum_dim)
+    if average == "weighted":
+        weight = tp + fn
+        if multidim_average == "global":
+            return (res * (weight / weight.sum()).reshape(*weight.shape, 1)).sum(sum_dim)
+        return (res * (weight / weight.sum(-1, keepdims=True)).reshape(*weight.shape, 1)).sum(sum_dim)
+    if average is None or average == "none":
+        return res
+    return None
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for multiclass tasks (reference ``stat_scores.py:451``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ------------------------------------------------------------------ multilabel
+
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    zero_division: float = 0,
+) -> None:
+    """Validate non-tensor args (reference ``stat_scores.py:500``)."""
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if zero_division not in [0, 1]:
+        raise ValueError(f"Expected argument `zero_division` to be 0 or 1, but got {zero_division}.")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate tensor shapes/values (reference ``stat_scores.py:536``)."""
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise ValueError(f"Expected both `preds` and `target` to be at least 2D, but got {preds.ndim}D")
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            f"Expected both `preds` and `target` to have second dimension equal to `num_labels`={num_labels},"
+            f" but got {preds.shape[1]}"
+        )
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+    if _is_concrete(target):
+        unique_ok = (target == 0) | (target == 1)
+        if ignore_index is not None:
+            unique_ok = unique_ok | (target == ignore_index)
+        if not bool(jnp.all(unique_ok)):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {jnp.unique(target)} but expected only"
+                f" the following values {[0, 1] + ([ignore_index] if ignore_index is not None else [])}."
+            )
+
+
+def _multilabel_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Threshold probabilities and flatten to ``(N, L, X)`` (reference ``stat_scores.py:566``)."""
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    preds = preds.reshape(*preds.shape[:2], -1).astype(jnp.int32)
+    target = target.reshape(*target.shape[:2], -1).astype(jnp.int32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _multilabel_stat_scores_update(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-label masked counts (reference ``stat_scores.py:586``)."""
+    valid = target >= 0
+    sum_dims = (0, -1) if multidim_average == "global" else (-1,)
+    tp = ((target == preds) & (target == 1) & valid).sum(sum_dims)
+    fn = ((target != preds) & (target == 1) & valid).sum(sum_dims)
+    fp = ((target != preds) & (target == 0) & valid).sum(sum_dims)
+    tn = ((target == preds) & (target == 0) & valid).sum(sum_dims)
+    return tp, fp, tn, fn
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+) -> Array:
+    """Stack stats + support and apply the average strategy (mirrors multiclass)."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_dim = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        return res.sum(sum_dim)
+    if average == "macro":
+        return res.astype(jnp.float32).mean(sum_dim)
+    if average == "weighted":
+        weight = tp + fn
+        if multidim_average == "global":
+            return (res * (weight / weight.sum()).reshape(*weight.shape, 1)).sum(sum_dim)
+        return (res * (weight / weight.sum(-1, keepdims=True)).reshape(*weight.shape, 1)).sum(sum_dim)
+    if average is None or average == "none":
+        return res
+    return None
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for multilabel tasks (reference ``stat_scores.py:598``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ------------------------------------------------------------------- dispatch
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching stat_scores (reference ``stat_scores.py:668``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        if not isinstance(top_k, int):
+            raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_stat_scores(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

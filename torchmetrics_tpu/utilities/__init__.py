@@ -1,0 +1,44 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Utility layer (L1): reductions, safe math, checks, distributed primitives."""
+from torchmetrics_tpu.utilities.checks import _check_same_shape, check_forward_full_state_property
+from torchmetrics_tpu.utilities.data import (
+    _bincount,
+    _cumsum,
+    _flatten,
+    _flatten_dict,
+    _flexible_bincount,
+    _squeeze_if_scalar,
+    allclose,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from torchmetrics_tpu.utilities.distributed import class_reduce, gather_all_arrays, reduce
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from torchmetrics_tpu.utilities.prints import rank_zero_print, rank_zero_warn
+
+__all__ = [
+    "check_forward_full_state_property",
+    "allclose",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "select_topk",
+    "to_categorical",
+    "to_onehot",
+    "class_reduce",
+    "gather_all_arrays",
+    "reduce",
+    "TorchMetricsUserError",
+    "TorchMetricsUserWarning",
+    "rank_zero_print",
+    "rank_zero_warn",
+]

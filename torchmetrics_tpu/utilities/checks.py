@@ -1,0 +1,108 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Input validation helpers.
+
+Capability parity with reference ``src/torchmetrics/utilities/checks.py``.
+Validation runs at trace/host time on shapes & dtypes (static under jit);
+value-dependent checks (e.g. label range) are only performed when inputs are
+concrete (eager), matching the reference's ``validate_args`` contract.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _is_concrete(x) -> bool:
+    """True when ``x`` holds real values (not a tracer) so value checks can run."""
+    return not isinstance(x, jax.core.Tracer)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ (reference ``checks.py:37``)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Check and format retrieval inputs (reference ``checks.py:507``)."""
+    if preds.shape != target.shape or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and of the same shape")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a array of floats")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.floating)):
+        raise ValueError("`target` must be a array of booleans, integers or floats")
+    if not allow_non_binary_target and _is_concrete(target) and bool(((target != 0) & (target != 1)).any()):
+        raise ValueError("`target` must contain `binary` values")
+    dtype = jnp.float32 if not allow_non_binary_target else target.dtype
+    return preds.reshape(-1).astype(jnp.float32), target.reshape(-1).astype(dtype)
+
+
+def _check_retrieval_inputs(
+    indexes: Array, preds: Array, target: Array, allow_non_binary_target: bool = False, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Check and format retrieval class inputs (reference ``checks.py:538``)."""
+    if indexes.shape != preds.shape or preds.shape != target.shape or indexes.ndim == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a array of integers")
+    if ignore_index is not None:
+        valid = np.asarray(target) != ignore_index
+        indexes, preds, target = (np.asarray(indexes)[valid], np.asarray(preds)[valid], np.asarray(target)[valid])
+        indexes, preds, target = jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target)
+    if not allow_non_binary_target and _is_concrete(target) and bool(((target != 0) & (target != 1)).any()):
+        raise ValueError("`target` must contain `binary` values")
+    return (
+        indexes.reshape(-1).astype(jnp.int32),
+        preds.reshape(-1).astype(jnp.float32),
+        target.reshape(-1).astype(jnp.float32 if not allow_non_binary_target else target.dtype),
+    )
+
+
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    return bool(jnp.allclose(jnp.asarray(res1), jnp.asarray(res2), atol=atol))
+
+
+def check_forward_full_state_property(
+    metric_class, init_args: Optional[dict] = None, input_args: Optional[dict] = None, num_update_to_compare=(10, 100, 1000), reps: int = 5
+) -> None:
+    """Empirically compare full-state vs partial-state ``forward`` (reference ``checks.py:634``)."""
+    import time
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):  # type: ignore[misc, valid-type]
+        full_state_update = True
+
+    class PartState(metric_class):  # type: ignore[misc, valid-type]
+        full_state_update = False
+
+    fs, ps = FullState(**init_args), PartState(**init_args)
+    res1 = fs(**input_args)
+    res2 = ps(**input_args)
+    if not _allclose_recursive(res1, res2):
+        raise RuntimeError(
+            "The metric does not give the same result with `full_state_update=False`; it must keep the default."
+        )
+    for metric, name in [(fs, "full"), (ps, "partial")]:
+        for num in num_update_to_compare:
+            metric.reset()
+            start = time.perf_counter()
+            for _ in range(num):
+                metric(**input_args)
+            jax.block_until_ready(metric.compute())
+            print(f"{name} state `forward` x{num}: {time.perf_counter() - start:.4f}s")

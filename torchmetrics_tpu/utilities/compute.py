@@ -1,0 +1,108 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Numerically-safe compute helpers.
+
+Capability parity with reference ``src/torchmetrics/utilities/compute.py``.
+All functions are pure jnp and jit-safe.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul that promotes half precision inputs (reference ``compute.py:20``)."""
+    if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
+        return (x.astype(jnp.float32) @ y.astype(jnp.float32).T).astype(x.dtype)
+    return x @ y.T
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` that is 0 whenever ``x == 0`` (reference ``compute.py:31``)."""
+    res = jax.scipy.special.xlogy(x, y)
+    return jnp.where(x == 0.0, 0.0, res)
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Division with a defined value where ``denom == 0`` (reference ``compute.py:46``)."""
+    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, jnp.float32)
+    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, jnp.float32)
+    zero = jnp.asarray(zero_division, dtype=jnp.result_type(num, denom))
+    return jnp.where(denom != 0, num / jnp.where(denom != 0, denom, 1.0), zero)
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array, top_k: int = 1
+) -> Array:
+    """Weighted/macro final averaging of per-class scores (reference ``compute.py:63``)."""
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = tp + fn
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
+        weights = jnp.where(jnp.isnan(score), 0.0, weights)
+    score = jnp.where(jnp.isnan(score), 0.0, score)
+    return _safe_divide(weights * score, weights.sum(-1, keepdims=True)).sum(-1)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under curve (reference ``compute.py:93``)."""
+    dx = jnp.diff(x, axis=axis)
+    return jnp.sum((jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis) + jnp.take(y, jnp.arange(0, y.shape[axis] - 1), axis=axis)) / 2.0 * dx, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """AUC with monotonicity handling (reference ``compute.py:99-120``).
+
+    Direction detection is data-dependent; jit-safe via a sign computed with jnp.
+    """
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+        direction = jnp.asarray(1.0)
+    else:
+        dx = jnp.diff(x)
+        any_neg = jnp.any(dx < 0)
+        all_nonpos = jnp.all(dx <= 0)
+        # matches reference semantics: decreasing -> -1, mixed -> nan-free error at
+        # trace time is impossible, so emit nan to signal invalid ordering
+        direction = jnp.where(any_neg, jnp.where(all_nonpos, -1.0, jnp.nan), 1.0)
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve ``y(x)`` using the trapezoidal rule."""
+    if x.ndim != 1 or y.ndim != 1:
+        raise ValueError(f"Expected 1d arrays, got x.ndim={x.ndim}, y.ndim={y.ndim}")
+    if x.shape[0] != y.shape[0]:
+        raise ValueError("x and y must have the same length")
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation, ``numpy.interp`` semantics (reference ``compute.py:139``)."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(tensor: Array, normalization: str = "sigmoid") -> Array:
+    """Apply sigmoid/softmax only when inputs are outside [0, 1].
+
+    The reference checks ``if not ((preds >= 0) & (preds <= 1)).all(): sigmoid()``
+    — a data-dependent branch. Under jit we compute both and select, which XLA
+    fuses into a single elementwise kernel.
+    """
+    if normalization == "sigmoid":
+        in_range = (tensor.min() >= 0) & (tensor.max() <= 1)
+        return jnp.where(in_range, tensor, jax.nn.sigmoid(tensor))
+    if normalization == "softmax":
+        in_range = (tensor.min() >= 0) & (tensor.max() <= 1)
+        return jnp.where(in_range, tensor, jax.nn.softmax(tensor, axis=1))
+    return tensor

@@ -1,0 +1,144 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Array manipulation helpers (the L1 utility layer).
+
+Capability parity with reference ``src/torchmetrics/utilities/data.py``.
+Everything here is pure ``jax.numpy`` with static shapes, so it can live
+inside ``jit``/``shard_map``-traced code. Notably the reference's
+deterministic/XLA ``_bincount`` fallback (``data.py:203-205``) — a one-hot
+compare-and-sum — is unnecessary on TPU: ``jnp.bincount(x, length=n)`` lowers
+to an XLA scatter-add which is already deterministic; we keep the compare
+formulation available as ``_bincount_onehot`` for tiny ``n`` where it fuses
+better.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array], Tuple[Array, ...]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0 (reference ``data.py:28``)."""
+    if isinstance(x, (jnp.ndarray, np.ndarray)) and not isinstance(x, (list, tuple)):
+        return jnp.asarray(x)
+    x = [jnp.atleast_1d(jnp.asarray(t)) for t in x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists into one list (reference ``data.py:58``)."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Tuple[Dict, bool]:
+    """Flatten dict of dicts into one level (reference ``data.py:63-77``)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Integer labels ``(N, ...)`` -> one-hot ``(N, C, ...)`` (reference ``data.py:80``)."""
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int64 if label_tensor.dtype == jnp.int64 else jnp.int32)
+    # one_hot appends the class dim last; reference puts it at dim 1
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference ``data.py:125``).
+
+    ``topk=1`` fast path uses argmax (reference ``data.py:145-146``).
+    """
+    if topk == 1:
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities -> class index via argmax (reference ``data.py:152``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.squeeze() if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    return jax.tree_util.tree_map(_squeeze_scalar_element_tensor, data)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Count occurrences of each value in ``[0, minlength)``.
+
+    Reference ``data.py:179-207``. ``jnp.bincount`` with a static ``length``
+    is an XLA scatter-add — deterministic and TPU-native; no fallback needed.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _bincount_onehot(x: Array, minlength: int) -> Array:
+    """Compare-and-sum bincount — the reference's deterministic fallback
+    (``data.py:203-205``); fuses well for small ``minlength``."""
+    mesh = jnp.arange(minlength, dtype=x.dtype)
+    return (x.reshape(-1, 1) == mesh.reshape(1, -1)).sum(axis=0)
+
+
+def _cumsum(x: Array, dim: int = 0, dtype=None) -> Array:
+    """Cumulative sum (reference ``data.py:210``; no CPU fallback needed on TPU)."""
+    return jnp.cumsum(x, axis=dim, dtype=dtype)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Count occurrences of each *unique* value (reference ``data.py:222``).
+
+    Unique is inherently dynamic-shape; runs on host (NumPy). Only used in
+    host-side compute paths (e.g. retrieval query splitting).
+    """
+    x = np.asarray(x)
+    _, counts = np.unique(x, return_counts=True)
+    return jnp.asarray(counts)
+
+
+def allclose(tensor1: Array, tensor2: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    """Shape- and dtype-robust allclose (reference ``data.py:241``)."""
+    if jnp.shape(tensor1) != jnp.shape(tensor2):
+        return False
+    return bool(jnp.allclose(jnp.asarray(tensor1, jnp.float32), jnp.asarray(tensor2, jnp.float32), rtol=rtol, atol=atol))

@@ -1,0 +1,137 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Cross-process synchronization primitives.
+
+Capability parity with reference ``src/torchmetrics/utilities/distributed.py``,
+re-designed for JAX's two distribution regimes:
+
+1. **In-step sharding (primary, TPU-native)** — metric updates run inside
+   ``pjit``/``shard_map`` over a ``jax.sharding.Mesh``; per-device partial
+   states are merged with XLA collectives (``psum``/``pmax``/``all_gather``)
+   over ICI. See ``torchmetrics_tpu.parallel``. This subsumes the reference's
+   per-step NCCL path.
+2. **Multi-host replica sync (this module)** — the analogue of the reference's
+   ``gather_all_tensors`` (``distributed.py:97-147``): each *process* holds a
+   local replica of the states; ``Metric.sync()`` gathers them over DCN via
+   ``jax.experimental.multihost_utils``. The reference's pad-to-max-then-trim
+   protocol for uneven shapes (``:124-147``) is reproduced on the host.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def reduce(x: Array, reduction: Optional[str]) -> Array:
+    """Reduce a tensor by elementwise-mean/sum or identity (reference ``distributed.py:22``)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Reduce per-class metric scores (reference ``distributed.py:45``)."""
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+    fraction = jnp.where(jnp.isnan(fraction), 0.0, fraction)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(jnp.float32) / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+def distributed_available() -> bool:
+    """True when more than one JAX process participates (multi-host)."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def world_size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """Gather an array from every process, supporting uneven dim sizes.
+
+    Mirrors reference ``gather_all_tensors`` (``distributed.py:97-147``):
+    gather shapes first, pad every local tensor to the per-dim max, all-gather,
+    then trim each gathered tensor back to its true shape. Runs on host via
+    ``multihost_utils`` (DCN); single-process returns ``[result]``.
+    ``group`` is accepted for API parity; JAX collectives span all processes.
+    """
+    if not distributed_available():
+        return [result]
+    from jax.experimental import multihost_utils
+
+    result = jnp.asarray(result)
+    local_shape = np.asarray(result.shape, dtype=np.int32)
+    ndim = np.int32(result.ndim)
+    # gather every process's shape (pad rank to max 8 dims for a static gather)
+    max_rank = 8
+    shape_buf = np.zeros((max_rank,), dtype=np.int32)
+    shape_buf[: local_shape.size] = local_shape
+    all_shapes = np.asarray(multihost_utils.process_allgather(jnp.asarray(shape_buf)))
+    n_proc = all_shapes.shape[0]
+    ranks = np.asarray(multihost_utils.process_allgather(jnp.asarray([ndim])))
+    all_true_shapes = [tuple(int(d) for d in all_shapes[p][: int(ranks[p][0])]) for p in range(n_proc)]
+    # fast path: all shapes equal
+    if all(s == all_true_shapes[0] for s in all_true_shapes):
+        stacked = np.asarray(multihost_utils.process_allgather(result))
+        return [jnp.asarray(stacked[p]) for p in range(n_proc)]
+    # slow path: pad to per-dim max, gather, trim (reference :124-147)
+    max_shape = tuple(int(m) for m in np.max(np.stack([np.array(s + (0,) * (max_rank - len(s))) for s in all_true_shapes]), axis=0)[: result.ndim])
+    pad_width = [(0, m - s) for m, s in zip(max_shape, result.shape)]
+    padded = jnp.pad(result, pad_width)
+    stacked = np.asarray(multihost_utils.process_allgather(padded))
+    out: List[Array] = []
+    for p in range(n_proc):
+        slices = tuple(slice(0, d) for d in all_true_shapes[p])
+        out.append(jnp.asarray(stacked[p][slices]))
+    return out
+
+
+def gather_all_objects(obj: Any) -> List[Any]:
+    """Gather arbitrary picklable objects from all processes.
+
+    Analogue of ``dist.all_gather_object`` used by mAP RLE masks
+    (reference ``detection/mean_ap.py:1043-1061``).
+    """
+    if not distributed_available():
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    return list(multihost_utils.broadcast_one_to_all_and_gather(obj)) if hasattr(multihost_utils, "broadcast_one_to_all_and_gather") else _gather_objects_via_bytes(obj)
+
+
+def _gather_objects_via_bytes(obj: Any) -> List[Any]:
+    import pickle
+
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    size = jnp.asarray([payload.size], dtype=jnp.int32)
+    sizes = np.asarray(multihost_utils.process_allgather(size)).reshape(-1)
+    max_size = int(sizes.max())
+    buf = np.zeros((max_size,), dtype=np.uint8)
+    buf[: payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(jnp.asarray(buf)))
+    return [pickle.loads(gathered[p][: int(sizes[p])].tobytes()) for p in range(gathered.shape[0])]

@@ -1,0 +1,106 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""String enums used across the framework.
+
+Capability parity with reference ``src/torchmetrics/utilities/enums.py``.
+"""
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """Case-insensitive string enum (reference ``enums.py:20``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Task"
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "Key") -> "EnumStr":
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError as err:
+            valid = [str(m.value) for m in cls]
+            raise ValueError(
+                f"Invalid {cls._name()}: expected one of {valid}, but got {value}."
+            ) from err
+
+    def __str__(self) -> str:
+        return self.value.lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+    def __eq__(self, other: object) -> bool:
+        other = other.value if isinstance(other, Enum) else str(other)
+        return self.value.lower() == other.lower()
+
+
+class DataType(EnumStr):
+    """Input data format (reference ``enums.py:56``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Data type"
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Averaging strategy (reference ``enums.py:74``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Average method"
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = None  # type: ignore[assignment]
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class averaging."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """Classification task dispatch key (reference ``enums.py:108``)."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoBinary(EnumStr):
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    @staticmethod
+    def _name() -> str:
+        return "Classification"
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+
+
+def _allclose_enum(value: Optional[str], enum_cls: type) -> bool:
+    return value in [m.value for m in enum_cls]

@@ -1,0 +1,14 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""User-facing exception types.
+
+Capability parity with reference ``src/torchmetrics/utilities/exceptions.py``.
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised when a misuse of the metrics API is detected."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised for recoverable misuses of the metrics API."""

@@ -1,0 +1,55 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Optional-dependency feature flags.
+
+Capability parity with reference ``src/torchmetrics/utilities/imports.py:22-70``
+(``RequirementCache`` flags). Implemented with a light importlib probe: no
+pkg_resources, evaluated lazily and cached.
+"""
+from __future__ import annotations
+
+import importlib
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _module_available(module: str) -> bool:
+    try:
+        return importlib.util.find_spec(module) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+class ModuleAvailableCache:
+    """Lazy boolean flag for an optional dependency, ``bool(flag)`` probes once."""
+
+    def __init__(self, module: str) -> None:
+        self.module = module
+
+    def __bool__(self) -> bool:
+        return _module_available(self.module)
+
+    def __repr__(self) -> str:
+        return f"ModuleAvailableCache({self.module!r}, available={bool(self)})"
+
+
+_JAX_AVAILABLE = ModuleAvailableCache("jax")
+_FLAX_AVAILABLE = ModuleAvailableCache("flax")
+_SCIPY_AVAILABLE = ModuleAvailableCache("scipy")
+_MATPLOTLIB_AVAILABLE = ModuleAvailableCache("matplotlib")
+_SCIENCEPLOT_AVAILABLE = ModuleAvailableCache("scienceplots")
+_TRANSFORMERS_AVAILABLE = ModuleAvailableCache("transformers")
+_NLTK_AVAILABLE = ModuleAvailableCache("nltk")
+_REGEX_AVAILABLE = ModuleAvailableCache("regex")
+_PESQ_AVAILABLE = ModuleAvailableCache("pesq")
+_PYSTOI_AVAILABLE = ModuleAvailableCache("pystoi")
+_LIBROSA_AVAILABLE = ModuleAvailableCache("librosa")
+_ONNXRUNTIME_AVAILABLE = ModuleAvailableCache("onnxruntime")
+_GAMMATONE_AVAILABLE = ModuleAvailableCache("gammatone")
+_MECAB_AVAILABLE = ModuleAvailableCache("MeCab")
+_IPADIC_AVAILABLE = ModuleAvailableCache("ipadic")
+_SENTENCEPIECE_AVAILABLE = ModuleAvailableCache("sentencepiece")
+_SKLEARN_AVAILABLE = ModuleAvailableCache("sklearn")
+_TORCH_AVAILABLE = ModuleAvailableCache("torch")
+_PIQ_GREATER_EQUAL_0_8 = ModuleAvailableCache("piq")

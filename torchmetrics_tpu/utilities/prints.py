@@ -1,0 +1,52 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Rank-zero printing/warning discipline.
+
+Capability parity with reference ``src/torchmetrics/utilities/prints.py:22-68``.
+In JAX the analogue of "rank" is the process index (multi-host); within one
+process all devices share the Python interpreter, so process 0 is rank zero.
+"""
+from __future__ import annotations
+
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Call ``fn`` only on process 0 of a multi-host run."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_print(*args: Any, **kwargs: Any) -> None:
+    print(*args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
+    kwargs.setdefault("stacklevel", 5)
+    warnings.warn(message, *args, **kwargs)
+
+
+def _deprecation_warn(message: str) -> None:
+    rank_zero_warn(message, DeprecationWarning)
+
+
+rank_zero_deprecation = partial(rank_zero_warn, category=DeprecationWarning)

@@ -1,0 +1,7 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Wrapper metrics (layer L5) — meta-metrics wrapping a base metric."""
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+from torchmetrics_tpu.wrappers.running import Running
+
+__all__ = ["WrapperMetric", "Running"]

@@ -1,0 +1,25 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Abstract base for wrapper metrics (reference ``wrappers/abstract.py:19``)."""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from torchmetrics_tpu.metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Base class for metrics that wrap another metric.
+
+    All synchronization logic is handled by the wrapped metric, so the
+    wrapper disables its own update/compute bookkeeping wrappers.
+    """
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        return update
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        return compute
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError

@@ -1,0 +1,77 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Running-window wrapper (reference ``wrappers/running.py:27``).
+
+Stores ``window`` copies of every state of the wrapped metric keyed
+``key_{i}`` (reference ``running.py:101-113``); ``compute`` folds the window
+slots back into the base metric with its declared reductions.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class Running(WrapperMetric):
+    """Compute a metric over a running window of the last ``window`` updates."""
+
+    def __init__(self, base_metric: Metric, window: int = 5) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {base_metric}")
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update is not False:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._num_vals_seen = 0
+        for key in base_metric._defaults:
+            for i in range(window):
+                self.add_state(
+                    name=key + f"_{i}", default=base_metric._defaults[key], dist_reduce_fx=base_metric._reductions[key]
+                )
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the base metric and store its state in the current window slot."""
+        val = self._num_vals_seen % self.window
+        self.base_metric.update(*args, **kwargs)
+        for key in self.base_metric._defaults:
+            setattr(self, key + f"_{val}", getattr(self.base_metric, key))
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward to the base metric (returns the batch value) and store state."""
+        val = self._num_vals_seen % self.window
+        res = self.base_metric.forward(*args, **kwargs)
+        for key in self.base_metric._defaults:
+            setattr(self, key + f"_{val}", getattr(self.base_metric, key))
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+        self._computed = None
+        return res
+
+    def compute(self) -> Any:
+        """Merge window slots into the base metric and compute."""
+        for i in range(self.window):
+            self.base_metric._update_count += 1
+            self.base_metric._reduce_states(
+                {key: getattr(self, key + f"_{i}") for key in self.base_metric._defaults}
+            )
+        self.base_metric._update_count = self._num_vals_seen
+        val = self.base_metric.compute()
+        self.base_metric.reset()
+        return val
+
+    def reset(self) -> None:
+        super().reset()
+        self.base_metric.reset()
+        self._num_vals_seen = 0
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
