@@ -1,0 +1,117 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Curve family (PR-curve, ROC, AUROC, AP) vs sklearn oracles (reference tests:
+``tests/unittests/classification/test_{precision_recall_curve,roc,auroc,average_precision}.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from torchmetrics_tpu.functional.classification.auroc import binary_auroc, multiclass_auroc, multilabel_auroc
+from torchmetrics_tpu.functional.classification.average_precision import (
+    binary_average_precision,
+    multiclass_average_precision,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+)
+from torchmetrics_tpu.functional.classification.roc import binary_roc, multiclass_roc
+
+N, C, L = 231, 5, 4
+rng = np.random.RandomState(31)
+T_B = rng.randint(0, 2, N)
+P_B = rng.rand(N)
+T_MC = rng.randint(0, C, N)
+P_MC_LOGITS = rng.randn(N, C)
+P_MC = np.exp(P_MC_LOGITS) / np.exp(P_MC_LOGITS).sum(1, keepdims=True)
+T_ML = rng.randint(0, 2, (N, L))
+P_ML = rng.rand(N, L)
+
+
+def test_binary_pr_curve_exact():
+    prec, rec, thr = binary_precision_recall_curve(P_B, T_B)
+    sp, sr, st = skm.precision_recall_curve(T_B, P_B)
+    np.testing.assert_allclose(np.asarray(prec), sp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rec), sr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(thr), st, atol=1e-6)
+
+
+def test_binary_roc_exact():
+    fpr, tpr, thr = binary_roc(P_B, T_B)
+    s_fpr, s_tpr, s_thr = skm.roc_curve(T_B, P_B, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), s_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), s_tpr, atol=1e-6)
+
+
+def test_binary_auroc_exact_and_binned():
+    sk_val = skm.roc_auc_score(T_B, P_B)
+    assert abs(float(binary_auroc(P_B, T_B)) - sk_val) < 1e-6
+    # binned with many thresholds approximates the exact value
+    assert abs(float(binary_auroc(P_B, T_B, thresholds=1000)) - sk_val) < 5e-3
+
+
+def test_binary_average_precision():
+    sk_val = skm.average_precision_score(T_B, P_B)
+    assert abs(float(binary_average_precision(P_B, T_B)) - sk_val) < 1e-6
+
+
+def test_multiclass_auroc():
+    for avg in ("macro", "weighted"):
+        sk_val = skm.roc_auc_score(T_MC, P_MC, multi_class="ovr", average=avg)
+        assert abs(float(multiclass_auroc(P_MC, T_MC, C, average=avg)) - sk_val) < 1e-5, avg
+    binned = float(multiclass_auroc(P_MC, T_MC, C, average="macro", thresholds=500))
+    assert abs(binned - skm.roc_auc_score(T_MC, P_MC, multi_class="ovr", average="macro")) < 5e-3
+
+
+def test_multiclass_average_precision():
+    sk_per_class = [
+        skm.average_precision_score((T_MC == i).astype(int), P_MC[:, i]) for i in range(C)
+    ]
+    res = np.asarray(multiclass_average_precision(P_MC, T_MC, C, average=None))
+    np.testing.assert_allclose(res, sk_per_class, atol=1e-6)
+    assert abs(float(multiclass_average_precision(P_MC, T_MC, C, average="macro")) - np.mean(sk_per_class)) < 1e-6
+
+
+def test_multilabel_auroc():
+    sk_val = skm.roc_auc_score(T_ML, P_ML, average="macro")
+    assert abs(float(multilabel_auroc(P_ML, T_ML, L, average="macro")) - sk_val) < 1e-5
+    sk_micro = skm.roc_auc_score(T_ML.flatten(), P_ML.flatten())
+    assert abs(float(multilabel_auroc(P_ML, T_ML, L, average="micro")) - sk_micro) < 1e-5
+
+
+def test_multiclass_pr_curve_exact_matches_binary_per_class():
+    prec_list, rec_list, thr_list = multiclass_precision_recall_curve(P_MC, T_MC, C)
+    for i in range(C):
+        sp, sr, st = skm.precision_recall_curve((T_MC == i).astype(int), P_MC[:, i])
+        np.testing.assert_allclose(np.asarray(prec_list[i]), sp, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec_list[i]), sr, atol=1e-6)
+
+
+def test_binned_roc_shapes():
+    fpr, tpr, thr = multiclass_roc(P_MC, T_MC, C, thresholds=20)
+    assert np.asarray(fpr).shape == (C, 20)
+    assert np.asarray(tpr).shape == (C, 20)
+    assert np.asarray(thr).shape == (20,)
+
+
+def test_multiclass_roc_micro_macro():
+    # micro: one-vs-rest flattened == binary roc on flattened one-hot
+    fpr, tpr, thr = multiclass_roc(P_MC, T_MC, C, average="micro")
+    onehot = np.eye(C)[T_MC].flatten()
+    s_fpr, s_tpr, _ = skm.roc_curve(onehot, P_MC.flatten(), drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), s_fpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), s_tpr, atol=1e-6)
+    # macro: merged curve is 1-D and monotone in fpr
+    m_fpr, m_tpr, m_thr = multiclass_roc(P_MC, T_MC, C, average="macro")
+    assert np.asarray(m_fpr).ndim == 1
+    assert bool((np.diff(np.asarray(m_fpr)) >= 0).all())
+    # binned macro path also works
+    b_fpr, b_tpr, _ = multiclass_roc(P_MC, T_MC, C, thresholds=20, average="macro")
+    assert np.asarray(b_fpr).ndim == 1
+
+
+def test_ignore_index_auroc():
+    t2 = T_B.copy()
+    t2[:40] = -1
+    sk_val = skm.roc_auc_score(T_B[40:], P_B[40:])
+    assert abs(float(binary_auroc(P_B, t2, ignore_index=-1)) - sk_val) < 1e-6

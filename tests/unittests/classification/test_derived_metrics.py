@@ -1,0 +1,171 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Derived classification families vs sklearn oracles (reference tests:
+``tests/unittests/classification/test_{accuracy,f_beta,precision_recall,...}.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from torchmetrics_tpu.functional.classification.accuracy import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+)
+from torchmetrics_tpu.functional.classification.cohen_kappa import binary_cohen_kappa, multiclass_cohen_kappa
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from torchmetrics_tpu.functional.classification.exact_match import multiclass_exact_match, multilabel_exact_match
+from torchmetrics_tpu.functional.classification.f_beta import (
+    binary_f1_score,
+    multiclass_f1_score,
+    multiclass_fbeta_score,
+)
+from torchmetrics_tpu.functional.classification.hamming import binary_hamming_distance, multiclass_hamming_distance
+from torchmetrics_tpu.functional.classification.jaccard import (
+    binary_jaccard_index,
+    multiclass_jaccard_index,
+    multilabel_jaccard_index,
+)
+from torchmetrics_tpu.functional.classification.matthews_corrcoef import (
+    binary_matthews_corrcoef,
+    multiclass_matthews_corrcoef,
+)
+from torchmetrics_tpu.functional.classification.precision_recall import (
+    binary_precision,
+    binary_recall,
+    multiclass_precision,
+    multiclass_recall,
+    multilabel_precision,
+)
+from torchmetrics_tpu.functional.classification.specificity import binary_specificity, multiclass_specificity
+
+N, C, L = 199, 5, 4
+rng = np.random.RandomState(11)
+T_MC = rng.randint(0, C, N)
+P_MC = rng.randint(0, C, N)
+T_B = rng.randint(0, 2, N)
+P_B = rng.randint(0, 2, N)
+P_BF = rng.rand(N)
+T_ML = rng.randint(0, 2, (N, L))
+P_ML = rng.rand(N, L)
+
+
+def _close(a, b, tol=1e-6):
+    return np.allclose(np.asarray(a), np.asarray(b), atol=tol)
+
+
+def test_binary_family():
+    assert _close(binary_accuracy(P_B, T_B), skm.accuracy_score(T_B, P_B))
+    assert _close(binary_precision(P_B, T_B), skm.precision_score(T_B, P_B))
+    assert _close(binary_recall(P_B, T_B), skm.recall_score(T_B, P_B))
+    assert _close(binary_f1_score(P_B, T_B), skm.f1_score(T_B, P_B))
+    assert _close(binary_specificity(P_B, T_B), skm.recall_score(1 - T_B, 1 - P_B))
+    assert _close(binary_hamming_distance(P_B, T_B), 1 - skm.accuracy_score(T_B, P_B))
+    assert _close(binary_jaccard_index(P_B, T_B), skm.jaccard_score(T_B, P_B))
+    assert _close(binary_cohen_kappa(P_B, T_B), skm.cohen_kappa_score(T_B, P_B), 1e-5)
+    assert _close(binary_matthews_corrcoef(P_B, T_B), skm.matthews_corrcoef(T_B, P_B), 1e-5)
+    assert np.array_equal(np.asarray(binary_confusion_matrix(P_B, T_B)), skm.confusion_matrix(T_B, P_B))
+    # float preds thresholded at 0.5
+    assert _close(binary_accuracy(P_BF, T_B), skm.accuracy_score(T_B, (P_BF > 0.5).astype(int)))
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+def test_multiclass_family(average):
+    sk_avg = average if average else None
+    assert _close(
+        multiclass_precision(P_MC, T_MC, C, average=average),
+        skm.precision_score(T_MC, P_MC, average=sk_avg, zero_division=0),
+    )
+    assert _close(
+        multiclass_recall(P_MC, T_MC, C, average=average),
+        skm.recall_score(T_MC, P_MC, average=sk_avg, zero_division=0),
+    )
+    assert _close(
+        multiclass_f1_score(P_MC, T_MC, C, average=average),
+        skm.f1_score(T_MC, P_MC, average=sk_avg, zero_division=0),
+    )
+    assert _close(
+        multiclass_fbeta_score(P_MC, T_MC, 2.0, C, average=average),
+        skm.fbeta_score(T_MC, P_MC, beta=2.0, average=sk_avg, zero_division=0),
+    )
+    assert _close(
+        multiclass_jaccard_index(P_MC, T_MC, C, average=average),
+        skm.jaccard_score(T_MC, P_MC, average=sk_avg if sk_avg else None, zero_division=0)
+        if average
+        else skm.jaccard_score(T_MC, P_MC, average=None, zero_division=0),
+    )
+
+
+def test_multiclass_scalar_metrics():
+    assert _close(multiclass_accuracy(P_MC, T_MC, C, average="micro"), skm.accuracy_score(T_MC, P_MC))
+    assert _close(multiclass_accuracy(P_MC, T_MC, C, average="macro"), skm.balanced_accuracy_score(T_MC, P_MC))
+    assert _close(multiclass_cohen_kappa(P_MC, T_MC, C), skm.cohen_kappa_score(T_MC, P_MC), 1e-5)
+    assert _close(
+        multiclass_cohen_kappa(P_MC, T_MC, C, weights="linear"),
+        skm.cohen_kappa_score(T_MC, P_MC, weights="linear"),
+        1e-5,
+    )
+    assert _close(
+        multiclass_cohen_kappa(P_MC, T_MC, C, weights="quadratic"),
+        skm.cohen_kappa_score(T_MC, P_MC, weights="quadratic"),
+        1e-5,
+    )
+    assert _close(multiclass_matthews_corrcoef(P_MC, T_MC, C), skm.matthews_corrcoef(T_MC, P_MC), 1e-5)
+    assert np.array_equal(
+        np.asarray(multiclass_confusion_matrix(P_MC, T_MC, C)), skm.confusion_matrix(T_MC, P_MC)
+    )
+    assert _close(multiclass_hamming_distance(P_MC, T_MC, C, average="micro"), 1 - skm.accuracy_score(T_MC, P_MC))
+    # specificity oracle: per-class tn/(tn+fp) from sk multilabel confmat
+    cms = skm.multilabel_confusion_matrix(T_MC, P_MC, labels=list(range(C)))
+    spec = cms[:, 0, 0] / (cms[:, 0, 0] + cms[:, 0, 1])
+    assert _close(multiclass_specificity(P_MC, T_MC, C, average=None), spec)
+
+
+def test_multiclass_logits_and_ignore():
+    logits = rng.randn(N, C)
+    assert _close(
+        multiclass_accuracy(logits, T_MC, C, average="micro"),
+        skm.accuracy_score(T_MC, logits.argmax(1)),
+    )
+    t2 = T_MC.copy()
+    t2[:30] = -1
+    assert _close(
+        multiclass_accuracy(P_MC, t2, C, average="micro", ignore_index=-1),
+        skm.accuracy_score(t2[30:], P_MC[30:]),
+    )
+
+
+def test_multilabel_family():
+    pb = (P_ML > 0.5).astype(int)
+    assert _close(
+        multilabel_precision(P_ML, T_ML, L, average="macro"),
+        skm.precision_score(T_ML, pb, average="macro", zero_division=0),
+    )
+    assert _close(
+        multilabel_jaccard_index(P_ML, T_ML, L, average="macro"),
+        skm.jaccard_score(T_ML, pb, average="macro", zero_division=0),
+    )
+    cms = np.asarray(multilabel_confusion_matrix(P_ML, T_ML, L))
+    sk_cms = skm.multilabel_confusion_matrix(T_ML, pb)
+    assert np.array_equal(cms, sk_cms)
+    # multilabel accuracy (label-wise) = mean over labels of per-label accuracy
+    per_label_acc = (pb == T_ML).mean(0)
+    assert _close(multilabel_accuracy(P_ML, T_ML, L, average="macro"), per_label_acc.mean())
+
+
+def test_exact_match():
+    assert _close(multilabel_exact_match(P_ML, T_ML, L), ((P_ML > 0.5).astype(int) == T_ML).all(1).mean())
+    t = rng.randint(0, C, (16, 7))
+    p = rng.randint(0, C, (16, 7))
+    assert _close(multiclass_exact_match(p, t, C), (p == t).all(1).mean())
+
+
+def test_top_k_accuracy():
+    logits = rng.randn(N, C)
+    for k in (1, 2, 3):
+        topk = np.argsort(-logits, axis=1)[:, :k]
+        sk_val = np.mean([T_MC[i] in topk[i] for i in range(N)])
+        assert _close(multiclass_accuracy(logits, T_MC, C, average="micro", top_k=k), sk_val)

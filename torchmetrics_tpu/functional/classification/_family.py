@@ -1,0 +1,156 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Factory for stat-scores-derived metric families.
+
+The reference re-spells the validate/format/update/reduce pipeline for every
+family (accuracy, precision, recall, fbeta, specificity, hamming, ...;
+~500 LoC each). Here one factory builds the ``binary_*``/``multiclass_*``/
+``multilabel_*`` functional triple from a reduce function — same behavior,
+one implementation of the pipeline.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multiclass_stat_scores_update,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
+)
+
+Array = jax.Array
+
+# A reduce fn has signature
+#   reduce(tp, fp, tn, fn, average, multidim_average, multilabel, top_k, zero_division) -> Array
+
+
+def make_binary(reduce: Callable, name: str) -> Callable:
+    def binary_fn(
+        preds: Array,
+        target: Array,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+    ) -> Array:
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index, zero_division)
+            _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+        preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(preds, target, multidim_average)
+        return reduce(tp, fp, tn, fn, "binary", multidim_average, False, 1, zero_division)
+
+    binary_fn.__name__ = f"binary_{name}"
+    binary_fn.__qualname__ = f"binary_{name}"
+    return binary_fn
+
+
+def make_multiclass(reduce: Callable, name: str, default_average: str = "macro") -> Callable:
+    def multiclass_fn(
+        preds: Array,
+        target: Array,
+        num_classes: int,
+        average: Optional[str] = default_average,
+        top_k: int = 1,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+    ) -> Array:
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(
+                num_classes, top_k, average, multidim_average, ignore_index, zero_division
+            )
+            _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+        preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(
+            preds, target, num_classes, top_k, average, multidim_average, ignore_index
+        )
+        return reduce(tp, fp, tn, fn, average, multidim_average, False, top_k, zero_division)
+
+    multiclass_fn.__name__ = f"multiclass_{name}"
+    multiclass_fn.__qualname__ = f"multiclass_{name}"
+    return multiclass_fn
+
+
+def make_multilabel(reduce: Callable, name: str, default_average: str = "macro") -> Callable:
+    def multilabel_fn(
+        preds: Array,
+        target: Array,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = default_average,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+    ) -> Array:
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(
+                num_labels, threshold, average, multidim_average, ignore_index, zero_division
+            )
+            _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+        preds, target = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+        tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, multidim_average)
+        return reduce(tp, fp, tn, fn, average, multidim_average, True, 1, zero_division)
+
+    multilabel_fn.__name__ = f"multilabel_{name}"
+    multilabel_fn.__qualname__ = f"multilabel_{name}"
+    return multilabel_fn
+
+
+def make_task_dispatch(name: str, binary_fn: Callable, multiclass_fn: Callable, multilabel_fn: Callable) -> Callable:
+    from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+    def task_fn(
+        preds: Array,
+        target: Array,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: int = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+    ) -> Array:
+        task_enum = ClassificationTask.from_str(task)
+        if task_enum == ClassificationTask.BINARY:
+            return binary_fn(preds, target, threshold, multidim_average, ignore_index, validate_args, zero_division)
+        if task_enum == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+            return multiclass_fn(
+                preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args, zero_division
+            )
+        if task_enum == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return multilabel_fn(
+                preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args, zero_division
+            )
+        raise ValueError(f"Not handled value: {task}")
+
+    task_fn.__name__ = name
+    task_fn.__qualname__ = name
+    return task_fn

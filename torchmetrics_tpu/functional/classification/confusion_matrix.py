@@ -1,0 +1,321 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Confusion-matrix kernels.
+
+Capability parity with reference
+``src/torchmetrics/functional/classification/confusion_matrix.py``.
+All paths use the bincount trick (``target * C + preds``) lowered to one XLA
+scatter-add; ``ignore_index`` is masked into a trash bin (static shapes).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+from torchmetrics_tpu.utilities.data import _bincount
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalize over true/pred/all (reference ``confusion_matrix.py:40-60``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / confmat.sum(axis=-1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / confmat.sum(axis=-2, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / confmat.sum(axis=(-2, -1), keepdims=True)
+        confmat = jnp.nan_to_num(confmat, nan=0.0)
+    return confmat
+
+
+# ---------------------------------------------------------------------- binary
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
+
+
+def _binary_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if _is_concrete(target):
+        ok = (target == 0) | (target == 1)
+        if ignore_index is not None:
+            ok = ok | (target == ignore_index)
+        if not bool(jnp.all(ok)):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {jnp.unique(target)} but expected only"
+                f" the following values {[0, 1] + ([ignore_index] if ignore_index is not None else [])}."
+            )
+    if not jnp.issubdtype(preds.dtype, jnp.floating) and _is_concrete(preds):
+        if not bool(jnp.all((preds == 0) | (preds == 1))):
+            raise RuntimeError("Detected non-binary integer predictions; pass a float tensor for probabilities/logits.")
+
+
+def _binary_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    convert_to_labels: bool = True,
+) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1).astype(jnp.int32)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if convert_to_labels:
+            preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array) -> Array:
+    """2x2 confmat via bincount with trash bin for ignored (reference ``:128``)."""
+    valid = target >= 0
+    unique_mapping = jnp.where(valid, target * 2 + preds, 4)
+    bins = _bincount(unique_mapping, minlength=5)[:4]
+    return bins.reshape(2, 2)
+
+
+def _binary_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Binary confusion matrix (reference ``confusion_matrix.py:142``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target)
+    return _binary_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------ multiclass
+
+
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
+
+
+def _multiclass_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should be (N, C, ...), and the shape of `target` should be (N, ...).")
+    elif preds.ndim == target.ndim:
+        _check_same_shape(preds, target)
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...) and `preds` should be (N, C, ...).")
+    if _is_concrete(target):
+        ok = (target >= 0) & (target < num_classes)
+        if ignore_index is not None:
+            ok = ok | (target == ignore_index)
+        if not bool(jnp.all(ok)):
+            raise RuntimeError(
+                f"Detected values in `target` outside the expected range [0, {num_classes - 1}]"
+                + (f" (or ignore_index={ignore_index})" if ignore_index is not None else "")
+                + f". Found values: {jnp.unique(target)}."
+            )
+    if not jnp.issubdtype(preds.dtype, jnp.floating) and _is_concrete(preds):
+        if not bool(jnp.all((preds >= 0) & (preds < num_classes))):
+            raise RuntimeError(f"Detected values in `preds` outside the expected range [0, {num_classes - 1}]. Found values: {jnp.unique(preds)}.")
+
+
+def _multiclass_confusion_matrix_format(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+    return preds.reshape(-1).astype(jnp.int32), target.reshape(-1).astype(jnp.int32)
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None) -> Array:
+    """C×C confmat via the bincount trick (reference ``:269``)."""
+    if ignore_index is not None:
+        valid = target != ignore_index
+        unique_mapping = jnp.where(valid, target * num_classes + jnp.clip(preds, 0, num_classes - 1), num_classes**2)
+        bins = _bincount(unique_mapping, minlength=num_classes**2 + 1)[: num_classes**2]
+    else:
+        unique_mapping = target * num_classes + preds
+        bins = _bincount(unique_mapping, minlength=num_classes**2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multiclass confusion matrix (reference ``confusion_matrix.py:287``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes, ignore_index)
+    return _multiclass_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------ multilabel
+
+
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
+
+
+def _multilabel_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.ndim < 2 or preds.shape[1] != num_labels:
+        raise ValueError(f"Expected both `preds` and `target` to have 2nd dimension equal to `num_labels`={num_labels}")
+    if _is_concrete(target):
+        ok = (target == 0) | (target == 1)
+        if ignore_index is not None:
+            ok = ok | (target == ignore_index)
+        if not bool(jnp.all(ok)):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {jnp.unique(target)} but expected only"
+                f" the following values {[0, 1] + ([ignore_index] if ignore_index is not None else [])}."
+            )
+
+
+def _multilabel_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    should_threshold: bool = True,
+) -> Tuple[Array, Array]:
+    """Flatten ``(N, L, ...)`` to ``(N*X, L)`` with thresholding (reference ``:442``)."""
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if should_threshold:
+            preds = (preds > threshold).astype(jnp.int32)
+    preds = jnp.moveaxis(preds.reshape(*preds.shape[:2], -1), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target.reshape(*target.shape[:2], -1), 1, -1).reshape(-1, num_labels).astype(jnp.int32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, num_labels: int) -> Array:
+    """Per-label 2x2 confmats (reference ``:474``)."""
+    valid = target >= 0
+    unique_mapping = jnp.arange(num_labels)[None, :] * 4 + target * 2 + preds
+    unique_mapping = jnp.where(valid, unique_mapping, 4 * num_labels)
+    bins = _bincount(unique_mapping, minlength=4 * num_labels + 1)[: 4 * num_labels]
+    return bins.reshape(num_labels, 2, 2)
+
+
+def _multilabel_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multilabel confusion matrix, shape ``(L, 2, 2)`` (reference ``confusion_matrix.py:496``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, num_labels)
+    return _multilabel_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------- dispatch
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching confusion matrix (reference ``confusion_matrix.py:571``)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

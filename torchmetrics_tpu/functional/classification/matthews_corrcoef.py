@@ -1,0 +1,149 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Matthews correlation coefficient kernels
+(reference ``functional/classification/matthews_corrcoef.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_tensor_validation,
+    _multilabel_confusion_matrix_update,
+)
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Reduce confusion matrix into MCC (reference ``matthews_corrcoef.py:37-81``).
+
+    The reference's data-dependent special cases (all-positive/all-negative
+    binary confmats, zero denominators) are expressed with ``jnp.where`` so the
+    whole reduction stays jit-safe.
+    """
+    confmat = confmat.sum(0) if confmat.ndim == 3 else confmat  # multilabel -> binary
+    confmat = confmat.astype(jnp.float32)
+
+    tk = confmat.sum(axis=-1)
+    pk = confmat.sum(axis=-2)
+    c = jnp.trace(confmat)
+    s = confmat.sum()
+
+    cov_ytyp = c * s - (tk * pk).sum()
+    cov_ypyp = s**2 - (pk * pk).sum()
+    cov_ytyt = s**2 - (tk * tk).sum()
+
+    numerator = cov_ytyp
+    denom = cov_ypyp * cov_ytyt
+
+    if confmat.size == 4:  # binary special cases (reference ``:46-77``)
+        tn, fp, fn, tp = confmat.reshape(-1)
+        eps = jnp.asarray(jnp.finfo(jnp.float32).eps, dtype=jnp.float32)
+        # choose (a, b) by which margin collapsed
+        a = jnp.where(
+            (fn == 0) & (tn == 0), tp, jnp.where((fp == 0) & (tn == 0), tp, jnp.where((tp == 0) & (fn == 0), tn, tn))
+        )
+        b = jnp.where(
+            (fn == 0) & (tn == 0), fp, jnp.where((fp == 0) & (tn == 0), fn, jnp.where((tp == 0) & (fn == 0), fp, fn))
+        )
+        eps_numerator = jnp.sqrt(eps) * (a - b)
+        eps_denom = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+        numerator = jnp.where(denom == 0, eps_numerator, numerator)
+        denom = jnp.where(denom == 0, eps_denom, denom)
+        res = numerator / jnp.sqrt(denom)
+        res = jnp.where((tp + tn != 0) & (fp + fn == 0), 1.0, res)
+        res = jnp.where((tp + tn == 0) & (fp + fn != 0), -1.0, res)
+        return res
+    safe_denom = jnp.where(denom == 0, 1.0, denom)
+    return jnp.where(denom == 0, 0.0, numerator / jnp.sqrt(safe_denom))
+
+
+def binary_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Binary MCC (reference ``matthews_corrcoef.py:84``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multiclass MCC (reference ``matthews_corrcoef.py:148``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_confusion_matrix_format(preds, target)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes, ignore_index)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multilabel MCC (reference ``matthews_corrcoef.py:215``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize=None)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, num_labels)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching MCC (reference ``matthews_corrcoef.py:287``)."""
+    task_enum = ClassificationTask.from_str(task)
+    if task_enum == ClassificationTask.BINARY:
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task_enum == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if task_enum == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

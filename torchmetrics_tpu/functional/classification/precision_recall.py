@@ -1,0 +1,85 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Precision / Recall / NPV kernels (reference ``functional/classification/precision_recall.py``)."""
+from __future__ import annotations
+
+
+import jax
+
+from torchmetrics_tpu.functional.classification._family import (
+    make_binary,
+    make_multiclass,
+    make_multilabel,
+    make_task_dispatch,
+)
+from torchmetrics_tpu.utilities.compute import _adjust_weights_safe_divide, _dim_sum, _safe_divide
+
+Array = jax.Array
+
+
+def _precision_recall_reduce_impl(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0,
+) -> Array:
+    """Reduce stats into precision/recall (reference ``precision_recall.py:40-82``)."""
+    different_stat = fp if stat == "precision" else fn  # this is what differs between the two
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    if average == "micro":
+        tp = _dim_sum(tp, 0 if multidim_average == "global" else 1)
+        fn = _dim_sum(fn, 0 if multidim_average == "global" else 1)
+        fp = _dim_sum(fp, 0 if multidim_average == "global" else 1)
+        different_stat = fp if stat == "precision" else fn
+        return _safe_divide(tp, tp + different_stat, zero_division)
+    score = _safe_divide(tp, tp + different_stat, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _precision_reduce(tp, fp, tn, fn, average, multidim_average="global", multilabel=False, top_k=1, zero_division=0):
+    return _precision_recall_reduce_impl("precision", tp, fp, tn, fn, average, multidim_average, multilabel, top_k, zero_division)
+
+
+def _recall_reduce(tp, fp, tn, fn, average, multidim_average="global", multilabel=False, top_k=1, zero_division=0):
+    return _precision_recall_reduce_impl("recall", tp, fp, tn, fn, average, multidim_average, multilabel, top_k, zero_division)
+
+
+binary_precision = make_binary(_precision_reduce, "precision")
+multiclass_precision = make_multiclass(_precision_reduce, "precision")
+multilabel_precision = make_multilabel(_precision_reduce, "precision")
+precision = make_task_dispatch("precision", binary_precision, multiclass_precision, multilabel_precision)
+
+binary_recall = make_binary(_recall_reduce, "recall")
+multiclass_recall = make_multiclass(_recall_reduce, "recall")
+multilabel_recall = make_multilabel(_recall_reduce, "recall")
+recall = make_task_dispatch("recall", binary_recall, multiclass_recall, multilabel_recall)
+
+
+def _npv_reduce(tp, fp, tn, fn, average, multidim_average="global", multilabel=False, top_k=1, zero_division=0):
+    """Negative predictive value = tn / (tn + fn) (reference ``negative_predictive_value.py``)."""
+    if average == "binary":
+        return _safe_divide(tn, tn + fn, zero_division)
+    if average == "micro":
+        tn = _dim_sum(tn, 0 if multidim_average == "global" else 1)
+        fn = _dim_sum(fn, 0 if multidim_average == "global" else 1)
+        return _safe_divide(tn, tn + fn, zero_division)
+    score = _safe_divide(tn, tn + fn, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+binary_negative_predictive_value = make_binary(_npv_reduce, "negative_predictive_value")
+multiclass_negative_predictive_value = make_multiclass(_npv_reduce, "negative_predictive_value")
+multilabel_negative_predictive_value = make_multilabel(_npv_reduce, "negative_predictive_value")
+negative_predictive_value = make_task_dispatch(
+    "negative_predictive_value",
+    binary_negative_predictive_value,
+    multiclass_negative_predictive_value,
+    multilabel_negative_predictive_value,
+)

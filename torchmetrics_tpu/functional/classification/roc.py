@@ -1,0 +1,231 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""ROC curve kernels (reference ``functional/classification/roc.py``)."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve_host,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fpr/tpr/thresholds from binned state or raw stream (reference ``roc.py:40-79``)."""
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0)
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0)
+        return fpr, tpr, jnp.flip(thresholds, 0)
+    preds, target = np.asarray(state[0]), np.asarray(state[1])
+    keep = target >= 0
+    preds, target = preds[keep], target[keep]
+    fps, tps, thres = _binary_clf_curve_host(preds, target, pos_label=pos_label)
+    # prepend origin so the curve starts at (0, 0)
+    tps = np.concatenate([[0], tps])
+    fps = np.concatenate([[0], fps])
+    thres = np.concatenate([[1.0], thres])
+    if fps[-1] <= 0:
+        rank_zero_warn(
+            "No negative samples in targets, false positive value should be meaningless."
+            " Returning zero tensor in false positive score",
+            UserWarning,
+        )
+        fpr = np.zeros_like(thres)
+    else:
+        fpr = fps / fps[-1]
+    if tps[-1] <= 0:
+        rank_zero_warn(
+            "No positive samples in targets, true positive value should be meaningless."
+            " Returning zero tensor in true positive score",
+            UserWarning,
+        )
+        tpr = np.zeros_like(thres)
+    else:
+        tpr = tps / tps[-1]
+    return jnp.asarray(fpr, jnp.float32), jnp.asarray(tpr, jnp.float32), jnp.asarray(thres, jnp.float32)
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Binary ROC curve (reference ``roc.py:82-168``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Per-class ROC curves + micro/macro merging (reference ``roc.py:166-204``)."""
+    if average == "micro":
+        return _binary_roc_compute(state, thresholds, pos_label=1)
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        thres = jnp.flip(thresholds, 0)
+        fpr_list = [fpr[i] for i in range(num_classes)]
+        tpr_list = [tpr[i] for i in range(num_classes)]
+        thres_list = [thres] * num_classes
+        tensor_state = True
+    else:
+        preds, target = np.asarray(state[0]), np.asarray(state[1])
+        keep = target >= 0
+        preds, target = preds[keep], target[keep]
+        fpr_list, tpr_list, thres_list = [], [], []
+        for i in range(num_classes):
+            res = _binary_roc_compute((jnp.asarray(preds[:, i]), jnp.asarray(target)), thresholds=None, pos_label=i)
+            fpr_list.append(res[0])
+            tpr_list.append(res[1])
+            thres_list.append(res[2])
+        tensor_state = False
+    if average == "macro":
+        # merge per-class curves onto the union fpr axis (reference ``:189-200``)
+        thres = jnp.sort(jnp.concatenate(thres_list))[::-1]
+        mean_fpr = jnp.sort(jnp.concatenate(fpr_list))
+        mean_tpr = jnp.zeros_like(mean_fpr)
+        for i in range(num_classes):
+            mean_tpr = mean_tpr + jnp.interp(mean_fpr, fpr_list[i], tpr_list[i])
+        mean_tpr = mean_tpr / num_classes
+        return mean_fpr, mean_tpr, thres
+    if tensor_state:
+        return jnp.stack(fpr_list), jnp.stack(tpr_list), thres_list[0]
+    return fpr_list, tpr_list, thres_list
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Multiclass ROC curves (reference ``roc.py:204-310``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, average)
+    return _multiclass_roc_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Per-label ROC curves (reference ``roc.py:313-343``)."""
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = jnp.flip(_safe_divide(tps, tps + fns), 0).T
+        fpr = jnp.flip(_safe_divide(fps, fps + tns), 0).T
+        return fpr, tpr, jnp.flip(thresholds, 0)
+    preds, target = np.asarray(state[0]), np.asarray(state[1])
+    fpr_list, tpr_list, thres_list = [], [], []
+    for i in range(num_labels):
+        p, t = preds[:, i], target[:, i]
+        keep = t >= 0
+        res = _binary_roc_compute((jnp.asarray(p[keep]), jnp.asarray(t[keep])), thresholds=None)
+        fpr_list.append(res[0])
+        tpr_list.append(res[1])
+        thres_list.append(res[2])
+    return fpr_list, tpr_list, thres_list
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Multilabel ROC curves (reference ``roc.py:346-437``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching ROC (reference ``roc.py:440-502``)."""
+    task_enum = ClassificationTask.from_str(task)
+    if task_enum == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task_enum == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_roc(preds, target, num_classes, thresholds, average, ignore_index, validate_args)
+    if task_enum == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

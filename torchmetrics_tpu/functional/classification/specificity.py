@@ -1,0 +1,35 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Specificity kernels (reference ``functional/classification/specificity.py``)."""
+from __future__ import annotations
+
+
+import jax
+
+from torchmetrics_tpu.functional.classification._family import (
+    make_binary,
+    make_multiclass,
+    make_multilabel,
+    make_task_dispatch,
+)
+from torchmetrics_tpu.utilities.compute import _adjust_weights_safe_divide, _dim_sum, _safe_divide
+
+Array = jax.Array
+
+
+def _specificity_reduce(tp, fp, tn, fn, average, multidim_average="global", multilabel=False, top_k=1, zero_division=0):
+    """tn / (tn + fp) (reference ``specificity.py:37``)."""
+    if average == "binary":
+        return _safe_divide(tn, tn + fp, zero_division)
+    if average == "micro":
+        tn = _dim_sum(tn, 0 if multidim_average == "global" else 1)
+        fp = _dim_sum(fp, 0 if multidim_average == "global" else 1)
+        return _safe_divide(tn, tn + fp, zero_division)
+    specificity_score = _safe_divide(tn, tn + fp, zero_division)
+    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn, top_k)
+
+
+binary_specificity = make_binary(_specificity_reduce, "specificity")
+multiclass_specificity = make_multiclass(_specificity_reduce, "specificity")
+multilabel_specificity = make_multilabel(_specificity_reduce, "specificity")
+specificity = make_task_dispatch("specificity", binary_specificity, multiclass_specificity, multilabel_specificity)

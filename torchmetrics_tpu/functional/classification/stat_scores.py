@@ -220,6 +220,17 @@ def _multiclass_stat_scores_tensor_validation(
                     f"Detected more unique values in `{name}` than expected. Expected only {check_value} but found"
                     f" {len(unique_values)} in `{name}`. Found values: {unique_values}."
                 )
+            # stricter than the reference: also catch out-of-range values, which
+            # would otherwise be silently clipped into the confusion matrix
+            in_range = (t >= 0) & (t < num_classes)
+            if ignore_index is not None:
+                in_range = in_range | (t == ignore_index)
+            if not bool(jnp.all(in_range)):
+                raise RuntimeError(
+                    f"Detected values in `{name}` outside the expected range [0, {num_classes - 1}]"
+                    + (f" (or ignore_index={ignore_index})" if ignore_index is not None else "")
+                    + f". Found values: {unique_values}."
+                )
 
 
 def _multiclass_stat_scores_format(

@@ -15,6 +15,12 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
+def _dim_sum(x: Array, axis: int) -> Array:
+    """``x.sum(axis)`` that is a no-op on 0-d arrays (torch-compatible
+    semantics: torch allows ``sum(dim=0)`` on scalars, jnp does not)."""
+    return x.sum(axis=axis) if jnp.ndim(x) > 0 else x
+
+
 def _safe_matmul(x: Array, y: Array) -> Array:
     """Matmul that promotes half precision inputs (reference ``compute.py:20``)."""
     if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
@@ -47,7 +53,11 @@ def _adjust_weights_safe_divide(
     else:
         weights = jnp.ones_like(score)
         if not multilabel:
-            weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
+            # with top_k > 1 a class can collect fp without ever appearing in
+            # target; only absent classes (tp+fn==0) are dropped then
+            # (reference ``compute.py:70-75``)
+            mask = (tp + fn == 0) if top_k != 1 else (tp + fp + fn == 0)
+            weights = jnp.where(mask, 0.0, weights)
         weights = jnp.where(jnp.isnan(score), 0.0, weights)
     score = jnp.where(jnp.isnan(score), 0.0, score)
     return _safe_divide(weights * score, weights.sum(-1, keepdims=True)).sum(-1)
