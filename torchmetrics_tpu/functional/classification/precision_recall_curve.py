@@ -126,21 +126,68 @@ def _binary_precision_recall_curve_format(
     return preds, target, _adjust_threshold_arg(thresholds)
 
 
+def _binned_curve_state(preds: Array, target_bin: Array, valid: Array, thresholds: Array) -> Array:
+    """Shared binned-confusion kernel: ``(T, ..., 2, 2)`` from flat probs.
+
+    The reference materializes the ``(N, ..., T)`` broadcast-compare tensor and
+    scatter-adds it into bins (reference ``:211-227``) — O(N·T) HBM traffic
+    plus a scatter, which TPUs execute serially (~10M updates/s). TPU-first
+    reformulation: the per-threshold counts are a *contraction over samples*,
+
+        ge[t, c, y] = Σ_n  1[p_nc ≥ thr_t] · 1[y_nc == y] · valid_nc
+
+    i.e. a batched matmul ``einsum('nct,ncy->tcy')`` between the bf16
+    threshold-compare tensor and the bf16 target masks — MXU work. Samples are
+    processed in VMEM-sized chunks under ``lax.scan`` so the compare tensor
+    never hits HBM at full size. Counts accumulate exactly (0/1 products,
+    f32 accumulator, chunks < 2^24).
+
+    ``preds``: (N, ...) probs; ``target_bin``: (N, ...) in {0,1};
+    ``valid``: (N, ...) bool. Returns (T, ..., 2, 2) int32 where
+    ``[t, ..., y, p]`` counts (target==y, (pred>=thr_t)==p).
+    """
+    len_t = thresholds.shape[0]
+    inner = preds.shape[1:]  # e.g. (C,) for multiclass/multilabel, () for binary
+    n_inner = int(np.prod(inner)) if inner else 1
+    n = preds.shape[0] if n_inner == 1 else preds.reshape(-1, n_inner).shape[0]
+    p = preds.reshape(n, n_inner)
+    y = jnp.clip(target_bin, 0, 1).reshape(n, n_inner)
+    v = valid.reshape(n, n_inner)
+    masks_i = jnp.stack([(1 - y) * v, y * v], axis=-1)  # (N, C, 2) int
+    total = masks_i.sum(0).astype(jnp.int32)  # (C, 2) per-class target counts
+
+    # chunk so the (chunk, C, T) compare tensor stays ~32MB bf16 (no floor:
+    # for very large C*T a small chunk is exactly what keeps it in VMEM)
+    chunk = max(1, min(n, (1 << 24) // max(1, n_inner * len_t)))
+    pad = (-n) % chunk
+    if pad:
+        p = jnp.pad(p, ((0, pad), (0, 0)))
+        masks_i = jnp.pad(masks_i, ((0, pad), (0, 0), (0, 0)))
+    nchunks = p.shape[0] // chunk
+    p3 = p.reshape(nchunks, chunk, n_inner)
+    m3 = masks_i.reshape(nchunks, chunk, n_inner, 2).astype(jnp.bfloat16)
+
+    def body(acc: Array, xs: Tuple[Array, Array]) -> Tuple[Array, None]:
+        pc, mc = xs
+        ge_c = (pc[:, :, None] >= thresholds[None, None, :]).astype(jnp.bfloat16)  # (chunk, C, T)
+        h = jnp.einsum("nct,ncy->tcy", ge_c, mc, preferred_element_type=jnp.float32)
+        return acc + h.astype(jnp.int32), None
+
+    init = jnp.zeros((len_t, n_inner, 2), jnp.int32)
+    ge, _ = jax.lax.scan(body, init, (p3, m3))  # counts with pred >= thr_t
+    state = jnp.stack([total[None] - ge, ge], axis=-1)  # [t, inner, target, pred]
+    return state.reshape((len_t,) + inner + (2, 2)) if inner else state.reshape(len_t, 2, 2)
+
+
 def _binary_precision_recall_curve_update(
     preds: Array,
     target: Array,
     thresholds: Optional[Array],
 ) -> Union[Array, Tuple[Array, Array]]:
-    """Binned: one broadcast-compare + bincount -> (T,2,2) (reference ``:191-226``)."""
+    """Binned: bucketize + histogram + suffix-sum -> (T,2,2) (reference ``:191-226``)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
-    preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.int32)  # (N, T)
-    valid = target >= 0
-    unique_mapping = preds_t + 2 * jnp.clip(target, 0, 1)[:, None] + 4 * jnp.arange(len_t)[None, :]
-    unique_mapping = jnp.where(valid[:, None], unique_mapping, 4 * len_t)
-    bins = _bincount(unique_mapping.reshape(-1), minlength=4 * len_t + 1)[: 4 * len_t]
-    return bins.reshape(len_t, 2, 2)
+    return _binned_curve_state(preds, target, target >= 0, thresholds)
 
 
 def _binary_precision_recall_curve_compute(
@@ -277,15 +324,9 @@ def _multiclass_precision_recall_curve_update(
         return preds, target
     if average == "micro":
         return _binary_precision_recall_curve_update(preds, target, thresholds)
-    len_t = thresholds.shape[0]
     valid = target >= 0
-    # (N, C, T) compare
-    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)
     target_t = jax.nn.one_hot(jnp.clip(target, 0, num_classes - 1), num_classes, dtype=jnp.int32)
-    unique_mapping = preds_t + 2 * target_t[:, :, None] + 4 * jnp.arange(num_classes)[None, :, None] + 4 * num_classes * jnp.arange(len_t)[None, None, :]
-    unique_mapping = jnp.where(valid[:, None, None], unique_mapping, 4 * num_classes * len_t)
-    bins = _bincount(unique_mapping.reshape(-1), minlength=4 * num_classes * len_t + 1)[: 4 * num_classes * len_t]
-    return bins.reshape(len_t, num_classes, 2, 2)
+    return _binned_curve_state(preds, target_t, jnp.broadcast_to(valid[:, None], preds.shape), thresholds)
 
 
 def _multiclass_precision_recall_curve_compute(
@@ -398,13 +439,7 @@ def _multilabel_precision_recall_curve_update(
     """Binned: (T, L, 2, 2) confusion tensor (reference ``:778-800``)."""
     if thresholds is None:
         return preds, target
-    len_t = thresholds.shape[0]
-    valid = target >= 0
-    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.int32)
-    unique_mapping = preds_t + 2 * jnp.clip(target, 0, 1)[:, :, None] + 4 * jnp.arange(num_labels)[None, :, None] + 4 * num_labels * jnp.arange(len_t)[None, None, :]
-    unique_mapping = jnp.where(valid[:, :, None], unique_mapping, 4 * num_labels * len_t)
-    bins = _bincount(unique_mapping.reshape(-1), minlength=4 * num_labels * len_t + 1)[: 4 * num_labels * len_t]
-    return bins.reshape(len_t, num_labels, 2, 2)
+    return _binned_curve_state(preds, target, target >= 0, thresholds)
 
 
 def _multilabel_precision_recall_curve_compute(

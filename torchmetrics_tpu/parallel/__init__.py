@@ -1,0 +1,42 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""TPU-native distribution: sharded metric updates over a ``jax.sharding.Mesh``.
+
+This subsystem replaces the reference's process-group model (NCCL/Gloo
+``gather_all_tensors``, reference ``src/torchmetrics/utilities/distributed.py:97-147``
++ ``Metric._sync_dist``, ``metric.py:435-474``) with JAX's in-step sharding:
+
+- :func:`sharded_update` runs a metric's ``update`` **inside** ``shard_map``
+  over a device mesh: each device folds its local shard of the batch into a
+  per-device partial state, then the states are merged with XLA collectives
+  (``psum``/``pmax``/``pmin``/``all_gather``) over ICI — keyed by each state's
+  declared ``dist_reduce_fx``, exactly like the reference's reduction map but
+  without any host round-trip.
+- :func:`metric_merge` / :func:`tree_merge` are the pure pairwise-merge
+  functions (the generalization of the reference ``_reduce_states``,
+  ``metric.py:401-433``) — usable directly inside user ``pjit`` eval steps.
+- :class:`ShardedMetric` wraps any :class:`~torchmetrics_tpu.Metric` so its
+  ``update`` transparently executes sharded over a mesh axis.
+
+Multi-host (DCN) sync of replicated states stays in
+``torchmetrics_tpu.utilities.distributed`` — the two regimes compose.
+"""
+from torchmetrics_tpu.parallel.sharded import (
+    ShardedMetric,
+    make_jit_update,
+    make_sharded_update,
+    metric_merge,
+    mesh_reduce_tree,
+    sharded_update,
+    tree_merge,
+)
+
+__all__ = [
+    "ShardedMetric",
+    "make_jit_update",
+    "make_sharded_update",
+    "metric_merge",
+    "mesh_reduce_tree",
+    "sharded_update",
+    "tree_merge",
+]

@@ -1,0 +1,302 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Sharded metric execution over a device mesh.
+
+Design (SURVEY.md §7): a metric is four pure functions —
+``init() -> State``, ``update(State, batch) -> State``,
+``compute(State) -> value``, ``merge(State, State) -> State`` — and the OO
+:class:`~torchmetrics_tpu.Metric` is a shell over them. This module exploits
+that: the OO metric's traced ``update`` runs per-device under ``shard_map``
+on the local batch shard, and per-device partial states are merged with the
+XLA collective matching each state's declared reduction:
+
+==============  =======================================
+dist_reduce_fx  collective over the mesh axis
+==============  =======================================
+``"sum"``       ``jax.lax.psum``
+``"mean"``      ``jax.lax.pmean``
+``"max"``       ``jax.lax.pmax``
+``"min"``       ``jax.lax.pmin``
+``"cat"``       ``jax.lax.all_gather`` + flatten
+``None``        ``jax.lax.all_gather`` (stacked raw)
+custom fn       ``all_gather`` + fn on the stacked axis
+==============  =======================================
+
+This is the TPU-native analogue of the reference's gather-then-reduce protocol
+(``metric.py:459-474``): same semantics, but fused into the compiled step and
+riding ICI instead of NCCL.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+# compiled sharded-update steps keyed by (id(metric), id(mesh), axis); weakrefs
+# validate against id reuse after gc
+_SHARDED_FN_CACHE: Dict[Tuple, Tuple] = {}
+
+
+# ------------------------------------------------------------------ pure merge
+
+
+def metric_merge(reduction: Optional[str | Callable], a: Any, b: Any) -> Any:
+    """Pairwise-merge two state values under a declared reduction.
+
+    The pure generalization of reference ``Metric._reduce_states``
+    (``metric.py:401-433``); jit-safe for array states.
+    """
+    if reduction == "sum":
+        return a + b
+    if reduction == "mean":
+        # matches the reference gather-then-``dim_zero_mean`` semantics
+        # (metric.py:459-474): the merged value is the mean of the parts
+        return (a + b) / 2
+    if reduction == "max":
+        return jnp.maximum(a, b)
+    if reduction == "min":
+        return jnp.minimum(a, b)
+    if reduction == "cat":
+        if isinstance(a, list):
+            return list(a) + list(b)
+        return jnp.concatenate([jnp.atleast_1d(a), jnp.atleast_1d(b)])
+    if reduction is None:
+        return jnp.stack([a, b])
+    if callable(reduction):
+        return reduction(jnp.stack([a, b]))
+    raise ValueError(f"Unknown reduction {reduction!r}")
+
+
+def tree_merge(reductions: Dict[str, Any], state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge two state pytrees keyed by per-state reductions."""
+    return {k: metric_merge(reductions[k], state_a[k], state_b[k]) for k in state_a}
+
+
+def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+    """Reduce a per-device partial-state pytree across a mesh axis.
+
+    Must be called inside ``shard_map``/``pmap`` with ``axis_name`` bound.
+    """
+    out: Dict[str, Any] = {}
+    for key, value in state.items():
+        reduction = reductions[key]
+        if reduction == "sum":
+            out[key] = jax.lax.psum(value, axis_name)
+        elif reduction == "mean":
+            out[key] = jax.lax.pmean(value, axis_name)
+        elif reduction == "max":
+            out[key] = jax.lax.pmax(value, axis_name)
+        elif reduction == "min":
+            out[key] = jax.lax.pmin(value, axis_name)
+        elif reduction == "cat":
+            if isinstance(value, list):
+                out[key] = [
+                    jax.lax.all_gather(v, axis_name).reshape((-1,) + tuple(v.shape[1:])) for v in value
+                ]
+            else:
+                out[key] = jax.lax.all_gather(value, axis_name).reshape((-1,) + tuple(value.shape[1:]))
+        elif reduction is None:
+            out[key] = jax.lax.all_gather(value, axis_name)
+        elif callable(reduction):
+            out[key] = reduction(jax.lax.all_gather(value, axis_name))
+        else:
+            raise ValueError(f"Unknown reduction {reduction!r} for state {key!r}")
+    return out
+
+
+# --------------------------------------------------------------- jitted update
+
+
+def make_jit_update(metric: "Any") -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
+    """Build ``(step, init_state)`` where ``step(state, *batch) -> state`` is jitted.
+
+    The entire update — validation-free kernel plus merge into the running
+    state — compiles to one XLA program, so a metric-evaluation loop runs at
+    device speed with no per-op dispatch. Array states only (``cat``/list
+    states are inherently dynamic; use binned variants).
+
+    Fold the final state back with ``metric.load_state_tree(state)`` followed
+    by ``metric._update_count += n`` (or just call ``compute`` on a clone).
+    """
+    reductions = dict(metric._reductions)
+    list_state_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
+    if list_state_keys:
+        raise ValueError(
+            f"Metric {type(metric).__name__} has list ('cat') states {list_state_keys};"
+            " jitted accumulation requires fixed-shape array states."
+        )
+    init_state = {k: jnp.asarray(v) for k, v in metric._defaults.items()}
+
+    def step(state: Dict[str, Any], *batch: Any) -> Dict[str, Any]:
+        fresh = _batch_update_state(metric, batch, {})
+        return tree_merge(reductions, state, fresh)
+
+    return jax.jit(step), init_state
+
+
+# ------------------------------------------------------------- sharded update
+
+
+def _batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Run ``metric.update`` on a fresh state and return the resulting pytree.
+
+    Pure w.r.t. traced inputs: the metric object is reset/restored around the
+    traced update so no tracer leaks into the host-side object.
+    """
+    saved = metric._copy_state_dict()
+    saved_count = metric._update_count
+    saved_computed = metric._computed
+    try:
+        metric.reset()
+        metric.update(*args, **kwargs)
+        return metric.state_tree()
+    finally:
+        metric.load_state_tree(saved)
+        metric._update_count = saved_count
+        metric._computed = saved_computed
+
+
+def make_sharded_update(
+    metric: "Any",
+    mesh: Mesh,
+    axis_name: str = "data",
+    in_specs: Optional[Any] = None,
+) -> Callable[..., Dict[str, Any]]:
+    """Build a jitted function ``(batch...) -> merged state pytree``.
+
+    The returned function shards its array arguments along ``axis_name`` over
+    ``mesh``, runs the metric's ``update`` per device on the local shard, and
+    reduces the per-device partial states with the collectives of
+    :func:`mesh_reduce_tree`. The result is a fully-replicated state pytree
+    ready to be merged into the host-side metric with
+    :meth:`Metric.load_state_tree` / :func:`tree_merge`.
+    """
+    reductions = dict(metric._reductions)
+    list_state_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
+    if list_state_keys:
+        raise ValueError(
+            f"Metric {type(metric).__name__} has list ('cat') states {list_state_keys}; sharded in-step"
+            " execution requires fixed-shape array states. Use binned/static-capacity variants, or"
+            " per-shard host accumulation."
+        )
+
+    def per_device(*args: Any, **kwargs: Any) -> Dict[str, Any]:
+        partial_state = _batch_update_state(metric, args, kwargs)
+        return mesh_reduce_tree(reductions, partial_state, axis_name)
+
+    def build_specs(args: Sequence[Any]) -> Tuple:
+        # batch args shard along axis_name; scalars/0-d args are replicated
+        return tuple(P(axis_name) if getattr(jnp.asarray(a), "ndim", 0) >= 1 else P() for a in args)
+
+    fn_cache: Dict[Tuple, Callable] = {}
+
+    def sharded(*args: Any) -> Dict[str, Any]:
+        specs = in_specs if in_specs is not None else build_specs(args)
+        key = tuple(specs)
+        if key not in fn_cache:
+            fn_cache[key] = jax.jit(
+                shard_map(
+                    per_device,
+                    mesh=mesh,
+                    in_specs=specs,
+                    out_specs=P(),  # merged state is replicated
+                    check_rep=False,
+                )
+            )
+        return fn_cache[key](*args)
+
+    return sharded
+
+
+def sharded_update(
+    metric: "Any",
+    mesh: Mesh,
+    *args: Any,
+    axis_name: str = "data",
+) -> None:
+    """Execute one sharded update step and fold the result into ``metric``.
+
+    The user-facing one-liner::
+
+        mesh = jax.make_mesh((8,), ("data",))
+        sharded_update(acc, mesh, preds, target)   # preds/target sharded 8-way
+
+    Equivalent to ``metric.update`` on the full batch, but each device only
+    touches its shard — the reference's DDP regime without processes. The
+    compiled step is cached on the metric per (mesh, axis), so repeated calls
+    dispatch the same XLA program.
+    """
+    key = (id(metric), id(mesh), axis_name)
+    entry = _SHARDED_FN_CACHE.get(key)
+    if entry is None or entry[0]() is not metric or entry[1]() is not mesh:
+        ref_m, ref_mesh = weakref.ref(metric), weakref.ref(mesh)
+        entry = (ref_m, ref_mesh, make_sharded_update(metric, mesh, axis_name=axis_name))
+        _SHARDED_FN_CACHE[key] = entry
+    update_fn = entry[2]
+    merged = update_fn(*args)
+    current = metric.state_tree()
+    defaults = metric._defaults
+    is_first = metric._update_count == 0
+    metric._computed = None
+    metric._update_count += 1
+    if is_first:
+        metric.load_state_tree(merged)
+    else:
+        metric.load_state_tree(tree_merge(metric._reductions, current, merged))
+
+
+class ShardedMetric:
+    """Wrap a metric so ``update``/``forward`` run sharded over a mesh axis.
+
+    Drop-in shell: all other attribute access proxies to the wrapped metric.
+    """
+
+    def __init__(self, metric: "Any", mesh: Mesh, axis_name: str = "data") -> None:
+        object.__setattr__(self, "_metric", metric)
+        object.__setattr__(self, "_mesh", mesh)
+        object.__setattr__(self, "_axis_name", axis_name)
+
+    def update(self, *args: Any) -> None:
+        sharded_update(self._metric, self._mesh, *args, axis_name=self._axis_name)
+
+    def forward(self, *args: Any) -> Any:
+        """Sharded accumulate + batch-local value (reference ``metric.py:283`` dual return)."""
+        prev_count = self._metric._update_count
+        self.update(*args)
+        if prev_count > 0:
+            # batch-local value needs a fresh state: run the (cached) sharded
+            # step once more on a reset metric, compute, then restore
+            saved = self._metric._copy_state_dict()
+            saved_count = self._metric._update_count
+            self._metric.reset()
+            sharded_update(self._metric, self._mesh, *args, axis_name=self._axis_name)
+            self._metric._to_sync = False
+            batch_val = self._metric.compute()
+            self._metric._to_sync = self._metric.sync_on_compute
+            self._metric.load_state_tree(saved)
+            self._metric._update_count = saved_count
+            self._metric._computed = None
+            return batch_val
+        self._metric._to_sync = False
+        val = self._metric.compute()
+        self._metric._to_sync = self._metric.sync_on_compute
+        self._metric._computed = None
+        return val
+
+    def __call__(self, *args: Any) -> Any:
+        return self.forward(*args)
+
+    def compute(self) -> Any:
+        return self._metric.compute()
+
+    def reset(self) -> None:
+        self._metric.reset()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_metric"), name)
