@@ -17,6 +17,26 @@ from torchmetrics_tpu.aggregation import (
     RunningSum,
     SumMetric,
 )
+from torchmetrics_tpu.classification import (
+    AUROC,
+    ROC,
+    Accuracy,
+    AveragePrecision,
+    CohenKappa,
+    ConfusionMatrix,
+    ExactMatch,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    NegativePredictiveValue,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 
@@ -32,4 +52,22 @@ __all__ = [
     "MetricCollection",
     "CompositionalMetric",
     "Metric",
+    "AUROC",
+    "ROC",
+    "Accuracy",
+    "AveragePrecision",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "ExactMatch",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "JaccardIndex",
+    "MatthewsCorrCoef",
+    "NegativePredictiveValue",
+    "Precision",
+    "PrecisionRecallCurve",
+    "Recall",
+    "Specificity",
+    "StatScores",
 ]

@@ -1,0 +1,102 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Factory for stat-scores-derived MODULE metric families.
+
+The reference re-spells ~500 LoC of boilerplate per family
+(``classification/accuracy.py``, ``precision_recall.py``, ``specificity.py``,
+``hamming.py``, ``f_beta.py``, ...). Here one factory subclasses the three
+StatScores state machines and swaps in the family's reduce function — same
+user-facing classes and behavior, one implementation of the plumbing.
+
+A reduce adapter has signature
+``reduce(tp, fp, tn, fn, average, multidim_average, multilabel, top_k, zero_division) -> Array``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Type
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+
+def make_stat_scores_family(
+    name: str,
+    reduce: Callable,
+    higher_is_better: bool = True,
+    plot_lower_bound: float = 0.0,
+    plot_upper_bound: float = 1.0,
+    reference: str = "",
+) -> tuple:
+    """Build ``(Binary<Name>, Multiclass<Name>, Multilabel<Name>, <Name>)`` module classes."""
+
+    class _Binary(BinaryStatScores):
+        def compute(self):
+            tp, fp, tn, fn = self._final_state()
+            return reduce(
+                tp, fp, tn, fn, "binary", self.multidim_average, False, 1, self.zero_division
+            )
+
+    class _Multiclass(MulticlassStatScores):
+        def compute(self):
+            tp, fp, tn, fn = self._final_state()
+            return reduce(
+                tp, fp, tn, fn, self.average, self.multidim_average, False, self.top_k, self.zero_division
+            )
+
+    class _Multilabel(MultilabelStatScores):
+        def compute(self):
+            tp, fp, tn, fn = self._final_state()
+            return reduce(
+                tp, fp, tn, fn, self.average, self.multidim_average, True, 1, self.zero_division
+            )
+
+    class _Wrapper(_ClassificationTaskWrapper):
+        def __new__(  # type: ignore[misc]
+            cls,
+            task: str,
+            threshold: float = 0.5,
+            num_classes: Optional[int] = None,
+            num_labels: Optional[int] = None,
+            average: Optional[str] = "micro",
+            multidim_average: str = "global",
+            top_k: Optional[int] = 1,
+            ignore_index: Optional[int] = None,
+            validate_args: bool = True,
+            **kwargs: Any,
+        ) -> Metric:
+            task = ClassificationTask.from_str(task)
+            kwargs.update(
+                {"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args}
+            )
+            if task == ClassificationTask.BINARY:
+                return _Binary(threshold, **kwargs)
+            if task == ClassificationTask.MULTICLASS:
+                if not isinstance(num_classes, int):
+                    raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+                if not isinstance(top_k, int):
+                    raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+                return _Multiclass(num_classes, top_k, average, **kwargs)
+            if task == ClassificationTask.MULTILABEL:
+                if not isinstance(num_labels, int):
+                    raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+                return _Multilabel(num_labels, threshold, average, **kwargs)
+            raise ValueError(f"Not handled value: {task}")
+
+    doc = f"Module metric (reference ``{reference}``)."
+    for klass, prefix in ((_Binary, "Binary"), (_Multiclass, "Multiclass"), (_Multilabel, "Multilabel")):
+        klass.__name__ = f"{prefix}{name}"
+        klass.__qualname__ = f"{prefix}{name}"
+        klass.__doc__ = doc
+        klass.higher_is_better = higher_is_better
+        klass.plot_lower_bound = plot_lower_bound
+        klass.plot_upper_bound = plot_upper_bound
+    _Wrapper.__name__ = name
+    _Wrapper.__qualname__ = name
+    _Wrapper.__doc__ = f"Task-dispatching {name} (reference ``{reference}``)."
+    return _Binary, _Multiclass, _Multilabel, _Wrapper

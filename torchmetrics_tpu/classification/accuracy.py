@@ -1,0 +1,18 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Accuracy module metrics (reference ``src/torchmetrics/classification/accuracy.py``)."""
+from __future__ import annotations
+
+from torchmetrics_tpu.classification._derived import make_stat_scores_family
+from torchmetrics_tpu.functional.classification.accuracy import _accuracy_reduce
+
+
+def _reduce(tp, fp, tn, fn, average, multidim_average, multilabel, top_k, zero_division):
+    return _accuracy_reduce(tp, fp, tn, fn, average, multidim_average, multilabel, top_k)
+
+
+BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy, Accuracy = make_stat_scores_family(
+    "Accuracy", _reduce, reference="classification/accuracy.py:29/:151/:319/:461"
+)
+
+__all__ = ["BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy", "Accuracy"]

@@ -1,0 +1,17 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Hamming distance module metrics (reference ``src/torchmetrics/classification/hamming.py``)."""
+from __future__ import annotations
+
+from torchmetrics_tpu.functional.classification.hamming import _hamming_distance_reduce
+
+from torchmetrics_tpu.classification._derived import make_stat_scores_family
+
+BinaryHammingDistance, MulticlassHammingDistance, MultilabelHammingDistance, HammingDistance = make_stat_scores_family(
+    "HammingDistance",
+    _hamming_distance_reduce,
+    higher_is_better=False,
+    reference="classification/hamming.py:28/:160/:332/:464",
+)
+
+__all__ = ["BinaryHammingDistance", "MulticlassHammingDistance", "MultilabelHammingDistance", "HammingDistance"]

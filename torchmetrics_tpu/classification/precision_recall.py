@@ -1,0 +1,45 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Precision / Recall / NPV module metrics (reference
+``src/torchmetrics/classification/precision_recall.py`` and
+``negative_predictive_value.py``)."""
+from __future__ import annotations
+
+from torchmetrics_tpu.classification._derived import make_stat_scores_family
+from torchmetrics_tpu.functional.classification.precision_recall import (
+    _npv_reduce,
+    _precision_reduce,
+    _recall_reduce,
+)
+
+BinaryPrecision, MulticlassPrecision, MultilabelPrecision, Precision = make_stat_scores_family(
+    "Precision", _precision_reduce, reference="classification/precision_recall.py:33/:171/:344"
+)
+BinaryRecall, MulticlassRecall, MultilabelRecall, Recall = make_stat_scores_family(
+    "Recall", _recall_reduce, reference="classification/precision_recall.py:522/:660/:833"
+)
+(
+    BinaryNegativePredictiveValue,
+    MulticlassNegativePredictiveValue,
+    MultilabelNegativePredictiveValue,
+    NegativePredictiveValue,
+) = make_stat_scores_family(
+    "NegativePredictiveValue",
+    _npv_reduce,
+    reference="classification/negative_predictive_value.py:33",
+)
+
+__all__ = [
+    "BinaryPrecision",
+    "MulticlassPrecision",
+    "MultilabelPrecision",
+    "Precision",
+    "BinaryRecall",
+    "MulticlassRecall",
+    "MultilabelRecall",
+    "Recall",
+    "BinaryNegativePredictiveValue",
+    "MulticlassNegativePredictiveValue",
+    "MultilabelNegativePredictiveValue",
+    "NegativePredictiveValue",
+]

@@ -1,0 +1,255 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""PrecisionRecallCurve module metrics (reference
+``src/torchmetrics/classification/precision_recall_curve.py``).
+
+Two state modes (reference ``:40-130``):
+- **binned** (``thresholds`` given) — fixed-shape ``(T, ..., 2, 2)`` confusion
+  tensor with ``dist_reduce_fx="sum"``: the TPU-native default, jit/psum-ready.
+- **exact** (``thresholds=None``) — append-lists of raw preds/targets with
+  ``"cat"``; finalized with the host sort+cumsum path at compute.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class _AbstractCurveMetric(Metric):
+    """Shared state plumbing for the curve family."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def _create_curve_state(self, thresholds: Optional[Array], state_shape: Tuple[int, ...]) -> None:
+        if thresholds is None:
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", jnp.zeros(state_shape, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _update_curve_state(self, state: Union[Array, Tuple[Array, Array]]) -> None:
+        if isinstance(state, tuple):
+            self.preds.append(state[0])
+            self.target.append(state[1])
+        else:
+            self.confmat = self.confmat + state
+
+    def _curve_state(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.thresholds is None:
+            return dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        return self.confmat
+
+
+class BinaryPrecisionRecallCurve(_AbstractCurveMetric):
+    """Binary PR curve (reference ``precision_recall_curve.py:40``)."""
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        self._create_curve_state(thresholds, (len(thresholds), 2, 2) if thresholds is not None else ())
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+        preds, target, _ = _binary_precision_recall_curve_format(preds, target, self.thresholds, self.ignore_index)
+        state = _binary_precision_recall_curve_update(preds, target, self.thresholds)
+        self._update_curve_state(state)
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Compute the final curve."""
+        return _binary_precision_recall_curve_compute(self._curve_state(), self.thresholds)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Union[Array, bool]] = None, ax: Any = None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class MulticlassPrecisionRecallCurve(_AbstractCurveMetric):
+    """Multiclass PR curve (reference ``precision_recall_curve.py:175``)."""
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        shape = ()
+        if thresholds is not None:
+            shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
+        self._create_curve_state(thresholds, shape)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if self.validate_args:
+            _multiclass_precision_recall_curve_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds, target, _ = _multiclass_precision_recall_curve_format(
+            preds, target, self.num_classes, self.thresholds, self.ignore_index, self.average
+        )
+        state = _multiclass_precision_recall_curve_update(
+            preds, target, self.num_classes, self.thresholds, self.average
+        )
+        self._update_curve_state(state)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """Compute the final per-class curves."""
+        return _multiclass_precision_recall_curve_compute(
+            self._curve_state(), self.num_classes, self.thresholds, self.average
+        )
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Union[Array, bool]] = None, ax: Any = None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class MultilabelPrecisionRecallCurve(_AbstractCurveMetric):
+    """Multilabel PR curve (reference ``precision_recall_curve.py:319``)."""
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        thresholds = _adjust_threshold_arg(thresholds)
+        self.thresholds = thresholds
+        self._create_curve_state(
+            thresholds, (len(thresholds), num_labels, 2, 2) if thresholds is not None else ()
+        )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target, _ = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, self.thresholds, self.ignore_index
+        )
+        state = _multilabel_precision_recall_curve_update(preds, target, self.num_labels, self.thresholds)
+        self._update_curve_state(state)
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """Compute the final per-label curves."""
+        return _multilabel_precision_recall_curve_compute(
+            self._curve_state(), self.num_labels, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Union[Array, bool]] = None, ax: Any = None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    """Task-dispatching PrecisionRecallCurve (reference ``precision_recall_curve.py:448``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")
+
+
+__all__ = [
+    "BinaryPrecisionRecallCurve",
+    "MulticlassPrecisionRecallCurve",
+    "MultilabelPrecisionRecallCurve",
+    "PrecisionRecallCurve",
+]

@@ -1,0 +1,98 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""ROC module metrics (reference ``src/torchmetrics/classification/roc.py``).
+Inherit the PR-curve state machines; only ``compute`` differs."""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    """Binary ROC curve (reference ``roc.py:35``)."""
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Compute fpr/tpr/thresholds."""
+        return _binary_roc_compute(self._curve_state(), self.thresholds)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Union[Array, bool]] = None, ax: Any = None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    """Multiclass ROC curves (reference ``roc.py:152``)."""
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """Compute per-class fpr/tpr/thresholds."""
+        return _multiclass_roc_compute(self._curve_state(), self.num_classes, self.thresholds, self.average)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Union[Array, bool]] = None, ax: Any = None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    """Multilabel ROC curves (reference ``roc.py:310``)."""
+
+    def compute(self) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        """Compute per-label fpr/tpr/thresholds."""
+        return _multilabel_roc_compute(self._curve_state(), self.num_labels, self.thresholds, self.ignore_index)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Union[Array, bool]] = None, ax: Any = None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class ROC(_ClassificationTaskWrapper):
+    """Task-dispatching ROC (reference ``roc.py:446``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Optional[Union[int, List[float], Array]] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryROC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassROC(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelROC(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")
+
+
+__all__ = ["BinaryROC", "MulticlassROC", "MultilabelROC", "ROC"]

@@ -1,0 +1,13 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Specificity module metrics (reference ``src/torchmetrics/classification/specificity.py``)."""
+from __future__ import annotations
+
+from torchmetrics_tpu.classification._derived import make_stat_scores_family
+from torchmetrics_tpu.functional.classification.specificity import _specificity_reduce
+
+BinarySpecificity, MulticlassSpecificity, MultilabelSpecificity, Specificity = make_stat_scores_family(
+    "Specificity", _specificity_reduce, reference="classification/specificity.py:29/:146/:308/:445"
+)
+
+__all__ = ["BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity", "Specificity"]
