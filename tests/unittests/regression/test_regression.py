@@ -1,0 +1,288 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Regression suite vs sklearn/scipy oracles (reference tests:
+``tests/unittests/regression/test_*.py``).
+
+Each case checks (a) the functional kernel on a single batch, and (b) the
+module metric streamed over NUM_BATCHES batches — exercising the
+state-accumulation (sum / cat / streaming-moment) paths."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+from scipy import stats
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+
+
+def _stream(metric, preds, target):
+    for p, t in zip(preds, target):
+        metric.update(p, t)
+    return np.asarray(metric.compute())
+
+
+def _make(n_out=None, positive=False, seed=0):
+    rng = np.random.RandomState(seed)
+    shape = (NUM_BATCHES, BATCH_SIZE) if n_out is None else (NUM_BATCHES, BATCH_SIZE, n_out)
+    preds = rng.randn(*shape).astype(np.float32)
+    target = rng.randn(*shape).astype(np.float32)
+    if positive:
+        preds, target = np.abs(preds) + 0.1, np.abs(target) + 0.1
+    return preds, target
+
+
+FLAT = lambda x: x.reshape(-1, *x.shape[2:])
+
+
+@pytest.mark.parametrize("n_out", [None, 3])
+@pytest.mark.parametrize(
+    ("name", "fn_factory", "fn_functional", "oracle"),
+    [
+        (
+            "mse",
+            lambda n: tm.MeanSquaredError(num_outputs=n or 1),
+            lambda p, t, n: F.mean_squared_error(p, t, num_outputs=n or 1),
+            lambda p, t: skm.mean_squared_error(t, p, multioutput="raw_values" if p.ndim == 2 else "uniform_average"),
+        ),
+        (
+            "rmse",
+            lambda n: tm.MeanSquaredError(squared=False, num_outputs=n or 1),
+            lambda p, t, n: F.mean_squared_error(p, t, squared=False, num_outputs=n or 1),
+            lambda p, t: np.sqrt(
+                skm.mean_squared_error(t, p, multioutput="raw_values" if p.ndim == 2 else "uniform_average")
+            ),
+        ),
+        (
+            "mae",
+            lambda n: tm.MeanAbsoluteError(num_outputs=n or 1),
+            lambda p, t, n: F.mean_absolute_error(p, t, num_outputs=n or 1),
+            lambda p, t: skm.mean_absolute_error(t, p, multioutput="raw_values" if p.ndim == 2 else "uniform_average"),
+        ),
+    ],
+)
+def test_error_metrics(name, fn_factory, fn_functional, oracle, n_out):
+    preds, target = _make(n_out)
+    res_fn = np.asarray(fn_functional(preds[0], target[0], n_out))
+    np.testing.assert_allclose(res_fn, oracle(preds[0], target[0]), rtol=1e-4, atol=1e-5)
+    res_mod = _stream(fn_factory(n_out), preds, target)
+    np.testing.assert_allclose(res_mod, oracle(FLAT(preds), FLAT(target)), rtol=1e-4, atol=1e-5)
+
+
+def test_mape_smape_wmape_msle():
+    preds, target = _make(positive=True)
+    fp, ft = FLAT(preds), FLAT(target)
+    np.testing.assert_allclose(
+        _stream(tm.MeanAbsolutePercentageError(), preds, target), skm.mean_absolute_percentage_error(ft, fp), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        _stream(tm.SymmetricMeanAbsolutePercentageError(), preds, target),
+        np.mean(2 * np.abs(fp - ft) / (np.abs(fp) + np.abs(ft))),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        _stream(tm.WeightedMeanAbsolutePercentageError(), preds, target),
+        np.sum(np.abs(fp - ft)) / np.sum(np.abs(ft)),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        _stream(tm.MeanSquaredLogError(), preds, target), skm.mean_squared_log_error(ft, fp), rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+def test_r2_and_explained_variance(multioutput):
+    preds, target = _make(3, seed=3)
+    fp, ft = FLAT(preds), FLAT(target)
+    np.testing.assert_allclose(
+        _stream(tm.R2Score(num_outputs=3, multioutput=multioutput), preds, target),
+        skm.r2_score(ft, fp, multioutput=multioutput),
+        rtol=1e-3,
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        _stream(tm.ExplainedVariance(multioutput=multioutput), preds, target),
+        skm.explained_variance_score(ft, fp, multioutput=multioutput),
+        rtol=1e-3,
+        atol=1e-5,
+    )
+
+
+def test_r2_adjusted():
+    preds, target = _make(seed=4)
+    fp, ft = FLAT(preds), FLAT(target)
+    n, adj = fp.shape[0], 5
+    base = skm.r2_score(ft, fp)
+    expected = 1 - (1 - base) * (n - 1) / (n - adj - 1)
+    np.testing.assert_allclose(_stream(tm.R2Score(adjusted=adj), preds, target), expected, rtol=1e-4)
+
+
+@pytest.mark.parametrize("n_out", [None, 2])
+def test_pearson_streaming(n_out):
+    preds, target = _make(n_out, seed=5)
+    target = target + 0.5 * preds  # induce correlation
+    fp, ft = FLAT(preds), FLAT(target)
+    if n_out is None:
+        expected = stats.pearsonr(fp, ft)[0]
+    else:
+        expected = np.array([stats.pearsonr(fp[:, i], ft[:, i])[0] for i in range(n_out)])
+    res = _stream(tm.PearsonCorrCoef(num_outputs=n_out or 1), preds, target)
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_pearson_shard_merge():
+    """_final_aggregation merges per-shard statistics exactly (the DCN replica path)."""
+    from torchmetrics_tpu.functional.regression.pearson import _final_aggregation, _pearson_corrcoef_compute
+
+    preds, target = _make(seed=6)
+    shard_stats = []
+    for p, t in zip(preds, target):
+        m = tm.PearsonCorrCoef()
+        m.update(p, t)
+        shard_stats.append([m.mean_x, m.mean_y, m.var_x, m.var_y, m.corr_xy, m.n_total])
+    stacked = [np.stack([s[i] for s in shard_stats]) for i in range(6)]
+    _, _, var_x, var_y, corr_xy, nb = _final_aggregation(*[np.asarray(s) for s in stacked])
+    res = _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+    expected = stats.pearsonr(FLAT(preds), FLAT(target))[0]
+    np.testing.assert_allclose(np.asarray(res), expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("ties", [False, True])
+def test_spearman(ties):
+    rng = np.random.RandomState(7)
+    if ties:
+        preds = rng.randint(0, 10, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+        target = rng.randint(0, 10, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+    else:
+        preds, target = _make(seed=7)
+    res = _stream(tm.SpearmanCorrCoef(), preds, target)
+    expected = stats.spearmanr(FLAT(preds), FLAT(target))[0]
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("variant", ["b", "c"])
+@pytest.mark.parametrize("t_test", [False, True])
+def test_kendall(variant, t_test):
+    rng = np.random.RandomState(8)
+    preds = rng.randint(0, 8, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+    target = rng.randint(0, 8, (NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+    m = tm.KendallRankCorrCoef(variant=variant, t_test=t_test)
+    for p, t in zip(preds, target):
+        m.update(p, t)
+    res = m.compute()
+    sp = stats.kendalltau(FLAT(preds), FLAT(target), variant=variant)
+    if t_test:
+        tau, p_value = res
+        np.testing.assert_allclose(np.asarray(tau), sp.statistic, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(p_value), sp.pvalue, rtol=1e-2, atol=1e-3)
+    else:
+        np.testing.assert_allclose(np.asarray(res), sp.statistic, rtol=1e-4, atol=1e-5)
+
+
+def test_concordance():
+    preds, target = _make(seed=9)
+    target = target + 0.7 * preds
+    fp, ft = FLAT(preds), FLAT(target)
+    mean_p, mean_t = fp.mean(), ft.mean()
+    var_p, var_t = fp.var(ddof=1), ft.var(ddof=1)
+    pearson = stats.pearsonr(fp, ft)[0]
+    expected = 2 * pearson * np.sqrt(var_p) * np.sqrt(var_t) / (var_p + var_t + (mean_p - mean_t) ** 2)
+    res = _stream(tm.ConcordanceCorrCoef(), preds, target)
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_cosine_similarity(reduction):
+    preds, target = _make(4, seed=10)
+    fp, ft = FLAT(preds), FLAT(target)
+    per_row = np.array([np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b)) for a, b in zip(fp, ft)])
+    expected = {"sum": per_row.sum(), "mean": per_row.mean(), "none": per_row}[reduction]
+    res = _stream(tm.CosineSimilarity(reduction=reduction), preds, target)
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("log_prob", [False, True])
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_kl_divergence(log_prob, reduction):
+    rng = np.random.RandomState(11)
+    p = rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32) + 0.1
+    q = rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32) + 0.1
+    pn = p / p.sum(-1, keepdims=True)
+    qn = q / q.sum(-1, keepdims=True)
+    measures = np.sum(pn * np.log(pn / qn), -1).reshape(-1)
+    expected = {"mean": measures.mean(), "sum": measures.sum(), "none": measures}[reduction]
+    m = tm.KLDivergence(log_prob=log_prob, reduction=reduction)
+    inp_p, inp_q = (np.log(pn), np.log(qn)) if log_prob else (p, q)
+    res = _stream(m, inp_p, inp_q)
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_tweedie_and_misc():
+    preds, target = _make(positive=True, seed=12)
+    fp, ft = FLAT(preds), FLAT(target)
+    for power, oracle in [
+        (0.0, lambda t, p: np.mean((t - p) ** 2)),
+        (1.0, skm.mean_poisson_deviance),
+        (2.0, skm.mean_gamma_deviance),
+        (1.5, lambda t, p: skm.mean_tweedie_deviance(t, p, power=1.5)),
+    ]:
+        res = _stream(tm.TweedieDevianceScore(power=power), preds, target)
+        np.testing.assert_allclose(res, oracle(ft, fp), rtol=1e-3)
+
+    np.testing.assert_allclose(
+        _stream(tm.MinkowskiDistance(p=3), preds, target), np.sum(np.abs(fp - ft) ** 3) ** (1 / 3), rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        _stream(tm.LogCoshError(), preds, target), np.mean(np.log(np.cosh(fp - ft))), rtol=1e-4
+    )
+
+
+def test_csi():
+    preds, target = _make(seed=13)
+    fp, ft = np.abs(FLAT(preds)), np.abs(FLAT(target))
+    pb, tb = fp >= 0.5, ft >= 0.5
+    expected = (pb & tb).sum() / ((pb & tb).sum() + ((pb ^ tb) & tb).sum() + ((pb ^ tb) & pb).sum())
+    res = _stream(tm.CriticalSuccessIndex(threshold=0.5), np.abs(preds), np.abs(target))
+    np.testing.assert_allclose(res, expected, rtol=1e-5)
+
+
+def test_rse():
+    preds, target = _make(seed=14)
+    fp, ft = FLAT(preds), FLAT(target)
+    expected = np.sum((fp - ft) ** 2) / np.sum((ft - ft.mean()) ** 2)
+    np.testing.assert_allclose(_stream(tm.RelativeSquaredError(), preds, target), expected, rtol=1e-4)
+    np.testing.assert_allclose(
+        _stream(tm.RelativeSquaredError(squared=False), preds, target), np.sqrt(expected), rtol=1e-4
+    )
+
+
+def test_forward_and_reset():
+    """forward returns the batch value while accumulating the global one."""
+    preds, target = _make(seed=15)
+    m = tm.MeanSquaredError()
+    batch_val = m(preds[0], target[0])
+    np.testing.assert_allclose(np.asarray(batch_val), skm.mean_squared_error(target[0], preds[0]), rtol=1e-5)
+    for p, t in zip(preds[1:], target[1:]):
+        m(p, t)
+    np.testing.assert_allclose(
+        np.asarray(m.compute()), skm.mean_squared_error(FLAT(target), FLAT(preds)), rtol=1e-5
+    )
+    m.reset()
+    assert m._update_count == 0
+
+
+def test_pickle_and_metric_collection():
+    import pickle
+
+    preds, target = _make(seed=16)
+    m = tm.MetricCollection([tm.MeanSquaredError(), tm.MeanAbsoluteError(), tm.PearsonCorrCoef()])
+    for p, t in zip(preds, target):
+        m.update(p, t)
+    res = m.compute()
+    assert set(res) == {"MeanSquaredError", "MeanAbsoluteError", "PearsonCorrCoef"}
+    m2 = pickle.loads(pickle.dumps(m))
+    res2 = m2.compute()
+    for k in res:
+        np.testing.assert_allclose(np.asarray(res[k]), np.asarray(res2[k]), rtol=1e-6)

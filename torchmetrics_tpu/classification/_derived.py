@@ -13,6 +13,7 @@ A reduce adapter has signature
 """
 from __future__ import annotations
 
+import sys
 from typing import Any, Callable, Optional, Type
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
@@ -88,15 +89,20 @@ def make_stat_scores_family(
                 return _Multilabel(num_labels, threshold, average, **kwargs)
             raise ValueError(f"Not handled value: {task}")
 
+    # bind classes into the caller's module under their public names so
+    # pickling works (pickle looks classes up by __module__ + __qualname__)
+    caller_module = sys._getframe(1).f_globals.get("__name__", __name__)
     doc = f"Module metric (reference ``{reference}``)."
     for klass, prefix in ((_Binary, "Binary"), (_Multiclass, "Multiclass"), (_Multilabel, "Multilabel")):
         klass.__name__ = f"{prefix}{name}"
         klass.__qualname__ = f"{prefix}{name}"
+        klass.__module__ = caller_module
         klass.__doc__ = doc
         klass.higher_is_better = higher_is_better
         klass.plot_lower_bound = plot_lower_bound
         klass.plot_upper_bound = plot_upper_bound
     _Wrapper.__name__ = name
     _Wrapper.__qualname__ = name
+    _Wrapper.__module__ = caller_module
     _Wrapper.__doc__ = f"Task-dispatching {name} (reference ``{reference}``)."
     return _Binary, _Multiclass, _Multilabel, _Wrapper

@@ -1,0 +1,43 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Concordance correlation coefficient (reference
+``src/torchmetrics/functional/regression/concordance.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.pearson import (
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+
+Array = jax.Array
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    nb: Array,
+) -> Array:
+    """Finalize CCC from Pearson statistics (reference ``concordance.py:20``)."""
+    pearson = _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    return 2.0 * pearson * jnp.sqrt(var_x) * jnp.sqrt(var_y) / (var_x + var_y + (mean_x - mean_y) ** 2)
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute concordance correlation coefficient (reference ``concordance.py:35``)."""
+    preds, target = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    _temp = jnp.zeros(d, dtype=preds.dtype)
+    mean_x, mean_y, var_x = _temp, _temp.copy(), _temp.copy()
+    var_y, corr_xy, nb = _temp.copy(), _temp.copy(), _temp.copy()
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb, num_outputs=d
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

@@ -1,0 +1,46 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Cosine similarity (reference
+``src/torchmetrics/functional/regression/cosine_similarity.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate shapes, pass tensors through (reference ``cosine_similarity.py:22``)."""
+    _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError(f"Expected input to cosine similarity to be 2D tensors of shape `[N,D]` but got {preds.ndim}D")
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Row-wise cosine similarity with reduction (reference ``cosine_similarity.py:45``)."""
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    reduction_mapping = {
+        "sum": jnp.sum,
+        "mean": jnp.mean,
+        "none": lambda x: x,
+        None: lambda x: x,
+    }
+    if reduction not in reduction_mapping:
+        raise ValueError(f"Expected reduction to be one of {list(reduction_mapping)} but got {reduction}")
+    return reduction_mapping[reduction](similarity)
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Compute cosine similarity (reference ``cosine_similarity.py:75``)."""
+    preds, target = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,49 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Critical success index (reference
+``src/torchmetrics/functional/regression/csi.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Threshold-binarize and count hits/misses/false alarms (reference ``csi.py:23``)."""
+    _check_same_shape(preds, target)
+    if keep_sequence_dim is None:
+        sum_dims = None
+    elif not 0 <= keep_sequence_dim < preds.ndim:
+        raise ValueError(f"Expected keep_sequence dim to be in range [0, {preds.ndim}] but got {keep_sequence_dim}")
+    else:
+        sum_dims = tuple(i for i in range(preds.ndim) if i != keep_sequence_dim)
+
+    preds_bin = preds >= threshold
+    target_bin = target >= threshold
+    hits = jnp.sum(preds_bin & target_bin, axis=sum_dims).astype(jnp.int32)
+    misses = jnp.sum((preds_bin ^ target_bin) & target_bin, axis=sum_dims).astype(jnp.int32)
+    false_alarms = jnp.sum((preds_bin ^ target_bin) & preds_bin, axis=sum_dims).astype(jnp.int32)
+    return hits, misses, false_alarms
+
+
+def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Array) -> Array:
+    """Finalize CSI = hits / (hits + misses + false_alarms) (reference ``csi.py:61``)."""
+    return _safe_divide(hits, hits + misses + false_alarms)
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Array:
+    """Compute critical success index (reference ``csi.py:77``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _critical_success_index_compute(hits, misses, false_alarms)

@@ -1,0 +1,181 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Kendall rank correlation (reference
+``src/torchmetrics/functional/regression/kendall.py``).
+
+TPU-first re-design: the reference counts concordant/discordant pairs with a
+Python loop over rows (``kendall.py:61-86``, O(n) traced ops); here the whole
+pair census is one O(n²) sign-product matrix — a single fused XLA reduction,
+``vmap``-ed over output dims. Tie statistics come from sort + segment sums
+(no data-dependent shapes)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.enums import EnumStr
+
+Array = jax.Array
+
+
+class _MetricVariant(EnumStr):
+    """Variants of Kendall's tau (reference ``kendall.py:26``)."""
+
+    A = "a"
+    B = "b"
+    C = "c"
+
+    @staticmethod
+    def _name() -> str:
+        return "variant"
+
+
+class _TestAlternative(EnumStr):
+    """Alternative hypotheses for the significance test (reference ``kendall.py:38``)."""
+
+    TWO_SIDED = "two-sided"
+    LESS = "less"
+    GREATER = "greater"
+
+    @staticmethod
+    def _name() -> str:
+        return "alternative"
+
+
+_CENSUS_BLOCK = 1024
+
+
+def _pair_census(x: Array, y: Array) -> Tuple[Array, Array]:
+    """Count concordant/discordant pairs over all i<j via blocked sign-product
+    matrices (replaces reference ``kendall.py:61-86`` row loop).
+
+    Rows are processed in blocks of ``_CENSUS_BLOCK`` under ``lax.scan`` so
+    peak memory is O(block·n) instead of O(n²) while each block is still one
+    fused vectorized reduction."""
+    n = x.shape[0]
+    n_blocks = max(1, -(-n // _CENSUS_BLOCK))
+    pad = n_blocks * _CENSUS_BLOCK - n
+    # padded rows are masked out of the census via their out-of-range index
+    xp = jnp.pad(x, (0, pad))
+    yp = jnp.pad(y, (0, pad))
+    row_idx = jnp.arange(n_blocks * _CENSUS_BLOCK).reshape(n_blocks, _CENSUS_BLOCK)
+    col_idx = jnp.arange(n)
+
+    def block(carry, inp):
+        con, dis = carry
+        rows, xi, yi = inp
+        sx = jnp.sign(xi[:, None] - x[None, :])
+        sy = jnp.sign(yi[:, None] - y[None, :])
+        prod = sx * sy
+        valid = (col_idx[None, :] > rows[:, None]) & (rows[:, None] < n)
+        con = con + jnp.sum((prod > 0) & valid)
+        dis = dis + jnp.sum((prod < 0) & valid)
+        return (con, dis), None
+
+    (concordant, discordant), _ = jax.lax.scan(
+        block,
+        (jnp.asarray(0), jnp.asarray(0)),
+        (row_idx, xp.reshape(n_blocks, _CENSUS_BLOCK), yp.reshape(n_blocks, _CENSUS_BLOCK)),
+    )
+    return concordant, discordant
+
+
+def _tie_stats(x: Array) -> Tuple[Array, Array, Array, Array]:
+    """Per-sequence tie statistics via sorted segment counts
+    (reference ``kendall.py:98-111``): returns
+    ``(sum t(t-1)/2, sum t(t-1)(t-2), sum t(t-1)(2t+5), n_unique)``."""
+    n = x.shape[0]
+    xs = jnp.sort(x)
+    seg = jnp.cumsum(jnp.concatenate([jnp.zeros(1, dtype=jnp.int32), (xs[1:] != xs[:-1]).astype(jnp.int32)]))
+    t = jax.ops.segment_sum(jnp.ones(n, dtype=jnp.float32), seg, num_segments=n)
+    ties = jnp.sum(t * (t - 1) // 2)
+    ties_p1 = jnp.sum(t * (t - 1.0) * (t - 2))
+    ties_p2 = jnp.sum(t * (t - 1.0) * (2 * t + 5))
+    n_unique = seg[-1] + 1
+    return ties, ties_p1, ties_p2, n_unique
+
+
+def _normal_cdf(x: Array) -> Array:
+    return 0.5 * (1 + jax.scipy.special.erf(x / jnp.sqrt(2.0)))
+
+
+def _kendall_tau_1d(
+    preds: Array, target: Array, variant: str, alternative: Optional[str]
+) -> Tuple[Array, Array]:
+    """Tau + p-value for one output dim (reference ``kendall.py:152-222``)."""
+    n_total = preds.shape[0]
+    concordant, discordant = _pair_census(preds, target)
+    con_min_dis = (concordant - discordant).astype(jnp.float32)
+    preds_ties, preds_p1, preds_p2, preds_unique = _tie_stats(preds)
+    target_ties, target_p1, target_p2, target_unique = _tie_stats(target)
+
+    if variant == "a":
+        tau = con_min_dis / (concordant + discordant)
+    elif variant == "b":
+        total_combinations = n_total * (n_total - 1) / 2
+        denominator = (total_combinations - preds_ties) * (total_combinations - target_ties)
+        tau = con_min_dis / jnp.sqrt(denominator)
+    else:
+        min_classes = jnp.minimum(preds_unique, target_unique).astype(jnp.float32)
+        tau = 2 * con_min_dis / ((min_classes - 1) / min_classes * n_total**2)
+
+    # p-value of the significance test (reference ``kendall.py:181-223``)
+    t_value_denominator_base = n_total * (n_total - 1) * (2.0 * n_total + 5)
+    if variant == "a":
+        t_value = 3 * con_min_dis / jnp.sqrt(t_value_denominator_base / 2)
+    else:
+        m = n_total * (n_total - 1)
+        t_value_denominator = (t_value_denominator_base - preds_p2 - target_p2) / 18
+        t_value_denominator += (2 * preds_ties * target_ties) / m
+        t_value_denominator += preds_p1 * target_p1 / (9 * m * (n_total - 2))
+        t_value = con_min_dis / jnp.sqrt(t_value_denominator)
+
+    if alternative == "two-sided":
+        t_value = jnp.abs(t_value)
+    if alternative in ("two-sided", "greater"):
+        t_value = -t_value
+    p_value = _normal_cdf(t_value)
+    if alternative == "two-sided":
+        p_value = p_value * 2
+    p_value = jnp.where(jnp.isnan(t_value), jnp.nan, p_value)
+    return jnp.clip(tau, -1.0, 1.0), p_value
+
+
+def _kendall_corrcoef_compute(
+    preds: Array,
+    target: Array,
+    variant: str = "b",
+    alternative: Optional[str] = None,
+) -> Tuple[Array, Optional[Array]]:
+    """Compute tau (+ optional p-value) for ``[N]`` or ``[N, d]`` inputs."""
+    if preds.ndim == 1:
+        tau, p_value = _kendall_tau_1d(preds, target, variant, alternative)
+    else:
+        tau, p_value = jax.vmap(lambda p, t: _kendall_tau_1d(p, t, variant, alternative), in_axes=1)(preds, target)
+    return (tau, p_value if alternative is not None else None)
+
+
+def kendall_rank_corrcoef(
+    preds: Array,
+    target: Array,
+    variant: str = "b",
+    t_test: bool = False,
+    alternative: Optional[str] = "two-sided",
+):
+    """Compute Kendall rank correlation coefficient (reference ``kendall.py:293``)."""
+    if not isinstance(t_test, bool):
+        raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {type(t_test)}.")
+    _variant = _MetricVariant.from_str(str(variant))
+    _alt = _TestAlternative.from_str(str(alternative)) if t_test else None
+    preds, target = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    _check_same_shape(preds, target)
+    tau, p_value = _kendall_corrcoef_compute(
+        preds, target, str(_variant.value), str(_alt.value) if _alt is not None else None
+    )
+    if p_value is not None:
+        return tau, p_value
+    return tau

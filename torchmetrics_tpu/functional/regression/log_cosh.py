@@ -1,0 +1,48 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Log-cosh error (reference
+``src/torchmetrics/functional/regression/log_cosh.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    """Sum of log-cosh errors + count (reference ``log_cosh.py:29``).
+
+    Uses the overflow-safe identity ``log(cosh(d)) = d + softplus(-2d) - log(2)``.
+    """
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(preds, target)
+    diff = preds - target
+    sum_log_cosh_error = jnp.sum(diff + jax.nn.softplus(-2.0 * diff) - jnp.log(2.0), axis=0).squeeze()
+    num_obs = jnp.asarray(target.shape[0])
+    return sum_log_cosh_error, num_obs
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs: Array) -> Array:
+    """Finalize log-cosh error (reference ``log_cosh.py:53``)."""
+    return (sum_log_cosh_error / num_obs).squeeze()
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """Compute log-cosh error (reference ``log_cosh.py:64``)."""
+    preds, target = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
+    sum_log_cosh_error, num_obs = _log_cosh_error_update(preds, target, num_outputs)
+    return _log_cosh_error_compute(sum_log_cosh_error, num_obs)

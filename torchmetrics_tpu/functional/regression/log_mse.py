@@ -1,0 +1,33 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Mean squared log error (reference
+``src/torchmetrics/functional/regression/log_mse.py``)."""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Sum of squared log errors + count (reference ``log_mse.py:22``)."""
+    _check_same_shape(preds, target)
+    sum_squared_log_error = jnp.sum(jnp.square(jnp.log1p(preds) - jnp.log1p(target)))
+    return sum_squared_log_error, target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, num_obs: Union[int, Array]) -> Array:
+    """Finalize MSLE (reference ``log_mse.py:35``)."""
+    return sum_squared_log_error / num_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Compute mean squared log error (reference ``log_mse.py:54``)."""
+    preds, target = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, num_obs)

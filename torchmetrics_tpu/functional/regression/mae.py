@@ -1,0 +1,37 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Mean absolute error (reference ``src/torchmetrics/functional/regression/mae.py``)."""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, int]:
+    """Sum of absolute errors + observation count (reference ``mae.py:22``)."""
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    target = target.astype(jnp.promote_types(target.dtype, jnp.float32))
+    sum_abs_error = jnp.sum(jnp.abs(preds - target), axis=0)
+    return sum_abs_error, target.shape[0]
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Union[int, Array]) -> Array:
+    """Finalize MAE (reference ``mae.py:43``)."""
+    return sum_abs_error / num_obs
+
+
+def mean_absolute_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    """Compute mean absolute error (reference ``mae.py:61``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    sum_abs_error, num_obs = _mean_absolute_error_update(preds, target, num_outputs)
+    return _mean_absolute_error_compute(sum_abs_error, num_obs)

@@ -1,0 +1,34 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Minkowski distance (reference
+``src/torchmetrics/functional/regression/minkowski.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
+    """Sum of p-th power of absolute errors (reference ``minkowski.py:21``)."""
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    difference = jnp.abs(preds - targets)
+    return jnp.sum(jnp.power(difference, p))
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    """Finalize Minkowski distance (reference ``minkowski.py:41``)."""
+    return jnp.power(distance, 1.0 / p)
+
+
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Compute Minkowski distance (reference ``minkowski.py:59``)."""
+    preds, targets = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(targets, dtype=jnp.float32)
+    distance = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(distance, p)

@@ -1,0 +1,39 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Mean squared error (reference ``src/torchmetrics/functional/regression/mse.py``)."""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    """Sum of squared errors + observation count (reference ``mse.py:22``)."""
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    target = target.astype(jnp.promote_types(target.dtype, jnp.float32))
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Union[int, Array], squared: bool = True) -> Array:
+    """Finalize MSE / RMSE (reference ``mse.py:42``)."""
+    mse = sum_squared_error / num_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """Compute mean squared error (reference ``mse.py:61``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, num_obs, squared=squared)

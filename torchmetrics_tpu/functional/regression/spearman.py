@@ -1,0 +1,75 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Spearman rank correlation (reference
+``src/torchmetrics/functional/regression/spearman.py``).
+
+TPU-first ranking: the reference assigns mean ranks to ties with a Python loop
+over repeated values (``spearman.py:36-54``); here tie-averaging is a
+sort + segment-mean + scatter, fully vectorized and jit-safe with static
+shapes.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rank_data(data: Array) -> Array:
+    """Rank 1D data starting from 1, ties get the mean of their ranks
+    (reference ``spearman.py:36``), via segment means over the sorted order."""
+    n = data.shape[0]
+    order = jnp.argsort(data)
+    sorted_vals = data[order]
+    ranks_sorted = jnp.arange(1, n + 1, dtype=data.dtype)
+    # segment ids: increment where the sorted value changes
+    seg = jnp.cumsum(jnp.concatenate([jnp.zeros(1, dtype=jnp.int32), (sorted_vals[1:] != sorted_vals[:-1]).astype(jnp.int32)]))
+    seg_sum = jax.ops.segment_sum(ranks_sorted, seg, num_segments=n)
+    seg_cnt = jax.ops.segment_sum(jnp.ones_like(ranks_sorted), seg, num_segments=n)
+    mean_rank_sorted = (seg_sum / jnp.maximum(seg_cnt, 1))[seg]
+    return jnp.zeros_like(data).at[order].set(mean_rank_sorted)
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    """Validate and pass through (cat-state update, reference ``spearman.py:57``)."""
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
+        raise TypeError(
+            f"Expected `preds` and `target` both to be floating point tensors, but got {preds.dtype} and {target.dtype}"
+        )
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    return preds, target
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    """Rank then Pearson-on-ranks (reference ``spearman.py:78``)."""
+    if preds.ndim == 1:
+        preds = _rank_data(preds)
+        target = _rank_data(target)
+    else:
+        preds = jax.vmap(_rank_data, in_axes=1, out_axes=1)(preds)
+        target = jax.vmap(_rank_data, in_axes=1, out_axes=1)(target)
+
+    preds_diff = preds - preds.mean(axis=0)
+    target_diff = target - target.mean(axis=0)
+
+    cov = (preds_diff * target_diff).mean(axis=0)
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean(axis=0))
+    target_std = jnp.sqrt((target_diff * target_diff).mean(axis=0))
+
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Compute Spearman rank correlation coefficient (reference ``spearman.py:112``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
+    preds, target = _spearman_corrcoef_update(preds, target, num_outputs)
+    return _spearman_corrcoef_compute(preds.astype(jnp.float32), target.astype(jnp.float32))

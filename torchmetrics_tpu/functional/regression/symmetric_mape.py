@@ -1,0 +1,37 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Symmetric mean absolute percentage error (reference
+``src/torchmetrics/functional/regression/symmetric_mape.py``)."""
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    """2·sum(|error|/max(|target|+|preds|, eps)) + count (reference ``symmetric_mape.py:22``)."""
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * jnp.sum(abs_per_error), target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(
+    sum_abs_per_error: Array, num_obs: Union[int, Array]
+) -> Array:
+    """Finalize SMAPE (reference ``symmetric_mape.py:49``)."""
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Compute symmetric mean absolute percentage error (reference ``symmetric_mape.py:68``)."""
+    preds, target = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

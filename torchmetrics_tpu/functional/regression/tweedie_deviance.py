@@ -1,0 +1,66 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tweedie deviance score (reference
+``src/torchmetrics/functional/regression/tweedie_deviance.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _tweedie_deviance_domain_check(preds: Array, targets: Array, power: float) -> None:
+    """Domain checks per power regime (reference ``tweedie_deviance.py:51-75``);
+    only run on concrete (non-traced) inputs so kernels stay jittable."""
+    if not (_is_concrete(preds) and _is_concrete(targets)):
+        return
+    if power == 1 and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+    if power == 2 and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+    if power < 0 and bool(jnp.any(preds <= 0)):
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+    if 1 < power < 2 and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+        raise ValueError(f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative.")
+    if power > 2 and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+        raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Sum of per-element deviance + count (reference ``tweedie_deviance.py:23``)."""
+    _check_same_shape(preds, targets)
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    _tweedie_deviance_domain_check(preds, targets, power)
+
+    if power == 0:
+        deviance_score = jnp.square(targets - preds)
+    elif power == 1:
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        term_1 = jnp.power(jnp.maximum(targets, 0.0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    """Finalize deviance score (reference ``tweedie_deviance.py:87``)."""
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Compute Tweedie deviance score (reference ``tweedie_deviance.py:105``)."""
+    preds, targets = jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(targets, dtype=jnp.float32)
+    sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

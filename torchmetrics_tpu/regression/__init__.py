@@ -1,0 +1,45 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Regression module metrics (reference
+``src/torchmetrics/regression/__init__.py``)."""
+from torchmetrics_tpu.regression.concordance import ConcordanceCorrCoef
+from torchmetrics_tpu.regression.cosine_similarity import CosineSimilarity
+from torchmetrics_tpu.regression.csi import CriticalSuccessIndex
+from torchmetrics_tpu.regression.explained_variance import ExplainedVariance
+from torchmetrics_tpu.regression.kendall import KendallRankCorrCoef
+from torchmetrics_tpu.regression.kl_divergence import KLDivergence
+from torchmetrics_tpu.regression.log_cosh import LogCoshError
+from torchmetrics_tpu.regression.log_mse import MeanSquaredLogError
+from torchmetrics_tpu.regression.mae import MeanAbsoluteError
+from torchmetrics_tpu.regression.mape import MeanAbsolutePercentageError
+from torchmetrics_tpu.regression.minkowski import MinkowskiDistance
+from torchmetrics_tpu.regression.mse import MeanSquaredError
+from torchmetrics_tpu.regression.pearson import PearsonCorrCoef
+from torchmetrics_tpu.regression.r2 import R2Score
+from torchmetrics_tpu.regression.rse import RelativeSquaredError
+from torchmetrics_tpu.regression.spearman import SpearmanCorrCoef
+from torchmetrics_tpu.regression.symmetric_mape import SymmetricMeanAbsolutePercentageError
+from torchmetrics_tpu.regression.tweedie_deviance import TweedieDevianceScore
+from torchmetrics_tpu.regression.wmape import WeightedMeanAbsolutePercentageError
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "CriticalSuccessIndex",
+    "ExplainedVariance",
+    "KendallRankCorrCoef",
+    "KLDivergence",
+    "LogCoshError",
+    "MeanSquaredLogError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MinkowskiDistance",
+    "MeanSquaredError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,21 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""ConcordanceCorrCoef module metric (reference
+``src/torchmetrics/regression/concordance.py``)."""
+from __future__ import annotations
+
+import jax
+
+from torchmetrics_tpu.functional.regression.concordance import _concordance_corrcoef_compute
+from torchmetrics_tpu.regression.pearson import PearsonCorrCoef
+
+Array = jax.Array
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Concordance correlation coefficient (reference ``concordance.py:27``);
+    rides the Pearson statistics states."""
+
+    def compute(self) -> Array:
+        """Finalize CCC (reference ``concordance.py:79``)."""
+        return _concordance_corrcoef_compute(*self._merged_states())

@@ -1,0 +1,53 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CosineSimilarity module metric (reference
+``src/torchmetrics/regression/cosine_similarity.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.cosine_similarity import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CosineSimilarity(Metric):
+    """Cosine similarity (reference ``cosine_similarity.py:29``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append a batch (cat state, reference ``cosine_similarity.py:86``)."""
+        preds, target = _cosine_similarity_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Finalize cosine similarity over the full stream (reference ``cosine_similarity.py:96``)."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _cosine_similarity_compute(preds, target, self.reduction)

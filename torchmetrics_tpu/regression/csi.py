@@ -1,0 +1,69 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CriticalSuccessIndex module metric (reference
+``src/torchmetrics/regression/csi.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.csi import (
+    _critical_success_index_compute,
+    _critical_success_index_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CriticalSuccessIndex(Metric):
+    """Critical success index (reference ``csi.py:23``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise ValueError(f"Expected argument `threshold` to be a float but got {threshold}")
+        self.threshold = float(threshold)
+        if keep_sequence_dim is not None and (not isinstance(keep_sequence_dim, int) or keep_sequence_dim < 0):
+            raise ValueError(f"Expected argument `keep_sequence_dim` to be an int but got {keep_sequence_dim}")
+        self.keep_sequence_dim = keep_sequence_dim
+
+        if keep_sequence_dim is None:
+            self.add_state("hits", default=jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("misses", default=jnp.asarray(0), dist_reduce_fx="sum")
+            self.add_state("false_alarms", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("hits_list", default=[], dist_reduce_fx="cat")
+            self.add_state("misses_list", default=[], dist_reduce_fx="cat")
+            self.add_state("false_alarms_list", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch into the state (reference ``csi.py:87``)."""
+        hits, misses, false_alarms = _critical_success_index_update(
+            jnp.asarray(preds), jnp.asarray(target), self.threshold, self.keep_sequence_dim
+        )
+        if self.keep_sequence_dim is None:
+            self.hits = self.hits + hits
+            self.misses = self.misses + misses
+            self.false_alarms = self.false_alarms + false_alarms
+        else:
+            self.hits_list.append(hits)
+            self.misses_list.append(misses)
+            self.false_alarms_list.append(false_alarms)
+
+    def compute(self) -> Array:
+        """Finalize CSI (reference ``csi.py:100``)."""
+        if self.keep_sequence_dim is None:
+            hits, misses, false_alarms = self.hits, self.misses, self.false_alarms
+        else:
+            hits = dim_zero_cat(self.hits_list)
+            misses = dim_zero_cat(self.misses_list)
+            false_alarms = dim_zero_cat(self.false_alarms_list)
+        return _critical_success_index_compute(hits, misses, false_alarms)

@@ -1,0 +1,82 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""KendallRankCorrCoef module metric (reference
+``src/torchmetrics/regression/kendall.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.kendall import (
+    _kendall_corrcoef_compute,
+    _MetricVariant,
+    _TestAlternative,
+)
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class KendallRankCorrCoef(Metric):
+    """Kendall rank correlation (reference ``kendall.py:35``); needs the full
+    stream (``cat`` states) since the pair census is global."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {type(t_test)}.")
+        if t_test and alternative is None:
+            raise ValueError("Argument `alternative` is required if `t_test=True` but got `None`.")
+        self.variant = _MetricVariant.from_str(str(variant))
+        self.alternative = _TestAlternative.from_str(str(alternative)) if t_test else None
+        self.num_outputs = num_outputs
+
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append a batch (reference ``kendall.py:160``)."""
+        preds = jnp.asarray(preds, dtype=jnp.float32)
+        target = jnp.asarray(target, dtype=jnp.float32)
+        _check_same_shape(preds, target)
+        _check_data_shape_to_num_outputs(preds, target, self.num_outputs)
+        if self.num_outputs == 1 and preds.ndim == 1:
+            preds = preds[:, None]
+            target = target[:, None]
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self):
+        """Pair census over the full stream (reference ``kendall.py:175``)."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        if self.num_outputs == 1:
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+        tau, p_value = _kendall_corrcoef_compute(
+            preds,
+            target,
+            str(self.variant.value),
+            str(self.alternative.value) if self.alternative is not None else None,
+        )
+        if p_value is not None:
+            return tau, p_value
+        return tau

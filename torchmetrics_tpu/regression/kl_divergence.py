@@ -1,0 +1,59 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""KLDivergence module metric (reference
+``src/torchmetrics/regression/kl_divergence.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.kl_divergence import _kld_compute, _kld_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class KLDivergence(Metric):
+    """KL divergence (reference ``kl_divergence.py:31``).
+
+    With ``reduction`` in ``("mean", "sum")`` the state is a scalar sum; with
+    ``"none"``/``None`` per-sample measures accumulate in a ``cat`` list state.
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ["mean", "sum", "none", None]
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ["mean", "sum"]:
+            self.add_state("measures", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        """Fold a batch into the state (reference ``kl_divergence.py:130``)."""
+        measures, total = _kld_update(jnp.asarray(p, dtype=jnp.float32), jnp.asarray(q, dtype=jnp.float32), self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + jnp.sum(measures)
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Finalize KL divergence (reference ``kl_divergence.py:139``)."""
+        measures = dim_zero_cat(self.measures) if self.reduction in ["none", None] else self.measures
+        return _kld_compute(measures, self.total, self.reduction)

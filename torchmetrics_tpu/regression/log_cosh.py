@@ -1,0 +1,44 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""LogCoshError module metric (reference
+``src/torchmetrics/regression/log_cosh.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.log_cosh import _log_cosh_error_compute, _log_cosh_error_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LogCoshError(Metric):
+    """Log-cosh error (reference ``log_cosh.py:28``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch into the state (reference ``log_cosh.py:85``)."""
+        sum_log_cosh_error, num_obs = _log_cosh_error_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32), self.num_outputs
+        )
+        self.sum_log_cosh_error = self.sum_log_cosh_error + sum_log_cosh_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Finalize log-cosh error (reference ``log_cosh.py:96``)."""
+        return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)

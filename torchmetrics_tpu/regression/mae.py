@@ -1,0 +1,43 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MeanAbsoluteError module metric (reference ``src/torchmetrics/regression/mae.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanAbsoluteError(Metric):
+    """Mean absolute error (reference ``mae.py:28``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch of absolute errors into the state (reference ``mae.py:92``)."""
+        sum_abs_error, num_obs = _mean_absolute_error_update(
+            jnp.asarray(preds), jnp.asarray(target), num_outputs=self.num_outputs
+        )
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Finalize MAE (reference ``mae.py:98``)."""
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)

@@ -1,0 +1,44 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MinkowskiDistance module metric (reference
+``src/torchmetrics/regression/minkowski.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.minkowski import (
+    _minkowski_distance_compute,
+    _minkowski_distance_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+class MinkowskiDistance(Metric):
+    """Minkowski distance (reference ``minkowski.py:29``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Fold a batch into the state (reference ``minkowski.py:74``)."""
+        minkowski_dist_sum = _minkowski_distance_update(jnp.asarray(preds), jnp.asarray(targets), self.p)
+        self.minkowski_dist_sum = self.minkowski_dist_sum + minkowski_dist_sum
+
+    def compute(self) -> Array:
+        """Finalize Minkowski distance (reference ``minkowski.py:79``)."""
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)

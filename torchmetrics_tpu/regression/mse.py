@@ -1,0 +1,46 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MeanSquaredError module metric (reference ``src/torchmetrics/regression/mse.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.mse import _mean_squared_error_compute, _mean_squared_error_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanSquaredError(Metric):
+    """Mean squared error (reference ``mse.py:28``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch of squared errors into the state (reference ``mse.py:100``)."""
+        sum_squared_error, num_obs = _mean_squared_error_update(
+            jnp.asarray(preds), jnp.asarray(target), num_outputs=self.num_outputs
+        )
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Finalize MSE/RMSE (reference ``mse.py:106``)."""
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, squared=self.squared)

@@ -1,0 +1,78 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""PearsonCorrCoef module metric (reference
+``src/torchmetrics/regression/pearson.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation coefficient (reference ``pearson.py:73``).
+
+    States carry ``dist_reduce_fx=None``: after a distributed gather they
+    arrive with a leading shard dim and are merged with the parallel-variance
+    formula in :func:`_final_aggregation` (reference ``pearson.py:161-169``).
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+
+        self.add_state("mean_x", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("mean_y", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("var_x", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("var_y", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("corr_xy", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch into the streaming statistics (reference ``pearson.py:145``)."""
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            jnp.asarray(preds, dtype=jnp.float32),
+            jnp.asarray(target, dtype=jnp.float32),
+            self.mean_x,
+            self.mean_y,
+            self.var_x,
+            self.var_y,
+            self.corr_xy,
+            self.n_total,
+            self.num_outputs,
+        )
+
+    def _merged_states(self):
+        """States, merged across gathered shards when they arrive stacked
+        (reference ``pearson.py:159-170``): returns
+        ``(mean_x, mean_y, var_x, var_y, corr_xy, n_total)``."""
+        if (self.num_outputs == 1 and jnp.asarray(self.mean_x).size > 1) or (
+            self.num_outputs > 1 and jnp.asarray(self.mean_x).ndim > 1
+        ):
+            return _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        """Finalize Pearson r (reference ``pearson.py:159-170``)."""
+        _, _, var_x, var_y, corr_xy, n_total = self._merged_states()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -1,0 +1,50 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""RelativeSquaredError module metric (reference
+``src/torchmetrics/regression/rse.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.r2 import _r2_score_update
+from torchmetrics_tpu.functional.regression.rse import _relative_squared_error_compute
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class RelativeSquaredError(Metric):
+    """Relative squared error (reference ``rse.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+
+        self.add_state("sum_squared_obs", default=jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_obs", default=jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch into the streaming sums (reference ``rse.py:80``)."""
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(target, dtype=jnp.float32)
+        )
+        self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
+        self.sum_obs = self.sum_obs + sum_obs
+        self.sum_squared_error = self.sum_squared_error + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """Finalize RSE (reference ``rse.py:90``)."""
+        return _relative_squared_error_compute(
+            self.sum_squared_obs, self.sum_obs, self.sum_squared_error, self.total, squared=self.squared
+        )

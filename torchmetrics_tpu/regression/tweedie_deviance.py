@@ -1,0 +1,47 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""TweedieDevianceScore module metric (reference
+``src/torchmetrics/regression/tweedie_deviance.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class TweedieDevianceScore(Metric):
+    """Tweedie deviance score (reference ``tweedie_deviance.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Fold a batch into the state (reference ``tweedie_deviance.py:87``)."""
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(
+            jnp.asarray(preds, dtype=jnp.float32), jnp.asarray(targets, dtype=jnp.float32), self.power
+        )
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        """Finalize deviance score (reference ``tweedie_deviance.py:95``)."""
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)

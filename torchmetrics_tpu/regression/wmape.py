@@ -1,0 +1,44 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""WeightedMeanAbsolutePercentageError module metric (reference
+``src/torchmetrics/regression/wmape.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.wmape import (
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """Weighted mean absolute percentage error (reference ``wmape.py:27``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch into the state (reference ``wmape.py:72``)."""
+        sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(
+            jnp.asarray(preds), jnp.asarray(target)
+        )
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> Array:
+        """Finalize WMAPE (reference ``wmape.py:78``)."""
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)
