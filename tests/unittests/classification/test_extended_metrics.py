@@ -1,0 +1,315 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Extended classification families vs sklearn/manual oracles (reference tests:
+``tests/unittests/classification/test_{calibration_error,hinge,ranking,dice,
+group_fairness,recall_fixed_precision,...}.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryFairness,
+    BinaryGroupStatRates,
+    BinaryHingeLoss,
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity,
+    Dice,
+    MulticlassCalibrationError,
+    MulticlassHingeLoss,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+
+N, C, L = 128, 5, 4
+
+
+def _rng(seed=7):
+    return np.random.RandomState(seed)
+
+
+def _ece_oracle(confidences, accuracies, n_bins=15, norm="l1"):
+    """Manual binning oracle matching the reference semantics."""
+    bins = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bins, confidences, side="right") - 1, 0, n_bins - 1)
+    err = 0.0
+    maxerr = 0.0
+    total = len(confidences)
+    for b in range(n_bins):
+        m = idx == b
+        if not m.any():
+            continue
+        gap = abs(accuracies[m].mean() - confidences[m].mean())
+        w = m.sum() / total
+        if norm == "l1":
+            err += gap * w
+        elif norm == "l2":
+            err += gap**2 * w
+        maxerr = max(maxerr, gap)
+    if norm == "max":
+        return maxerr
+    if norm == "l2":
+        return np.sqrt(err) if err > 0 else 0.0
+    return err
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_binary_calibration_error(norm):
+    rng = _rng()
+    preds = rng.rand(N).astype(np.float32)
+    target = (rng.rand(N) < preds).astype(np.int32)
+    conf = np.where(preds >= 0.5, preds, 1 - preds)
+    acc = ((preds >= 0.5).astype(int) == target).astype(float)
+    expected = _ece_oracle(conf, acc, 15, norm)
+    got = float(F.binary_calibration_error(preds, target, n_bins=15, norm=norm))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+    # module path, streamed
+    m = BinaryCalibrationError(n_bins=15, norm=norm)
+    for i in range(4):
+        m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_multiclass_calibration_error(norm):
+    rng = _rng(3)
+    logits = rng.randn(N, C).astype(np.float32)
+    target = rng.randint(0, C, N).astype(np.int32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    conf = probs.max(-1)
+    acc = (probs.argmax(-1) == target).astype(float)
+    expected = _ece_oracle(conf, acc, 15, norm)
+    got = float(F.multiclass_calibration_error(logits, target, num_classes=C, n_bins=15, norm=norm))
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
+    m = MulticlassCalibrationError(num_classes=C, n_bins=15, norm=norm)
+    for i in range(4):
+        m.update(logits[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_binary_hinge():
+    rng = _rng(11)
+    preds = rng.rand(N).astype(np.float32)
+    target = rng.randint(0, 2, N)
+    margin = np.where(target == 1, preds, -preds)
+    expected = np.clip(1 - margin, 0, None).mean()
+    got = float(F.binary_hinge_loss(preds, target))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+    expected_sq = (np.clip(1 - margin, 0, None) ** 2).mean()
+    np.testing.assert_allclose(float(F.binary_hinge_loss(preds, target, squared=True)), expected_sq, rtol=1e-5)
+    m = BinaryHingeLoss()
+    for i in range(4):
+        m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_multiclass_hinge():
+    rng = _rng(13)
+    logits = rng.randn(N, C).astype(np.float32)
+    target = rng.randint(0, C, N)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    # crammer-singer oracle
+    true_score = probs[np.arange(N), target]
+    masked = probs.copy()
+    masked[np.arange(N), target] = -np.inf
+    margin = true_score - masked.max(-1)
+    expected = np.clip(1 - margin, 0, None).mean()
+    got = float(F.multiclass_hinge_loss(probs, target, num_classes=C))
+    np.testing.assert_allclose(got, expected, rtol=1e-5)
+    m = MulticlassHingeLoss(num_classes=C)
+    for i in range(4):
+        m.update(probs[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+    # one-vs-all mode runs and returns (C,)
+    ova = np.asarray(F.multiclass_hinge_loss(probs, target, num_classes=C, multiclass_mode="one-vs-all"))
+    assert ova.shape == (C,)
+
+
+def test_multilabel_ranking():
+    rng = _rng(17)
+    preds = rng.rand(N, C).astype(np.float32)
+    target = (rng.rand(N, C) > 0.5).astype(np.int32)
+    np.testing.assert_allclose(
+        float(F.multilabel_coverage_error(preds, target, num_labels=C)),
+        skm.coverage_error(target, preds),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(F.multilabel_ranking_average_precision(preds, target, num_labels=C)),
+        skm.label_ranking_average_precision_score(target, preds),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        float(F.multilabel_ranking_loss(preds, target, num_labels=C)),
+        skm.label_ranking_loss(target, preds),
+        rtol=1e-5,
+    )
+    for cls, oracle in [
+        (MultilabelCoverageError, skm.coverage_error),
+        (MultilabelRankingAveragePrecision, skm.label_ranking_average_precision_score),
+        (MultilabelRankingLoss, skm.label_ranking_loss),
+    ]:
+        m = cls(num_labels=C)
+        for i in range(4):
+            m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+        np.testing.assert_allclose(float(m.compute()), oracle(target, preds), rtol=1e-5)
+
+
+def test_dice():
+    rng = _rng(19)
+    preds = rng.randint(0, C, N)
+    target = rng.randint(0, C, N)
+    expected_micro = skm.f1_score(target, preds, average="micro")
+    got = float(F.dice(preds, target, num_classes=C, average="micro"))
+    np.testing.assert_allclose(got, expected_micro, rtol=1e-5)
+    expected_macro = skm.f1_score(target, preds, average="macro", labels=list(range(C)))
+    np.testing.assert_allclose(float(F.dice(preds, target, num_classes=C, average="macro")), expected_macro, rtol=1e-5)
+    m = Dice(num_classes=C, average="micro")
+    for i in range(4):
+        m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    np.testing.assert_allclose(float(m.compute()), expected_micro, rtol=1e-5)
+    # multiclass probs input
+    logits = rng.randn(N, C).astype(np.float32)
+    expected = skm.f1_score(target, logits.argmax(-1), average="micro")
+    np.testing.assert_allclose(float(F.dice(logits, target, average="micro")), expected, rtol=1e-5)
+
+
+def test_group_fairness():
+    rng = _rng(23)
+    preds = rng.rand(N).astype(np.float32)
+    target = rng.randint(0, 2, N)
+    groups = rng.randint(0, 2, N)
+    hard = (preds > 0.5).astype(int)
+
+    # oracle rates
+    def rates(g):
+        m = groups == g
+        tp = ((hard == 1) & (target == 1) & m).sum()
+        fp = ((hard == 1) & (target == 0) & m).sum()
+        tn = ((hard == 0) & (target == 0) & m).sum()
+        fn = ((hard == 0) & (target == 1) & m).sum()
+        s = tp + fp + tn + fn
+        return np.array([tp, fp, tn, fn]) / s
+
+    res = F.binary_groups_stat_rates(preds, target, groups, num_groups=2)
+    np.testing.assert_allclose(np.asarray(res["group_0"]), rates(0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res["group_1"]), rates(1), rtol=1e-6)
+
+    # positivity / tpr ratios
+    pr = [((hard == 1) & (groups == g)).sum() / (groups == g).sum() for g in (0, 1)]
+    dp_expected = min(pr) / max(pr)
+    dp = F.demographic_parity(preds, groups)
+    np.testing.assert_allclose(float(list(dp.values())[0]), dp_expected, rtol=1e-5)
+
+    tpr = [
+        ((hard == 1) & (target == 1) & (groups == g)).sum() / ((target == 1) & (groups == g)).sum() for g in (0, 1)
+    ]
+    eo_expected = min(tpr) / max(tpr)
+    eo = F.equal_opportunity(preds, target, groups)
+    np.testing.assert_allclose(float(list(eo.values())[0]), eo_expected, rtol=1e-5)
+
+    both = F.binary_fairness(preds, target, groups, task="all")
+    assert len(both) == 2
+
+    # module path
+    m = BinaryGroupStatRates(num_groups=2)
+    for i in range(4):
+        s = slice(i * 32, (i + 1) * 32)
+        m.update(preds[s], target[s], groups[s])
+    res_m = m.compute()
+    np.testing.assert_allclose(np.asarray(res_m["group_0"]), rates(0), rtol=1e-6)
+
+    mf = BinaryFairness(num_groups=2, task="all")
+    for i in range(4):
+        s = slice(i * 32, (i + 1) * 32)
+        mf.update(preds[s], target[s], groups[s])
+    res_f = mf.compute()
+    assert len(res_f) == 2
+    np.testing.assert_allclose(float(res_f[[k for k in res_f if k.startswith("DP")][0]]), dp_expected, rtol=1e-5)
+
+
+@pytest.mark.parametrize("thresholds", [None, 100])
+def test_recall_at_fixed_precision(thresholds):
+    rng = _rng(29)
+    preds = rng.rand(N).astype(np.float32)
+    target = rng.randint(0, 2, N)
+    min_precision = 0.5
+    # oracle from the sklearn PR curve
+    prec, rec, thr = skm.precision_recall_curve(target, preds)
+    valid = prec >= min_precision
+    expected = rec[valid].max() if valid.any() else 0.0
+    r, t = F.binary_recall_at_fixed_precision(preds, target, min_precision=min_precision, thresholds=thresholds)
+    tol = 1e-6 if thresholds is None else 2e-2
+    np.testing.assert_allclose(float(r), expected, atol=tol)
+    m = BinaryRecallAtFixedPrecision(min_precision=min_precision, thresholds=thresholds)
+    for i in range(4):
+        m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    r2, _ = m.compute()
+    np.testing.assert_allclose(float(r2), expected, atol=tol)
+
+
+def test_multiclass_recall_at_fixed_precision():
+    rng = _rng(31)
+    logits = rng.randn(N, C).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.randint(0, C, N)
+    r, t = F.multiclass_recall_at_fixed_precision(probs, target, num_classes=C, min_precision=0.4)
+    assert r.shape == (C,)
+    for i in range(C):
+        prec, rec, thr = skm.precision_recall_curve((target == i).astype(int), probs[:, i])
+        valid = prec >= 0.4
+        expected = rec[valid].max() if valid.any() else 0.0
+        np.testing.assert_allclose(float(r[i]), expected, atol=1e-6)
+    m = MulticlassRecallAtFixedPrecision(num_classes=C, min_precision=0.4)
+    m.update(probs, target)
+    r2, _ = m.compute()
+    np.testing.assert_allclose(np.asarray(r2), np.asarray(r), atol=1e-6)
+
+
+def test_precision_at_fixed_recall():
+    rng = _rng(37)
+    preds = rng.rand(N).astype(np.float32)
+    target = rng.randint(0, 2, N)
+    min_recall = 0.5
+    prec, rec, thr = skm.precision_recall_curve(target, preds)
+    valid = rec >= min_recall
+    expected = prec[valid].max() if valid.any() else 0.0
+    p, t = F.binary_precision_at_fixed_recall(preds, target, min_recall=min_recall)
+    np.testing.assert_allclose(float(p), expected, atol=1e-6)
+    m = BinaryPrecisionAtFixedRecall(min_recall=min_recall)
+    m.update(preds, target)
+    p2, _ = m.compute()
+    np.testing.assert_allclose(float(p2), expected, atol=1e-6)
+
+
+def test_sensitivity_at_specificity_and_reverse():
+    rng = _rng(41)
+    preds = rng.rand(N).astype(np.float32)
+    target = rng.randint(0, 2, N)
+    fpr, tpr, thr = skm.roc_curve(target, preds)
+    spec = 1 - fpr
+
+    min_spec = 0.6
+    valid = spec >= min_spec
+    expected_sens = tpr[valid].max() if valid.any() else 0.0
+    s, t = F.binary_sensitivity_at_specificity(preds, target, min_specificity=min_spec)
+    np.testing.assert_allclose(float(s), expected_sens, atol=1e-6)
+    m = BinarySensitivityAtSpecificity(min_specificity=min_spec)
+    m.update(preds, target)
+    s2, _ = m.compute()
+    np.testing.assert_allclose(float(s2), expected_sens, atol=1e-6)
+
+    min_sens = 0.6
+    valid = tpr >= min_sens
+    expected_spec = spec[valid].max() if valid.any() else 0.0
+    s, t = F.binary_specificity_at_sensitivity(preds, target, min_sensitivity=min_sens)
+    np.testing.assert_allclose(float(s), expected_spec, atol=1e-6)
+    m = BinarySpecificityAtSensitivity(min_sensitivity=min_sens)
+    m.update(preds, target)
+    s2, _ = m.compute()
+    np.testing.assert_allclose(float(s2), expected_spec, atol=1e-6)

@@ -1,0 +1,104 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Dice module metric (reference ``src/torchmetrics/classification/dice.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.dice import (
+    _dice_compute,
+    _dice_format,
+    _dice_update,
+    _dice_update_samplewise,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class Dice(Metric):
+    """Dice score: 2·tp / (2·tp + fp + fn) (reference ``dice.py:28``).
+
+    State: per-class tp/fp/fn counters with ``"sum"`` reduction — the
+    stat-scores state machine of the reference's legacy ``StatScores`` base.
+    For ``average='samples'`` the state is the running per-sample dice sum +
+    sample count instead. When ``num_classes`` is not given, per-class states
+    are sized on the first ``update`` from the inputs.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        zero_division: float = 0.0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        ignore_index: Optional[int] = None,
+        top_k: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        # accept-and-ignore legacy kwargs for API parity
+        kwargs.pop("mdmc_average", None)
+        kwargs.pop("multiclass", None)
+        super().__init__(**kwargs)
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+        if average == "samples":
+            self.add_state("samples_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("samples_count", jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            n_states = num_classes if num_classes is not None and num_classes > 2 else 2
+            self.add_state("tp", jnp.zeros(n_states), dist_reduce_fx="sum")
+            self.add_state("fp", jnp.zeros(n_states), dist_reduce_fx="sum")
+            self.add_state("fn", jnp.zeros(n_states), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold per-class tp/fp/fn counts (or per-sample dice) into the state."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        preds_oh, target_oh = _dice_format(preds, target, self.threshold, self.num_classes, self.top_k)
+        if self.average == "samples":
+            total, count = _dice_update_samplewise(preds_oh, target_oh, self.zero_division, self.ignore_index)
+            self.samples_total = self.samples_total + total
+            self.samples_count = self.samples_count + count
+            return
+        tp, fp, fn = _dice_update(preds_oh, target_oh)
+        if self.tp.shape != tp.shape:
+            # num_classes was not given: size the states from the first batch
+            if bool((self.tp.sum() + self.fp.sum() + self.fn.sum()) == 0):
+                zero = jnp.zeros_like(tp)
+                for name in ("tp", "fp", "fn"):
+                    self._defaults[name] = zero
+            else:
+                raise ValueError(
+                    f"Inconsistent number of classes between updates: state has {self.tp.shape[0]}, "
+                    f"batch has {tp.shape[0]}. Pass `num_classes` explicitly."
+                )
+            self.tp, self.fp, self.fn = tp, fp, fn
+            return
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.fn = self.fn + fn
+
+    def compute(self) -> Array:
+        """Finalize the dice score."""
+        if self.average == "samples":
+            return self.samples_total / jnp.maximum(self.samples_count, 1.0)
+        return _dice_compute(self.tp, self.fp, self.fn, self.average, self.zero_division, self.ignore_index)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

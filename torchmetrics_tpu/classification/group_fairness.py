@@ -1,0 +1,130 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Group fairness module metrics (reference ``src/torchmetrics/classification/group_fairness.py``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_reduce,
+    _groups_stat_transform,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _AbstractGroupStatScores(Metric):
+    """Create and update per-group tp/fp/tn/fn states (reference ``group_fairness.py:33-57``)."""
+
+    def _create_states(self, num_groups: int) -> None:
+        self.add_state("tp", jnp.zeros(num_groups), dist_reduce_fx="sum")
+        self.add_state("fp", jnp.zeros(num_groups), dist_reduce_fx="sum")
+        self.add_state("tn", jnp.zeros(num_groups), dist_reduce_fx="sum")
+        self.add_state("fn", jnp.zeros(num_groups), dist_reduce_fx="sum")
+
+    def _update_states(self, group_stats) -> None:
+        stacked = _groups_stat_transform(group_stats)
+        self.tp = self.tp + stacked["tp"]
+        self.fp = self.fp + stacked["fp"]
+        self.tn = self.tn + stacked["tn"]
+        self.fn = self.fn + stacked["fn"]
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """tp/fp/tn/fn rates by group (reference ``group_fairness.py:60``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        """Fold per-group stat scores into the states (reference ``:118-131``)."""
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        """Per-group rates (reference ``:133-137``)."""
+        group_stats = [(self.tp[i], self.fp[i], self.tn[i], self.fn[i]) for i in range(self.num_groups)]
+        return _groups_reduce(group_stats)
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity ratios (reference ``group_fairness.py:140``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ("demographic_parity", "equal_opportunity", "all"):
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.task = task
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Optional[Array], groups: Array) -> None:
+        """Fold per-group stat scores into the states (reference ``:201-224``)."""
+        preds = jnp.asarray(preds)
+        if self.task == "demographic_parity":
+            target = jnp.zeros(preds.shape, dtype=jnp.int32)
+        elif target is None:
+            raise ValueError(f"The task {self.task} requires a target.")
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        """Fairness ratios (reference ``:226-245``)."""
+        transformed = {"tp": self.tp, "fp": self.fp, "tn": self.tn, "fn": self.fn}
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(**transformed)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(**transformed)
+        return {
+            **_compute_binary_demographic_parity(**transformed),
+            **_compute_binary_equal_opportunity(**transformed),
+        }

@@ -1,0 +1,91 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Multilabel ranking module metrics (reference ``src/torchmetrics/classification/ranking.py``)."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_format,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+    _ranking_reduce,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _MultilabelRankingMetric(Metric):
+    """Shared state machine: summed score + count (reference ``ranking.py:33-101``)."""
+
+    is_differentiable = False
+    full_state_update = False
+
+    _update_fn = None
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            if not isinstance(num_labels, int) or num_labels < 2:
+                raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+            if ignore_index is not None and not isinstance(ignore_index, int):
+                raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the ranking measure over a batch."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target = _multilabel_ranking_format(preds, target, self.ignore_index)
+        measure, total = type(self)._update_fn(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Mean measure over all samples."""
+        return _ranking_reduce(self.measure, self.total)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class MultilabelCoverageError(_MultilabelRankingMetric):
+    """Multilabel coverage error (reference ``ranking.py:33``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_MultilabelRankingMetric):
+    """Multilabel label-ranking average precision (reference ``ranking.py:137``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_MultilabelRankingMetric):
+    """Multilabel ranking loss (reference ``ranking.py:241``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

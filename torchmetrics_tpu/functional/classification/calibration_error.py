@@ -1,0 +1,227 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Calibration error (reference ``src/torchmetrics/functional/classification/calibration_error.py``).
+
+TPU-native formulation: the bucketize/scatter-add of the reference
+(``calibration_error.py:29-59``) becomes a one-hot bin-membership matmul —
+static shapes, MXU-friendly, jit/shard_map-safe.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Array) -> Tuple[Array, Array, Array]:
+    """Per-bin mean accuracy/confidence and bin proportions (reference ``:29-59``).
+
+    Bin membership is computed as a dense one-hot comparison against the bin
+    boundaries (the ``_bincount`` one-hot trick of ``utilities/data.py:203-205``),
+    so the whole binning is a single matmul-like reduction.
+    """
+    accuracies = accuracies.astype(confidences.dtype)
+    n_bins = bin_boundaries.shape[0] - 1
+    # index of the bin each confidence falls into: boundaries are a linspace on
+    # [0, 1]; right-closed bucketize like torch.bucketize(right=True) - 1
+    idx = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="right") - 1, 0, n_bins - 1)
+    onehot = (idx[:, None] == jnp.arange(n_bins)[None, :]).astype(confidences.dtype)  # (N, B)
+    count_bin = onehot.sum(axis=0)
+    conf_bin = _safe_divide(confidences @ onehot, count_bin)
+    acc_bin = _safe_divide(accuracies @ onehot, count_bin)
+    prop_bin = count_bin / count_bin.sum()
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Union[Array, int],
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Calibration error from confidences/accuracies (reference ``:62-108``)."""
+    if isinstance(bin_boundaries, int):
+        bin_boundaries = jnp.linspace(0, 1, bin_boundaries + 1, dtype=confidences.dtype)
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * confidences.shape[0] - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``:111-122``)."""
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(preds: Array, target: Array, ignore_index: Optional[int] = None) -> None:
+    """Validate input tensors (reference ``:125-134``)."""
+    from torchmetrics_tpu.functional.classification.confusion_matrix import _binary_confusion_matrix_tensor_validation
+
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be floating tensor with probabilities/logits but got tensor with dtype {preds.dtype}")
+
+
+def _binary_calibration_error_format(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Flatten + sigmoid-normalize, keep an ignore mask via target=-1."""
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidences and accuracies (reference ``:136-138``).
+
+    Ignored positions (target == -1) get confidence 0 and land in bin 0 with
+    zero weight via masking by the caller; here we filter host-side free since
+    these are raw `cat` states.
+    """
+    confidences = jnp.where(preds >= 0.5, preds, 1 - preds)
+    accuracies = (jnp.where(preds >= 0.5, 1, 0) == target).astype(preds.dtype)
+    return confidences, accuracies
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Binary expected calibration error (reference ``:141-207``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds, target = _binary_calibration_error_format(preds, target, ignore_index)
+    if ignore_index is not None:
+        keep = target != -1
+        preds = preds[keep]
+        target = target[keep]
+    confidences, accuracies = _binary_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int,
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``:210-224``)."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate input tensors (reference ``:227-235``)."""
+    from torchmetrics_tpu.functional.classification.confusion_matrix import (
+        _multiclass_confusion_matrix_tensor_validation,
+    )
+
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be floating tensor with probabilities/logits but got tensor with dtype {preds.dtype}")
+
+
+def _multiclass_calibration_error_format(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Move class dim last, flatten, softmax-normalize."""
+    if preds.ndim > 2:
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, preds.shape[1])
+        target = target.reshape(-1)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    return preds, target
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence/accuracy per sample (reference ``:238-246``)."""
+    confidences = jnp.max(preds, axis=-1)
+    predictions = jnp.argmax(preds, axis=-1)
+    accuracies = (predictions == target).astype(jnp.float32)
+    return confidences.astype(jnp.float32), accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multiclass expected calibration error (reference ``:249-318``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target = _multiclass_calibration_error_format(preds, target, ignore_index)
+    if ignore_index is not None:
+        keep = target != -1
+        preds = preds[keep]
+        target = target[keep]
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching calibration error (reference ``:321-365``)."""
+    if task == "binary":
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to be one of 'binary', 'multiclass' but got {task}")

@@ -1,0 +1,142 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Dice score (reference ``src/torchmetrics/functional/classification/dice.py``).
+
+The reference's Dice rides the legacy ``_stat_scores_update`` input formatter;
+here the same capability is built on the framework's one-hot stat-scores
+kernels: dice = 2·tp / (2·tp + fp + fn) with micro/macro/weighted/none/samples
+averaging (reference ``dice.py:24-64``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
+from torchmetrics_tpu.utilities.data import to_onehot
+
+Array = jax.Array
+
+
+def _dice_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    top_k: int = 1,
+) -> Tuple[Array, Array]:
+    """Normalize inputs to one-hot ``(N, C)`` prediction/target pairs.
+
+    Accepts binary probabilities/labels ``(N, ...)``, multiclass labels
+    ``(N, ...)`` with ``num_classes``, or multiclass probs ``(N, C, ...)``
+    (argmax/top-k) — the capability surface of the reference's legacy
+    ``_input_format_classification`` (``utilities/checks.py:313``).
+    """
+    if preds.ndim == target.ndim + 1:
+        # (N, C, ...) probabilities/logits
+        num_classes = preds.shape[1]
+        probs = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax")
+        if preds.ndim > 2:
+            probs = jnp.moveaxis(probs, 1, -1).reshape(-1, num_classes)
+            target = target.reshape(-1)
+        if top_k == 1:
+            preds_oh = to_onehot(jnp.argmax(probs, axis=-1), num_classes)
+        else:
+            from torchmetrics_tpu.utilities.data import select_topk
+
+            preds_oh = select_topk(probs, top_k, dim=1)
+        target_oh = to_onehot(target.astype(jnp.int32), num_classes)
+        return preds_oh.astype(jnp.int32), target_oh.astype(jnp.int32)
+    # same-shape inputs
+    preds = preds.reshape(preds.shape[0], -1) if preds.ndim > 1 else preds.reshape(-1)
+    target = target.reshape(target.shape[0], -1) if target.ndim > 1 else target.reshape(-1)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = (normalize_logits_if_needed(preds, "sigmoid") >= threshold).astype(jnp.int32)
+    if num_classes is not None and num_classes > 2:
+        preds_oh = to_onehot(preds.reshape(-1).astype(jnp.int32), num_classes)
+        target_oh = to_onehot(target.reshape(-1).astype(jnp.int32), num_classes)
+        return preds_oh.astype(jnp.int32), target_oh.astype(jnp.int32)
+    # binary: treat as 2-class one-hot
+    preds_oh = to_onehot(preds.reshape(-1).astype(jnp.int32), 2)
+    target_oh = to_onehot(target.reshape(-1).astype(jnp.int32), 2)
+    return preds_oh.astype(jnp.int32), target_oh.astype(jnp.int32)
+
+
+def _dice_update(preds_oh: Array, target_oh: Array) -> Tuple[Array, Array, Array]:
+    """Per-class tp/fp/fn from one-hot inputs.
+
+    ``ignore_index`` is handled at compute time by dropping the class column
+    (the reference's legacy stat-scores semantics), not by dropping samples.
+    """
+    tp = (preds_oh * target_oh).sum(axis=0).astype(jnp.float32)
+    fp = (preds_oh * (1 - target_oh)).sum(axis=0).astype(jnp.float32)
+    fn = ((1 - preds_oh) * target_oh).sum(axis=0).astype(jnp.float32)
+    return tp, fp, fn
+
+
+def _dice_update_samplewise(
+    preds_oh: Array, target_oh: Array, zero_division: float, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    """Summed per-sample dice + sample count (``average='samples'``)."""
+    if ignore_index is not None:
+        keep = jnp.arange(preds_oh.shape[1]) != ignore_index
+        preds_oh = preds_oh * keep
+        target_oh = target_oh * keep
+    tp = (preds_oh * target_oh).sum(axis=1).astype(jnp.float32)
+    fp = (preds_oh * (1 - target_oh)).sum(axis=1).astype(jnp.float32)
+    fn = ((1 - preds_oh) * target_oh).sum(axis=1).astype(jnp.float32)
+    per_sample = _safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
+    return per_sample.sum(), jnp.asarray(per_sample.shape[0], jnp.float32)
+
+
+def _dice_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str] = "micro",
+    zero_division: float = 0.0,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Reduce per-class dice (reference ``dice.py:24-64``)."""
+    if ignore_index is not None:
+        keep = jnp.arange(tp.shape[0]) != ignore_index
+    else:
+        keep = jnp.ones(tp.shape[0], dtype=bool)
+    if average == "micro":
+        tp_s = jnp.where(keep, tp, 0.0).sum()
+        fp_s = jnp.where(keep, fp, 0.0).sum()
+        fn_s = jnp.where(keep, fn, 0.0).sum()
+        return _safe_divide(2 * tp_s, 2 * tp_s + fp_s + fn_s, zero_division)
+    per_class = _safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
+    if average in (None, "none"):
+        return per_class
+    if average == "macro":
+        return jnp.where(keep, per_class, 0.0).sum() / keep.sum()
+    if average == "weighted":
+        weights = jnp.where(keep, tp + fn, 0.0)
+        return _safe_divide((per_class * weights).sum(), weights.sum(), zero_division)
+    raise ValueError(
+        f"Expected argument `average` to be one of 'micro', 'macro', 'weighted', 'samples', 'none' but got {average}"
+    )
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0.0,
+    average: Optional[str] = "micro",
+    threshold: float = 0.5,
+    top_k: int = 1,
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice score (reference ``dice.py:67-214``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    preds_oh, target_oh = _dice_format(preds, target, threshold, num_classes, top_k)
+    if average == "samples":
+        total, count = _dice_update_samplewise(preds_oh, target_oh, zero_division, ignore_index)
+        return total / count
+    tp, fp, fn = _dice_update(preds_oh, target_oh)
+    return _dice_compute(tp, fp, fn, average, zero_division, ignore_index)

@@ -1,0 +1,192 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Group fairness (reference ``src/torchmetrics/functional/classification/group_fairness.py``).
+
+TPU-native formulation: the reference sorts by group and splits into a Python
+list of variable-size chunks (``group_fairness.py:52-83``); here group
+membership is a one-hot ``(N, G)`` mask and all per-group stats are a single
+masked reduction — static shapes, shardable along N.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    """Validate the groups tensor (reference ``:30-44``)."""
+    if int(jnp.max(groups)) >= num_groups:
+        raise ValueError(
+            f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the specified"
+            f"number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``."
+        )
+    if not jnp.issubdtype(groups.dtype, jnp.integer):
+        raise ValueError(f"Expected dtype of argument groups to be int, not {groups.dtype}.")
+
+
+def _groups_format(groups: Array) -> Array:
+    """Reshape groups to correspond to preds and target (reference ``:47-49``)."""
+    return groups.reshape(groups.shape[0], -1)
+
+
+def _binary_groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> List[Tuple[Array, Array, Array, Array]]:
+    """Per-group (tp, fp, tn, fn) via one-hot group masking (reference ``:52-83``)."""
+    preds, target, groups = jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups)
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+
+    preds, target = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    groups = _groups_format(groups)
+
+    g = groups.reshape(-1)
+    p = preds.reshape(-1)
+    t = target.reshape(-1)
+    valid = t >= 0  # ignore_index positions encoded as -1
+    onehot = (g[:, None] == jnp.arange(num_groups)[None, :]) & valid[:, None]  # (N, G)
+    tp = jnp.sum(onehot & ((p == 1) & (t == 1))[:, None], axis=0)
+    fp = jnp.sum(onehot & ((p == 1) & (t == 0))[:, None], axis=0)
+    tn = jnp.sum(onehot & ((p == 0) & (t == 0))[:, None], axis=0)
+    fn = jnp.sum(onehot & ((p == 0) & (t == 1))[:, None], axis=0)
+    return [(tp[i], fp[i], tn[i], fn[i]) for i in range(num_groups)]
+
+
+def _groups_reduce(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    """Rates per group (reference ``:86-90``)."""
+    return {
+        f"group_{group}": jnp.stack(stats) / jnp.maximum(jnp.stack(stats).sum(), 1)
+        for group, stats in enumerate(group_stats)
+    }
+
+
+def _groups_stat_transform(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    """Stack per-group stats into per-stat tensors (reference ``:93-102``)."""
+    return {
+        "tp": jnp.stack([s[0] for s in group_stats]),
+        "fp": jnp.stack([s[1] for s in group_stats]),
+        "tn": jnp.stack([s[2] for s in group_stats]),
+        "fn": jnp.stack([s[3] for s in group_stats]),
+    }
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """True/false positive/negative rates by group (reference ``:105-161``)."""
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _groups_reduce(group_stats)
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """DP = min positivity rate / max positivity rate (reference ``:164-175``)."""
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_pos_rate_id = int(jnp.argmin(pos_rates))
+    max_pos_rate_id = int(jnp.argmax(pos_rates))
+    return {f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id])}
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity ratio (reference ``:177-241``)."""
+    preds, groups = jnp.asarray(preds), jnp.asarray(groups)
+    num_groups = int(jnp.unique(groups).shape[0])
+    target = jnp.zeros(preds.shape, dtype=jnp.int32)
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _compute_binary_demographic_parity(**_groups_stat_transform(group_stats))
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """EO = min TPR / max TPR (reference ``:243-255``)."""
+    true_pos_rates = _safe_divide(tp, tp + fn)
+    min_pos_rate_id = int(jnp.argmin(true_pos_rates))
+    max_pos_rate_id = int(jnp.argmax(true_pos_rates))
+    return {
+        f"EO_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
+            true_pos_rates[min_pos_rate_id], true_pos_rates[max_pos_rate_id]
+        )
+    }
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Equal opportunity ratio (reference ``:258-324``)."""
+    preds, target, groups = jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups)
+    num_groups = int(jnp.unique(groups).shape[0])
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _compute_binary_equal_opportunity(**_groups_stat_transform(group_stats))
+
+
+def binary_fairness(
+    preds: Array,
+    target: Optional[Array],
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity (reference ``:326-383``)."""
+    if task not in ("demographic_parity", "equal_opportunity", "all"):
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    preds, groups = jnp.asarray(preds), jnp.asarray(groups)
+    if task == "demographic_parity":
+        if target is not None:
+            rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+        target = jnp.zeros(preds.shape, dtype=jnp.int32)
+    elif target is None:
+        raise ValueError(f"The task {task} requires a target.")
+    target = jnp.asarray(target)
+
+    num_groups = int(jnp.unique(groups).shape[0])
+    group_stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    transformed = _groups_stat_transform(group_stats)
+
+    if task == "demographic_parity":
+        return _compute_binary_demographic_parity(**transformed)
+    if task == "equal_opportunity":
+        return _compute_binary_equal_opportunity(**transformed)
+    return {
+        **_compute_binary_demographic_parity(**transformed),
+        **_compute_binary_equal_opportunity(**transformed),
+    }

@@ -1,0 +1,179 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Hinge loss (reference ``src/torchmetrics/functional/classification/hinge.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+from torchmetrics_tpu.utilities.data import to_onehot
+
+Array = jax.Array
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    """Finalize mean hinge loss (reference ``hinge.py:30-31``)."""
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    """Validate non-tensor args (reference ``:34-38``)."""
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_tensor_validation(preds: Array, target: Array, ignore_index: Optional[int] = None) -> None:
+    """Validate input tensors (reference ``:41-47``)."""
+    from torchmetrics_tpu.functional.classification.confusion_matrix import _binary_confusion_matrix_tensor_validation
+
+    _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be floating tensor with probabilities/logits but got tensor with dtype {preds.dtype}")
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    """Summed hinge measure + count (reference ``:50-67``).
+
+    ``preds`` here are margins in [0, 1] (sigmoid-normalized by the caller);
+    ignored positions carry target ``-1`` and are masked to zero contribution.
+    """
+    valid = target >= 0
+    sign = jnp.where(target > 0, 1.0, -1.0)
+    margin = sign * preds
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures**2
+    measures = jnp.where(valid, measures, 0.0)
+    total = valid.sum()
+    return measures.sum(axis=0), total
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Binary hinge loss (reference ``:70-122``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
+    preds = normalize_logits_if_needed(preds.reshape(-1).astype(jnp.float32), "sigmoid")
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    measures, total = _binary_hinge_loss_update(preds, target, squared)
+    return _hinge_loss_compute(measures, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``:125-136``)."""
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    allowed_mm = ("crammer-singer", "one-vs-all")
+    if multiclass_mode not in allowed_mm:
+        raise ValueError(f"Expected argument `multiclass_mode` to be one of {allowed_mm}, but got {multiclass_mode}.")
+
+
+def _multiclass_hinge_loss_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    """Validate input tensors (reference ``:139-147``)."""
+    from torchmetrics_tpu.functional.classification.confusion_matrix import (
+        _multiclass_confusion_matrix_tensor_validation,
+    )
+
+    _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be floating tensor with probabilities/logits but got tensor with dtype {preds.dtype}")
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array,
+    target: Array,
+    squared: bool,
+    multiclass_mode: str = "crammer-singer",
+) -> Tuple[Array, Array]:
+    """Summed hinge measures + count (reference ``:150-177``).
+
+    Ignored rows carry target ``-1`` → masked out; the boolean scatter of the
+    reference becomes where-selects over a one-hot target (static shapes).
+    """
+    preds = normalize_logits_if_needed(preds, "softmax")
+    valid = target >= 0
+    target_oh = to_onehot(jnp.where(valid, target, 0), max(2, preds.shape[1])).astype(bool)
+    if multiclass_mode == "crammer-singer":
+        true_score = jnp.sum(jnp.where(target_oh, preds, 0.0), axis=1)
+        best_other = jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
+        margin = true_score - best_other
+        measures = jnp.clip(1 - margin, 0, None)
+        if squared:
+            measures = measures**2
+        measures = jnp.where(valid, measures, 0.0)
+        total = valid.sum()
+        return measures.sum(axis=0), total
+    # one-vs-all: per-class hinge, (C,) output
+    margin = jnp.where(target_oh, preds, -preds)
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures**2
+    measures = jnp.where(valid[:, None], measures, 0.0)
+    total = valid.sum()
+    return measures.sum(axis=0), total
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Multiclass hinge loss (reference ``:179-243``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        _multiclass_hinge_loss_tensor_validation(preds, target, num_classes, ignore_index)
+    if preds.ndim > 2:
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, preds.shape[1])
+        target = target.reshape(-1)
+    preds = preds.astype(jnp.float32)
+    if ignore_index is not None:
+        target = jnp.where(target == ignore_index, -1, target)
+    measures, total = _multiclass_hinge_loss_update(preds, target, squared, multiclass_mode)
+    return _hinge_loss_compute(measures, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching hinge loss (reference ``:246-300``)."""
+    if task == "binary":
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to be one of 'binary', 'multiclass' but got {task}")

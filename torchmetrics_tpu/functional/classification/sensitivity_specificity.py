@@ -1,0 +1,241 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Sensitivity at fixed specificity (reference
+``src/torchmetrics/functional/classification/sensitivity_specificity.py``)."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+
+Array = jax.Array
+
+
+def _convert_fpr_to_specificity(fpr: Array) -> Array:
+    """specificity = 1 - fpr (reference ``:42-44``)."""
+    return 1 - fpr
+
+
+def _sensitivity_at_specificity(
+    sensitivity: Array,
+    specificity: Array,
+    thresholds: Array,
+    min_specificity: float,
+) -> Tuple[Array, Array]:
+    """Max sensitivity whose specificity >= min_specificity (reference ``:47-71``)."""
+    sensitivity, specificity, thresholds = (np.asarray(sensitivity), np.asarray(specificity), np.asarray(thresholds))
+    indices = specificity >= min_specificity
+    if not indices.any():
+        max_sens, best_threshold = 0.0, 1e6
+    else:
+        sensitivity, thresholds = sensitivity[indices], thresholds[indices]
+        idx = int(np.argmax(sensitivity))
+        max_sens, best_threshold = sensitivity[idx], thresholds[idx]
+    return jnp.asarray(max_sens, jnp.float32), jnp.asarray(best_threshold, jnp.float32)
+
+
+def _binary_sensitivity_at_specificity_arg_validation(
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``:74-83``)."""
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if not isinstance(min_specificity, float) or not (0 <= min_specificity <= 1):
+        raise ValueError(
+            f"Expected argument `min_specificity` to be an float in the [0,1] range, but got {min_specificity}"
+        )
+
+
+def _binary_sensitivity_at_specificity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_specificity: float,
+    pos_label: int = 1,
+) -> Tuple[Array, Array]:
+    """ROC → (max sensitivity, threshold) (reference ``:86-94``)."""
+    fpr, sensitivity, thresholds = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = _convert_fpr_to_specificity(fpr)
+    return _sensitivity_at_specificity(sensitivity, specificity, thresholds, min_specificity)
+
+
+def binary_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity at minimum specificity, binary (reference ``:97-167``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_sensitivity_at_specificity_arg_validation(min_specificity, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_sensitivity_at_specificity_compute(state, thresholds, min_specificity)
+
+
+def _multiclass_sensitivity_at_specificity_arg_validation(
+    num_classes: int,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``:170-180``)."""
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if not isinstance(min_specificity, float) or not (0 <= min_specificity <= 1):
+        raise ValueError(
+            f"Expected argument `min_specificity` to be an float in the [0,1] range, but got {min_specificity}"
+        )
+
+
+def _multiclass_sensitivity_at_specificity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_specificity: float,
+) -> Tuple[Array, Array]:
+    """Per-class ROC → per-class (sensitivity, threshold) (reference ``:183-197``)."""
+    fpr, sensitivity, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(state, tuple):
+        res = [
+            _sensitivity_at_specificity(s, _convert_fpr_to_specificity(f), t, min_specificity)
+            for f, s, t in zip(fpr, sensitivity, thresholds)
+        ]
+    else:
+        res = [
+            _sensitivity_at_specificity(sensitivity[i], _convert_fpr_to_specificity(fpr[i]), thresholds, min_specificity)
+            for i in range(num_classes)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity at minimum specificity, multiclass (reference ``:200-277``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_sensitivity_at_specificity_arg_validation(num_classes, min_specificity, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_sensitivity_at_specificity_compute(state, num_classes, thresholds, min_specificity)
+
+
+def _multilabel_sensitivity_at_specificity_arg_validation(
+    num_labels: int,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Validate non-tensor args (reference ``:280-290``)."""
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if not isinstance(min_specificity, float) or not (0 <= min_specificity <= 1):
+        raise ValueError(
+            f"Expected argument `min_specificity` to be an float in the [0,1] range, but got {min_specificity}"
+        )
+
+
+def _multilabel_sensitivity_at_specificity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_specificity: float,
+) -> Tuple[Array, Array]:
+    """Per-label ROC → per-label (sensitivity, threshold) (reference ``:293-308``)."""
+    fpr, sensitivity, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(state, tuple):
+        res = [
+            _sensitivity_at_specificity(s, _convert_fpr_to_specificity(f), t, min_specificity)
+            for f, s, t in zip(fpr, sensitivity, thresholds)
+        ]
+    else:
+        res = [
+            _sensitivity_at_specificity(sensitivity[i], _convert_fpr_to_specificity(fpr[i]), thresholds, min_specificity)
+            for i in range(num_labels)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity at minimum specificity, multilabel (reference ``:311-389``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_sensitivity_at_specificity_arg_validation(num_labels, min_specificity, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_sensitivity_at_specificity_compute(state, num_labels, thresholds, ignore_index, min_specificity)
+
+
+def sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_specificity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching sensitivity at fixed specificity (reference ``:392-437``)."""
+    if task == "binary":
+        return binary_sensitivity_at_specificity(preds, target, min_specificity, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_sensitivity_at_specificity(
+            preds, target, num_classes, min_specificity, thresholds, ignore_index, validate_args
+        )
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_sensitivity_at_specificity(
+            preds, target, num_labels, min_specificity, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Expected argument `task` to be one of 'binary', 'multiclass' or 'multilabel' but got {task}")

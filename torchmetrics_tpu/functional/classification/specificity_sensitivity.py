@@ -1,0 +1,198 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Specificity at fixed sensitivity (reference
+``src/torchmetrics/functional/classification/specificity_sensitivity.py``)."""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.functional.classification.sensitivity_specificity import (
+    _binary_sensitivity_at_specificity_arg_validation,
+    _convert_fpr_to_specificity,
+    _multiclass_sensitivity_at_specificity_arg_validation,
+    _multilabel_sensitivity_at_specificity_arg_validation,
+)
+
+Array = jax.Array
+
+
+def _specificity_at_sensitivity(
+    specificity: Array,
+    sensitivity: Array,
+    thresholds: Array,
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Max specificity whose sensitivity >= min_sensitivity (reference ``:48-72``)."""
+    specificity, sensitivity, thresholds = (np.asarray(specificity), np.asarray(sensitivity), np.asarray(thresholds))
+    indices = sensitivity >= min_sensitivity
+    if not indices.any():
+        max_spec, best_threshold = 0.0, 1e6
+    else:
+        specificity, thresholds = specificity[indices], thresholds[indices]
+        idx = int(np.argmax(specificity))
+        max_spec, best_threshold = specificity[idx], thresholds[idx]
+    return jnp.asarray(max_spec, jnp.float32), jnp.asarray(best_threshold, jnp.float32)
+
+
+def _binary_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+    pos_label: int = 1,
+) -> Tuple[Array, Array]:
+    """ROC → (max specificity, threshold) (reference ``:86-94``)."""
+    fpr, sensitivity, thresholds = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = _convert_fpr_to_specificity(fpr)
+    return _specificity_at_sensitivity(specificity, sensitivity, thresholds, min_sensitivity)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity at minimum sensitivity, binary (reference ``:97-170``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _binary_sensitivity_at_specificity_arg_validation(min_sensitivity, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Per-class ROC → per-class (specificity, threshold) (reference ``:186-200``)."""
+    fpr, sensitivity, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(state, tuple):
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(f), s, t, min_sensitivity)
+            for f, s, t in zip(fpr, sensitivity, thresholds)
+        ]
+    else:
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(fpr[i]), sensitivity[i], thresholds, min_sensitivity)
+            for i in range(num_classes)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity at minimum sensitivity, multiclass (reference ``:203-281``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multiclass_sensitivity_at_specificity_arg_validation(num_classes, min_sensitivity, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    return _multiclass_specificity_at_sensitivity_compute(state, num_classes, thresholds, min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    """Per-label ROC → per-label (specificity, threshold) (reference ``:297-312``)."""
+    fpr, sensitivity, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(state, tuple):
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(f), s, t, min_sensitivity)
+            for f, s, t in zip(fpr, sensitivity, thresholds)
+        ]
+    else:
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(fpr[i]), sensitivity[i], thresholds, min_sensitivity)
+            for i in range(num_labels)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity at minimum sensitivity, multilabel (reference ``:315-392``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if validate_args:
+        _multilabel_sensitivity_at_specificity_arg_validation(num_labels, min_sensitivity, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds)
+    return _multilabel_specificity_at_sensitivity_compute(state, num_labels, thresholds, ignore_index, min_sensitivity)
+
+
+def specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_sensitivity: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching specificity at fixed sensitivity (reference ``:395-444``)."""
+    if task == "binary":
+        return binary_specificity_at_sensitivity(preds, target, min_sensitivity, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_specificity_at_sensitivity(
+            preds, target, num_classes, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_specificity_at_sensitivity(
+            preds, target, num_labels, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Expected argument `task` to be one of 'binary', 'multiclass' or 'multilabel' but got {task}")

@@ -27,7 +27,7 @@ def _tweedie_deviance_domain_check(preds: Array, targets: Array, power: float) -
     if power < 0 and bool(jnp.any(preds <= 0)):
         raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
     if 1 < power < 2 and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
-        raise ValueError(f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative.")
+        raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
     if power > 2 and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
         raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
 
