@@ -1,0 +1,206 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Wrapper suite (reference tests: ``tests/unittests/wrappers/test_*.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from torchmetrics_tpu import MeanSquaredError, MetricCollection, R2Score
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassRecall
+from torchmetrics_tpu.wrappers import (
+    BinaryTargetTransformer,
+    BootStrapper,
+    ClasswiseWrapper,
+    LambdaInputTransformer,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+)
+
+
+def test_bootstrapper():
+    rng = np.random.RandomState(0)
+    preds = rng.randint(0, 5, 256)
+    target = rng.randint(0, 5, 256)
+    boot = BootStrapper(MulticlassAccuracy(num_classes=5, average="micro"), num_bootstraps=20, seed=42)
+    boot.update(preds, target)
+    out = boot.compute()
+    assert set(out) == {"mean", "std"}
+    true_acc = (preds == target).mean()
+    assert abs(float(out["mean"]) - true_acc) < 0.1
+    assert 0 < float(out["std"]) < 0.2
+    # quantile + raw
+    boot2 = BootStrapper(
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        num_bootstraps=10, quantile=0.5, raw=True, sampling_strategy="multinomial", seed=1,
+    )
+    boot2.update(preds, target)
+    out2 = boot2.compute()
+    assert out2["raw"].shape == (10,)
+    assert "quantile" in out2
+    boot2.reset()
+    assert boot2.metrics[0]._update_count == 0
+
+
+def test_classwise_wrapper():
+    rng = np.random.RandomState(1)
+    preds = rng.rand(64, 3).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    target = rng.randint(0, 3, 64)
+    metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["horse", "fish", "dog"])
+    metric.update(preds, target)
+    out = metric.compute()
+    assert set(out) == {"multiclassaccuracy_horse", "multiclassaccuracy_fish", "multiclassaccuracy_dog"}
+    expected = skm.recall_score(target, preds.argmax(-1), average=None, labels=[0, 1, 2])
+    np.testing.assert_allclose(
+        [float(out["multiclassaccuracy_horse"]), float(out["multiclassaccuracy_fish"]), float(out["multiclassaccuracy_dog"])],
+        expected, rtol=1e-5,
+    )
+    # in a collection: flattened keys
+    coll = MetricCollection({
+        "acc": ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), prefix="acc_"),
+        "rec": ClasswiseWrapper(MulticlassRecall(num_classes=3, average=None), prefix="rec_"),
+    })
+    coll.update(preds, target)
+    out = coll.compute()
+    assert "acc_0" in out and "rec_2" in out
+
+
+def test_minmax():
+    m = MinMaxMetric(BinaryAccuracy())
+    p1 = np.array([0.9, 0.9, 0.1]); t1 = np.array([1, 1, 0])      # acc 1.0
+    p2 = np.array([0.1, 0.9, 0.1]); t2 = np.array([1, 0, 1])      # stream acc drops
+    m.update(p1, t1)
+    out1 = m.compute()
+    assert float(out1["raw"]) == 1.0 and float(out1["max"]) == 1.0
+    m.update(p2, t2)
+    out2 = m.compute()
+    assert float(out2["raw"]) < 1.0
+    assert float(out2["max"]) == 1.0
+    assert float(out2["min"]) == float(out2["raw"])
+    m.reset()
+    assert float(m.min_val) == float("inf")
+
+
+def test_multioutput():
+    rng = np.random.RandomState(2)
+    preds = rng.randn(100, 2).astype(np.float32)
+    target = preds + 0.1 * rng.randn(100, 2).astype(np.float32)
+    wrapped = MultioutputWrapper(R2Score(), 2)
+    wrapped.update(preds, target)
+    out = np.asarray(wrapped.compute())
+    expected = [skm.r2_score(target[:, i], preds[:, i]) for i in range(2)]
+    np.testing.assert_allclose(out, expected, rtol=1e-4)
+    # forward returns batch values
+    wrapped.reset()
+    val = wrapped(preds, target)
+    np.testing.assert_allclose(np.asarray(val), expected, rtol=1e-4)
+    # nan removal
+    target_nan = target.copy()
+    target_nan[:5, 0] = np.nan
+    w2 = MultioutputWrapper(MeanSquaredError(), 2)
+    w2.update(preds, target_nan)
+    out2 = np.asarray(w2.compute())
+    exp0 = skm.mean_squared_error(target_nan[5:, 0], preds[5:, 0])
+    exp1 = skm.mean_squared_error(target_nan[:, 1], preds[:, 1])
+    np.testing.assert_allclose(out2, [exp0, exp1], rtol=1e-4)
+
+
+def test_multitask():
+    rng = np.random.RandomState(3)
+    cls_preds = rng.rand(64).astype(np.float32)
+    cls_target = rng.randint(0, 2, 64)
+    reg_preds = rng.randn(64).astype(np.float32)
+    reg_target = reg_preds + 0.1 * rng.randn(64).astype(np.float32)
+    mt = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+    mt.update({"cls": cls_preds, "reg": reg_preds}, {"cls": cls_target, "reg": reg_target})
+    out = mt.compute()
+    np.testing.assert_allclose(float(out["cls"]), ((cls_preds > 0.5) == cls_target).mean(), rtol=1e-5)
+    np.testing.assert_allclose(float(out["reg"]), skm.mean_squared_error(reg_target, reg_preds), rtol=1e-4)
+    with pytest.raises(ValueError, match="same keys"):
+        mt.update({"cls": cls_preds}, {"cls": cls_target})
+    cloned = mt.clone(prefix="p_")
+    assert "p_cls" in dict(cloned.items(flatten=False))
+
+
+def test_tracker():
+    rng = np.random.RandomState(4)
+    tracker = MetricTracker(MulticlassAccuracy(num_classes=3, average="micro"), maximize=True)
+    accs = []
+    for step in range(3):
+        tracker.increment()
+        preds = rng.randint(0, 3, 100)
+        target = preds.copy()
+        flip = rng.rand(100) < (0.5 - 0.2 * step)  # accuracy improves over steps
+        target[flip] = (target[flip] + 1) % 3
+        tracker.update(preds, target)
+        accs.append((preds == target).mean())
+    all_vals = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(all_vals, accs, rtol=1e-5)
+    best, idx = tracker.best_metric(return_step=True)
+    assert idx == int(np.argmax(accs))
+    assert tracker.n_steps == 3
+    with pytest.raises(ValueError, match="increment"):
+        MetricTracker(BinaryAccuracy()).update(np.array([1]), np.array([1]))
+    # collection tracking
+    tc = MetricTracker(MetricCollection([MulticlassAccuracy(num_classes=3, average="micro")]), maximize=[True])
+    tc.increment()
+    tc.update(np.array([0, 1, 2]), np.array([0, 1, 1]))
+    res = tc.compute_all()
+    assert "MulticlassAccuracy" in res
+
+
+def test_transformations():
+    rng = np.random.RandomState(5)
+    preds = rng.rand(64).astype(np.float32)
+    target_raw = rng.randint(0, 5, 64)  # multi-valued target
+    t = BinaryTargetTransformer(BinaryAccuracy(), threshold=2)
+    t.update(preds, target_raw)
+    expected = ((preds > 0.5).astype(int) == (target_raw > 2).astype(int)).mean()
+    np.testing.assert_allclose(float(t.compute()), expected, rtol=1e-5)
+
+    lam = LambdaInputTransformer(MeanSquaredError(), transform_pred=lambda p: p * 2)
+    p = rng.randn(32).astype(np.float32)
+    tt = rng.randn(32).astype(np.float32)
+    lam.update(p, tt)
+    np.testing.assert_allclose(float(lam.compute()), skm.mean_squared_error(tt, p * 2), rtol=1e-4)
+    with pytest.raises(TypeError):
+        LambdaInputTransformer(MeanSquaredError(), transform_pred=3)
+
+
+def test_feature_share():
+    from torchmetrics_tpu.wrappers import FeatureShare
+    from torchmetrics_tpu.metric import Metric
+    import jax.numpy as jnp
+
+    calls = {"n": 0}
+
+    def net(x):
+        calls["n"] += 1
+        return jnp.asarray(x) * 2.0
+
+    class FeatMetric(Metric):
+        feature_network = "net"
+
+        def __init__(self):
+            super().__init__()
+            self.net = net
+            self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + self.net(x).sum()
+
+        def compute(self):
+            return self.total
+
+    class FeatMetric2(FeatMetric):
+        def compute(self):
+            return self.total * 10
+
+    fs = FeatureShare([FeatMetric(), FeatMetric2()])
+    x = np.ones(4, dtype=np.float32)
+    fs.update(x)
+    out = fs.compute()
+    assert calls["n"] == 1  # second metric hit the cache
+    assert float(out["FeatMetric"]) == 8.0 and float(out["FeatMetric2"]) == 80.0

@@ -59,9 +59,6 @@ class BinaryCalibrationError(Metric):
         if self.validate_args:
             _binary_calibration_error_tensor_validation(preds, target, self.ignore_index)
         preds, target = _binary_calibration_error_format(preds, target, self.ignore_index)
-        if self.ignore_index is not None:
-            keep = target != -1
-            preds, target = preds[keep], target[keep]
         confidences, accuracies = _binary_calibration_error_update(preds, target)
         self.confidences.append(confidences)
         self.accuracies.append(accuracies)
@@ -112,9 +109,6 @@ class MulticlassCalibrationError(Metric):
         if self.validate_args:
             _multiclass_calibration_error_tensor_validation(preds, target, self.num_classes, self.ignore_index)
         preds, target = _multiclass_calibration_error_format(preds, target, self.ignore_index)
-        if self.ignore_index is not None:
-            keep = target != -1
-            preds, target = preds[keep], target[keep]
         confidences, accuracies = _multiclass_calibration_error_update(preds, target)
         self.confidences.append(confidences)
         self.accuracies.append(accuracies)

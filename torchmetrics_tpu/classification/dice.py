@@ -45,9 +45,17 @@ class Dice(Metric):
         top_k: int = 1,
         **kwargs: Any,
     ) -> None:
-        # accept-and-ignore legacy kwargs for API parity
-        kwargs.pop("mdmc_average", None)
-        kwargs.pop("multiclass", None)
+        mdmc_average = kwargs.pop("mdmc_average", None)
+        multiclass = kwargs.pop("multiclass", None)
+        if mdmc_average is not None or multiclass is not None:
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "Arguments `mdmc_average` and `multiclass` are accepted for API parity but not implemented:"
+                " Dice always uses global (flattened) reduction. Results may differ from the legacy reference"
+                " for samplewise mdmc averaging.",
+                UserWarning,
+            )
         super().__init__(**kwargs)
         allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
         if average not in allowed_average:

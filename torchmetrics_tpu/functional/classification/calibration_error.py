@@ -14,7 +14,6 @@ import jax
 import jax.numpy as jnp
 
 from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
-from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -24,16 +23,19 @@ def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Ar
 
     Bin membership is computed as a dense one-hot comparison against the bin
     boundaries (the ``_bincount`` one-hot trick of ``utilities/data.py:203-205``),
-    so the whole binning is a single matmul-like reduction.
+    so the whole binning is a single matmul-like reduction. Entries whose
+    confidence lies outside [0, 1] (the ``ignore_index`` sentinel 2.0) are
+    masked out of every bin — shapes stay static under jit/shard_map.
     """
     accuracies = accuracies.astype(confidences.dtype)
     n_bins = bin_boundaries.shape[0] - 1
+    valid = (confidences >= 0) & (confidences <= 1)
     # index of the bin each confidence falls into: boundaries are a linspace on
     # [0, 1]; right-closed bucketize like torch.bucketize(right=True) - 1
     idx = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="right") - 1, 0, n_bins - 1)
-    onehot = (idx[:, None] == jnp.arange(n_bins)[None, :]).astype(confidences.dtype)  # (N, B)
+    onehot = ((idx[:, None] == jnp.arange(n_bins)[None, :]) & valid[:, None]).astype(confidences.dtype)  # (N, B)
     count_bin = onehot.sum(axis=0)
-    conf_bin = _safe_divide(confidences @ onehot, count_bin)
+    conf_bin = _safe_divide(jnp.where(valid, confidences, 0.0) @ onehot, count_bin)
     acc_bin = _safe_divide(accuracies @ onehot, count_bin)
     prop_bin = count_bin / count_bin.sum()
     return acc_bin, conf_bin, prop_bin
@@ -60,7 +62,8 @@ def _ce_compute(
         return jnp.max(jnp.abs(acc_bin - conf_bin))
     ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
     if debias:
-        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * confidences.shape[0] - 1)
+        n_valid = jnp.sum((confidences >= 0) & (confidences <= 1))
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * n_valid - 1)
         ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
     return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
 
@@ -105,12 +108,13 @@ def _binary_calibration_error_format(
 def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     """Top-1 confidences and accuracies (reference ``:136-138``).
 
-    Ignored positions (target == -1) get confidence 0 and land in bin 0 with
-    zero weight via masking by the caller; here we filter host-side free since
-    these are raw `cat` states.
+    Ignored positions (target == -1) are encoded with the out-of-range
+    confidence sentinel 2.0, which ``_binning_bucketize`` masks out of every
+    bin — shapes stay static, so this is jit/shard_map-safe.
     """
-    confidences = jnp.where(preds >= 0.5, preds, 1 - preds)
-    accuracies = (jnp.where(preds >= 0.5, 1, 0) == target).astype(preds.dtype)
+    valid = target >= 0
+    confidences = jnp.where(valid, jnp.where(preds >= 0.5, preds, 1 - preds), 2.0)
+    accuracies = (valid & (jnp.where(preds >= 0.5, 1, 0) == target)).astype(preds.dtype)
     return confidences, accuracies
 
 
@@ -128,10 +132,6 @@ def binary_calibration_error(
         _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
         _binary_calibration_error_tensor_validation(preds, target, ignore_index)
     preds, target = _binary_calibration_error_format(preds, target, ignore_index)
-    if ignore_index is not None:
-        keep = target != -1
-        preds = preds[keep]
-        target = target[keep]
     confidences, accuracies = _binary_calibration_error_update(preds, target)
     return _ce_compute(confidences, accuracies, n_bins, norm)
 
@@ -177,10 +177,15 @@ def _multiclass_calibration_error_format(
 
 
 def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
-    """Top-1 confidence/accuracy per sample (reference ``:238-246``)."""
-    confidences = jnp.max(preds, axis=-1)
+    """Top-1 confidence/accuracy per sample (reference ``:238-246``).
+
+    Ignored positions (target == -1) get the sentinel confidence 2.0 and are
+    masked out of the binning (see :func:`_binning_bucketize`).
+    """
+    valid = target >= 0
+    confidences = jnp.where(valid, jnp.max(preds, axis=-1), 2.0)
     predictions = jnp.argmax(preds, axis=-1)
-    accuracies = (predictions == target).astype(jnp.float32)
+    accuracies = (valid & (predictions == target)).astype(jnp.float32)
     return confidences.astype(jnp.float32), accuracies
 
 
@@ -199,10 +204,6 @@ def multiclass_calibration_error(
         _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
         _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
     preds, target = _multiclass_calibration_error_format(preds, target, ignore_index)
-    if ignore_index is not None:
-        keep = target != -1
-        preds = preds[keep]
-        target = target[keep]
     confidences, accuracies = _multiclass_calibration_error_update(preds, target)
     return _ce_compute(confidences, accuracies, n_bins, norm)
 

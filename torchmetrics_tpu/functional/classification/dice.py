@@ -54,14 +54,20 @@ def _dice_format(
     target = target.reshape(target.shape[0], -1) if target.ndim > 1 else target.reshape(-1)
     if jnp.issubdtype(preds.dtype, jnp.floating):
         preds = (normalize_logits_if_needed(preds, "sigmoid") >= threshold).astype(jnp.int32)
-    if num_classes is not None and num_classes > 2:
+    if num_classes is None:
+        # infer the class count from the labels (host-side; inputs are concrete
+        # here — the jittable path is to pass num_classes explicitly)
+        max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
+        if max_label > 1:
+            num_classes = max_label + 1
+    if num_classes is not None and num_classes >= 2:
         preds_oh = to_onehot(preds.reshape(-1).astype(jnp.int32), num_classes)
         target_oh = to_onehot(target.reshape(-1).astype(jnp.int32), num_classes)
         return preds_oh.astype(jnp.int32), target_oh.astype(jnp.int32)
-    # binary: treat as 2-class one-hot
-    preds_oh = to_onehot(preds.reshape(-1).astype(jnp.int32), 2)
-    target_oh = to_onehot(target.reshape(-1).astype(jnp.int32), 2)
-    return preds_oh.astype(jnp.int32), target_oh.astype(jnp.int32)
+    # binary: score the positive class only (legacy reference semantics)
+    preds_oh = preds.reshape(-1, 1).astype(jnp.int32)
+    target_oh = target.reshape(-1, 1).astype(jnp.int32)
+    return preds_oh, target_oh
 
 
 def _dice_update(preds_oh: Array, target_oh: Array) -> Tuple[Array, Array, Array]:

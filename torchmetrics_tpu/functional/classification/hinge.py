@@ -58,7 +58,7 @@ def binary_hinge_loss(
     target: Array,
     squared: bool = False,
     ignore_index: Optional[int] = None,
-    validate_args: bool = False,
+    validate_args: bool = True,
 ) -> Array:
     """Binary hinge loss (reference ``:70-122``)."""
     preds, target = jnp.asarray(preds), jnp.asarray(target)
@@ -142,7 +142,7 @@ def multiclass_hinge_loss(
     squared: bool = False,
     multiclass_mode: str = "crammer-singer",
     ignore_index: Optional[int] = None,
-    validate_args: bool = False,
+    validate_args: bool = True,
 ) -> Array:
     """Multiclass hinge loss (reference ``:179-243``)."""
     preds, target = jnp.asarray(preds), jnp.asarray(target)

@@ -18,11 +18,6 @@ from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
 Array = jax.Array
 
 
-def _rank_data(x: Array) -> Array:
-    """Max-style tie rank: rank[j] = #{k : x[k] <= x[j]} (reference ``ranking.py:27-33``)."""
-    return jnp.sum(x[None, :] <= x[:, None], axis=1)
-
-
 def _ranking_reduce(score: Array, num_elements: Array) -> Array:
     """Mean over samples (reference ``:36-37``)."""
     return score / num_elements

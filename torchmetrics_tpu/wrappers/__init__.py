@@ -1,7 +1,33 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Wrapper metrics (layer L5) — meta-metrics wrapping a base metric."""
+"""Wrapper metrics (layer L5) — meta-metrics wrapping a base metric
+(reference ``src/torchmetrics/wrappers/``)."""
 from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+from torchmetrics_tpu.wrappers.bootstrapping import BootStrapper
+from torchmetrics_tpu.wrappers.classwise import ClasswiseWrapper
+from torchmetrics_tpu.wrappers.feature_share import FeatureShare
+from torchmetrics_tpu.wrappers.minmax import MinMaxMetric
+from torchmetrics_tpu.wrappers.multioutput import MultioutputWrapper
+from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper
 from torchmetrics_tpu.wrappers.running import Running
+from torchmetrics_tpu.wrappers.tracker import MetricTracker
+from torchmetrics_tpu.wrappers.transformations import (
+    BinaryTargetTransformer,
+    LambdaInputTransformer,
+    MetricInputTransformer,
+)
 
-__all__ = ["WrapperMetric", "Running"]
+__all__ = [
+    "WrapperMetric",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "FeatureShare",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "Running",
+    "MetricTracker",
+    "BinaryTargetTransformer",
+    "LambdaInputTransformer",
+    "MetricInputTransformer",
+]

@@ -1,0 +1,128 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""BootStrapper wrapper (reference ``src/torchmetrics/wrappers/bootstrapping.py``)."""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _apply_to_arrays(data: Any, fn) -> Any:
+    """Apply ``fn`` to every array leaf in args/kwargs collections."""
+    if isinstance(data, (jax.Array, np.ndarray)):
+        return fn(data)
+    if isinstance(data, tuple):
+        return tuple(_apply_to_arrays(d, fn) for d in data)
+    if isinstance(data, list):
+        return [_apply_to_arrays(d, fn) for d in data]
+    if isinstance(data, dict):
+        return {k: _apply_to_arrays(v, fn) for k, v in data.items()}
+    return data
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Resampling indices (reference ``bootstrapping.py:31-51``).
+
+    Host-side numpy sampling: index generation is O(N) scalar work and feeds
+    a device gather; keeping it off-device avoids a tiny jitted program per
+    bootstrap copy.
+    """
+    rng = rng or np.random.default_rng()
+    if sampling_strategy == "poisson":
+        n = rng.poisson(1, size=size)
+        return np.repeat(np.arange(size), n)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size=size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    """Bootstrapped confidence intervals for any metric (reference ``bootstrapping.py:54``).
+
+    Keeps ``num_bootstraps`` copies of the base metric; every ``update``
+    resamples the batch (with replacement) along dim 0 for each copy.
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of torchmetrics.Metric but received {base_metric}")
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.default_rng(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch per bootstrap copy and update it (reference ``:125-146``)."""
+        sizes = []
+        _apply_to_arrays(args, lambda a: sizes.append(len(a)))
+        _apply_to_arrays(kwargs, lambda a: sizes.append(len(a)))
+        if not sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        size = sizes[0]
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            new_args = _apply_to_arrays(args, lambda a: jnp.take(jnp.asarray(a), sample_idx, axis=0))
+            new_kwargs = _apply_to_arrays(kwargs, lambda a: jnp.take(jnp.asarray(a), sample_idx, axis=0))
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean/std/quantile/raw over the bootstrap copies (reference ``:148-165``)."""
+        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Use the base forward: update all copies, return batch value (reference ``:167-169``)."""
+        return super(WrapperMetric, self).forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        """Reset all bootstrap copies (reference ``:171-175``)."""
+        for m in self.metrics:
+            m.reset()
+        super().reset()
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

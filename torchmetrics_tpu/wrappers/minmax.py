@@ -1,0 +1,64 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MinMaxMetric wrapper (reference ``src/torchmetrics/wrappers/minmax.py``)."""
+from __future__ import annotations
+
+from typing import Any, Dict, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the min and max of a base metric over compute calls (reference ``minmax.py:29``)."""
+
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of `torchmetrics.Metric` but received {base_metric}")
+        self._base_metric = base_metric
+        self.add_state("min_val", jnp.asarray(float("inf")), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(float("-inf")), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Delegate update to the base metric (reference ``:81-83``)."""
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Base value + running min/max (reference ``:85-97``)."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        val = jnp.asarray(val)
+        self.max_val = jnp.where(self.max_val < val, val, self.max_val)
+        self.min_val = jnp.where(self.min_val > val, val, self.min_val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Use the original forward of ``Metric`` (reference ``:99-101``)."""
+        return super(WrapperMetric, self).forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        """Reset bounds and base metric (reference ``:103-106``)."""
+        super().reset()
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Union[float, Array]) -> bool:
+        """True for scalars (reference ``:108-115``)."""
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jax.Array, np.ndarray)):
+            return np.asarray(val).size == 1
+        return False
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,100 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MultioutputWrapper (reference ``src/torchmetrics/wrappers/multioutput.py``)."""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+from torchmetrics_tpu.wrappers.bootstrapping import _apply_to_arrays
+
+Array = jax.Array
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where any tensor has a NaN (reference ``multioutput.py:27-39``)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for tensor in tensors:
+        permuted_tensor = tensor.reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted_tensor), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Evaluate one base metric per output dimension (reference ``multioutput.py:43``)."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+    ) -> None:
+        super().__init__()
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[tuple, dict]]:
+        """Slice args/kwargs per output dim, optionally dropping NaN rows
+        (reference ``:107-131``)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def select(a, idx=i):
+                return jnp.take(jnp.asarray(a), jnp.asarray([idx]), axis=self.output_dim)
+
+            selected_args = _apply_to_arrays(args, select)
+            selected_kwargs = _apply_to_arrays(kwargs, select)
+            if self.remove_nans:
+                args_kwargs = tuple(selected_args) + tuple(selected_kwargs.values())
+                nan_idxs = _get_nan_indices(*args_kwargs)
+                selected_args = tuple(arg[~nan_idxs] for arg in selected_args)
+                selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = tuple(arg.squeeze(self.output_dim) for arg in selected_args)
+                selected_kwargs = {k: v.squeeze(self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each output's metric (reference ``:133-137``)."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        """Stack per-output values (reference ``:139-141``)."""
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], 0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Per-output forward values (reference ``:143-155``)."""
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs)
+        ]
+        if any(res is None for res in results):
+            return None
+        return jnp.stack([jnp.asarray(r) for r in results], 0)
+
+    def reset(self) -> None:
+        """Reset all per-output metrics (reference ``:157-161``)."""
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,177 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MetricTracker (reference ``src/torchmetrics/wrappers/tracker.py``)."""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Track a metric (or collection) over time steps (reference ``tracker.py:31``).
+
+    ``increment()`` starts a new step by appending a fresh copy of the base
+    metric; ``update``/``forward``/``compute`` act on the latest copy;
+    ``compute_all``/``best_metric`` aggregate over history.
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics `Metric` or `MetricCollection`"
+                f" but got {metric}"
+            )
+        self._base_metric = metric
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+
+        if maximize is None:
+            if isinstance(metric, Metric):
+                if getattr(metric, "higher_is_better", None) is None:
+                    raise AttributeError(
+                        f"The metric '{metric.__class__.__name__}' does not have a 'higher_is_better' attribute."
+                        " Please provide the `maximize` argument explicitly."
+                    )
+                self.maximize: Union[bool, List[bool]] = metric.higher_is_better
+            else:
+                self.maximize = []
+                for name, m in metric.items():
+                    if getattr(m, "higher_is_better", None) is None:
+                        raise AttributeError(
+                            f"The metric '{name}' does not have a 'higher_is_better' attribute."
+                            " Please provide the `maximize` argument explicitly."
+                        )
+                    self.maximize.append(m.higher_is_better)
+        else:
+            if not isinstance(maximize, (bool, list)):
+                raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+            if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+                raise ValueError("Argument `maximize` should be a list of bool")
+            if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+                raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+            if isinstance(metric, Metric) and not isinstance(maximize, bool):
+                raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+            self.maximize = maximize
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of tracked steps (reference ``:158-160``)."""
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Start a new tracking step (reference ``:162-165``)."""
+        self._increment_called = True
+        self._metrics.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward on the latest copy (reference ``:167-170``)."""
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the latest copy (reference ``:172-175``)."""
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Compute the latest copy (reference ``:177-180``)."""
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Compute all tracked steps (reference ``:182-206``)."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._metrics]
+        try:
+            if isinstance(self._base_metric, MetricCollection):
+                keys = res[0].keys()
+                return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+            return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+        except TypeError:  # ragged outputs
+            return res
+
+    def reset(self) -> None:
+        """Reset the latest copy (reference ``:208-210``)."""
+        if self._metrics:
+            self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        """Reset all tracked copies (reference ``:212-215``)."""
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[
+        None,
+        float,
+        Tuple[float, int],
+        Tuple[None, None],
+        Dict[str, Optional[float]],
+        Tuple[Dict[str, Optional[float]], Dict[str, Optional[int]]],
+    ]:
+        """Best value (and optionally its step) over history (reference ``:217-297``)."""
+        res = self.compute_all()
+        if isinstance(self._base_metric, Metric):
+            try:
+                arr = np.asarray(res)
+                idx = int(np.argmax(arr)) if self.maximize else int(np.argmin(arr))
+                value = float(arr[idx])
+                if return_step:
+                    return value, idx
+                return value
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    "this is probably due to the 'best' not being defined for this metric."
+                    "Returning `None` instead.",
+                    UserWarning,
+                )
+                if return_step:
+                    return None, None
+                return None
+        else:
+            maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    arr = np.asarray(v)
+                    best = int(np.argmax(arr)) if maximize[i] else int(np.argmin(arr))
+                    value[k] = float(arr[best])
+                    idx[k] = best
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{error} this is probably due to the 'best' not being defined for this metric."
+                        "Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value
+
+    def _check_for_increment(self, method: str) -> None:
+        """Guard against use before ``increment`` (reference ``:299-302``)."""
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def plot(self, val=None, ax=None):
+        """Plot tracked values over steps (reference ``:304-341``)."""
+        from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute_all()
+        return plot_single_or_multi_val(val, ax=ax, name=self.__class__.__name__)

@@ -1,0 +1,98 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Input-transforming wrappers (reference ``src/torchmetrics/wrappers/transformations.py``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MetricInputTransformer(WrapperMetric):
+    """Base class: transform inputs, forward everything to the wrapped metric
+    (reference ``transformations.py:23``)."""
+
+    def __init__(self, wrapped_metric: Union[Metric, MetricCollection], **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(wrapped_metric, (Metric, MetricCollection)):
+            raise TypeError(
+                f"Expected wrapped metric to be an instance of `torchmetrics.Metric` or"
+                f" `torchmetrics.MetricsCollection`but received {wrapped_metric}"
+            )
+        self.wrapped_metric = wrapped_metric
+
+    def transform_pred(self, pred: Array) -> Array:
+        """Identity by default (reference ``:40-46``)."""
+        return pred
+
+    def transform_target(self, target: Array) -> Array:
+        """Identity by default (reference ``:48-54``)."""
+        return target
+
+    def _wrap_transform(self, *args: Array) -> tuple:
+        """Dispatch args to their transform functions (reference ``:56-63``)."""
+        if len(args) == 1:
+            return (self.transform_pred(args[0]),)
+        if len(args) == 2:
+            return self.transform_pred(args[0]), self.transform_target(args[1])
+        return (self.transform_pred(args[0]), self.transform_target(args[1]), *args[2:])
+
+    def update(self, *args: Array, **kwargs: Any) -> None:
+        """Transform then update (reference ``:65-68``)."""
+        args = self._wrap_transform(*args)
+        self.wrapped_metric.update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Delegate compute (reference ``:70-72``)."""
+        return self.wrapped_metric.compute()
+
+    def forward(self, *args: Array, **kwargs: Any) -> Any:
+        """Transform then forward (reference ``:74-77``)."""
+        args = self._wrap_transform(*args)
+        return self.wrapped_metric.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        self.wrapped_metric.reset()
+        super().reset()
+
+
+class LambdaInputTransformer(MetricInputTransformer):
+    """Transform inputs with user-provided lambdas (reference ``transformations.py:79``)."""
+
+    def __init__(
+        self,
+        wrapped_metric: Union[Metric, MetricCollection],
+        transform_pred: Optional[Callable[[Array], Array]] = None,
+        transform_target: Optional[Callable[[Array], Array]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(wrapped_metric, **kwargs)
+        if transform_pred is not None:
+            if not callable(transform_pred):
+                raise TypeError(f"Expected `transform_pred` to be a Callable but received {transform_pred}")
+            self.transform_pred = transform_pred  # type: ignore[method-assign]
+        if transform_target is not None:
+            if not callable(transform_target):
+                raise TypeError(f"Expected `transform_target` to be a Callable but received {transform_target}")
+            self.transform_target = transform_target  # type: ignore[method-assign]
+
+
+class BinaryTargetTransformer(MetricInputTransformer):
+    """Threshold targets to {0, 1} (reference ``transformations.py:132``)."""
+
+    def __init__(self, wrapped_metric: Union[Metric, MetricCollection], threshold: float = 0, **kwargs: Any) -> None:
+        super().__init__(wrapped_metric, **kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise TypeError(f"Expected `threshold` to be a float but received {threshold}")
+        self.threshold = threshold
+
+    def transform_target(self, target: Array) -> Array:
+        """Cast targets to binary by thresholding (reference ``:170-172``)."""
+        return (jnp.asarray(target) > self.threshold).astype(jnp.int32)
