@@ -1,0 +1,195 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Clustering + nominal suites vs sklearn/scipy oracles (reference tests:
+``tests/unittests/clustering/*.py``, ``tests/unittests/nominal/*.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+from scipy.stats import contingency
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.clustering import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.nominal import CramersV, FleissKappa, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+
+N = 128
+
+
+def _labels(seed=0, k=5):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, k, N), rng.randint(0, k, N)
+
+
+@pytest.mark.parametrize(
+    ("fn", "cls", "oracle"),
+    [
+        (F.mutual_info_score, MutualInfoScore, skm.mutual_info_score),
+        (F.adjusted_mutual_info_score, AdjustedMutualInfoScore, skm.adjusted_mutual_info_score),
+        (F.normalized_mutual_info_score, NormalizedMutualInfoScore, skm.normalized_mutual_info_score),
+        (F.rand_score, RandScore, skm.rand_score),
+        (F.adjusted_rand_score, AdjustedRandScore, skm.adjusted_rand_score),
+        (F.fowlkes_mallows_index, FowlkesMallowsIndex, skm.fowlkes_mallows_score),
+        (F.homogeneity_score, None, skm.homogeneity_score),
+        (F.completeness_score, None, skm.completeness_score),
+        (F.v_measure_score, VMeasureScore, skm.v_measure_score),
+    ],
+)
+def test_extrinsic_clustering(fn, cls, oracle):
+    preds, target = _labels(3)
+    # sklearn's convention: oracle(labels_true, labels_pred); reference passes (preds, target)
+    expected = oracle(target, preds)
+    np.testing.assert_allclose(float(fn(preds, target)), expected, rtol=1e-4, atol=1e-6)
+    if cls is not None:
+        m = cls()
+        for i in range(4):
+            m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+        np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4, atol=1e-6)
+
+
+def test_intrinsic_clustering():
+    rng = np.random.RandomState(7)
+    data = rng.randn(N, 4).astype(np.float32) + 3 * rng.randint(0, 3, (N, 1))
+    labels = rng.randint(0, 3, N)
+    np.testing.assert_allclose(
+        float(F.calinski_harabasz_score(data, labels)), skm.calinski_harabasz_score(data, labels), rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        float(F.davies_bouldin_score(data, labels)), skm.davies_bouldin_score(data, labels), rtol=1e-3
+    )
+    m = CalinskiHarabaszScore()
+    m.update(data[:64], labels[:64]); m.update(data[64:], labels[64:])
+    np.testing.assert_allclose(float(m.compute()), skm.calinski_harabasz_score(data, labels), rtol=1e-3)
+    m = DaviesBouldinScore()
+    m.update(data, labels)
+    np.testing.assert_allclose(float(m.compute()), skm.davies_bouldin_score(data, labels), rtol=1e-3)
+    # dunn index: oracle = manual centroid-based computation
+    cents = np.stack([data[labels == k].mean(0) for k in range(3)])
+    inter = [np.linalg.norm(cents[a] - cents[b]) for a in range(3) for b in range(a + 1, 3)]
+    intra = [np.linalg.norm(data[labels == k] - cents[k], axis=1).max() for k in range(3)]
+    np.testing.assert_allclose(float(F.dunn_index(data, labels)), min(inter) / max(intra), rtol=1e-4)
+    m = DunnIndex()
+    m.update(data, labels)
+    np.testing.assert_allclose(float(m.compute()), min(inter) / max(intra), rtol=1e-4)
+
+
+def test_cramers_and_friends():
+    preds, target = _labels(11, k=4)
+
+    def chi2_stats(p, t, correction):
+        cm = np.zeros((4, 4))
+        for a, b in zip(p, t):
+            cm[a, b] += 1
+        cm = cm[cm.sum(1) != 0][:, cm.sum(0) != 0]
+        chi2 = contingency.chi2_contingency(cm, correction=correction)[0]
+        return chi2, cm
+
+    # bias_correction=False matches scipy chi2 (no Yates unless df==1)
+    chi2, cm = chi2_stats(preds, target, False)
+    n = cm.sum()
+    phi2 = chi2 / n
+    r, c = cm.shape
+    expected_v = np.sqrt(phi2 / min(r - 1, c - 1))
+    np.testing.assert_allclose(float(F.cramers_v(preds, target, bias_correction=False)), expected_v, rtol=1e-4)
+    expected_p = np.sqrt(phi2 / (1 + phi2))
+    np.testing.assert_allclose(float(F.pearsons_contingency_coefficient(preds, target)), expected_p, rtol=1e-4)
+    expected_t = np.sqrt(phi2 / np.sqrt((r - 1) * (c - 1)))
+    np.testing.assert_allclose(float(F.tschuprows_t(preds, target, bias_correction=False)), expected_t, rtol=1e-4)
+
+    # streamed module path
+    m = CramersV(num_classes=4, bias_correction=False)
+    for i in range(4):
+        m.update(preds[i * 32 : (i + 1) * 32], target[i * 32 : (i + 1) * 32])
+    np.testing.assert_allclose(float(m.compute()), expected_v, rtol=1e-4)
+    m = PearsonsContingencyCoefficient(num_classes=4)
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), expected_p, rtol=1e-4)
+    m = TschuprowsT(num_classes=4, bias_correction=False)
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), expected_t, rtol=1e-4)
+
+    # bias-corrected variant matches the published bias-corrected formula
+    phi2c = max(0.0, phi2 - (r - 1) * (c - 1) / (n - 1))
+    rc = r - (r - 1) ** 2 / (n - 1)
+    cc = c - (c - 1) ** 2 / (n - 1)
+    chi2_y, _ = chi2_stats(preds, target, True)
+    np.testing.assert_allclose(
+        float(F.cramers_v(preds, target, bias_correction=True)),
+        np.sqrt(phi2c / min(rc - 1, cc - 1)),
+        rtol=1e-4,
+    )
+
+
+def test_theils_u():
+    preds, target = _labels(13, k=4)
+
+    # oracle: U(X|Y) with X=preds, Y=target per the reference formula
+    def entropy(x):
+        p = np.bincount(x) / len(x)
+        p = p[p > 0]
+        return -(p * np.log(p)).sum()
+
+    # confusion-matrix orientation matches the reference bincount trick:
+    # rows = target, cols = preds
+    cm = np.zeros((4, 4))
+    for a, b in zip(preds, target):
+        cm[b, a] += 1
+    n = cm.sum()
+    p_xy = cm / n
+    p_y = cm.sum(1) / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s_xy = np.nansum(p_xy * np.log(np.where(p_xy > 0, p_y[:, None] / p_xy, 1)))
+    s_x = entropy(preds)
+    expected = (s_x - s_xy) / s_x
+    np.testing.assert_allclose(float(F.theils_u(preds, target)), expected, rtol=1e-4)
+    m = TheilsU(num_classes=4)
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_fleiss_kappa():
+    # classic Fleiss worked example (Wikipedia): kappa ~= 0.2099
+    counts = np.array(
+        [
+            [0, 0, 0, 0, 14],
+            [0, 2, 6, 4, 2],
+            [0, 0, 3, 5, 6],
+            [0, 3, 9, 2, 0],
+            [2, 2, 8, 1, 1],
+            [7, 7, 0, 0, 0],
+            [3, 2, 6, 3, 0],
+            [2, 5, 3, 2, 2],
+            [6, 5, 2, 1, 0],
+            [0, 2, 2, 3, 7],
+        ],
+        dtype=np.int32,
+    )
+    v = float(F.fleiss_kappa(counts))
+    np.testing.assert_allclose(v, 0.2099, atol=1e-3)
+    m = FleissKappa(mode="counts")
+    m.update(counts[:5]); m.update(counts[5:])
+    np.testing.assert_allclose(float(m.compute()), v, atol=1e-6)
+    # probs mode smoke test
+    rng = np.random.RandomState(0)
+    probs = rng.rand(10, 5, 3).astype(np.float32)
+    assert np.isfinite(float(F.fleiss_kappa(probs, mode="probs")))
+
+
+def test_matrix_variants():
+    rng = np.random.RandomState(17)
+    matrix = rng.randint(0, 3, (64, 3))
+    out = np.asarray(F.cramers_v_matrix(matrix, bias_correction=False))
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(np.diag(out), 1.0)
+    np.testing.assert_allclose(out, out.T, atol=1e-6)
+    u = np.asarray(F.theils_u_matrix(matrix))
+    assert u.shape == (3, 3)

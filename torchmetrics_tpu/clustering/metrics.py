@@ -1,0 +1,201 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Clustering module metrics (reference ``src/torchmetrics/clustering/*.py``).
+
+Two state machines:
+
+- extrinsic (label-vs-label) metrics keep ``preds``/``target`` as ``cat``
+  list states and evaluate the functional kernel on the concatenated stream
+  at ``compute`` (cluster ids are arbitrary, so per-batch contingency
+  matrices cannot be merged);
+- intrinsic (data-vs-label) metrics keep ``data``/``labels`` the same way.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from torchmetrics_tpu.functional.clustering.utils import _validate_average_method_arg
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _LabelPairClusteringMetric(Metric):
+    """Shared cat-state machine for extrinsic clustering metrics
+    (e.g. reference ``clustering/mutual_info_score.py:30``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append predicted and target cluster labels."""
+        import jax.numpy as jnp
+
+        self.preds.append(jnp.asarray(preds))
+        self.target.append(jnp.asarray(target))
+
+    def _compute(self, fn, *args: Any) -> Array:
+        return fn(dim_zero_cat(self.preds), dim_zero_cat(self.target), *args)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class MutualInfoScore(_LabelPairClusteringMetric):
+    """Mutual information score (reference ``clustering/mutual_info_score.py:30``)."""
+
+    def compute(self) -> Array:
+        return self._compute(mutual_info_score)
+
+
+class AdjustedMutualInfoScore(_LabelPairClusteringMetric):
+    """Adjusted mutual info score (reference ``clustering/adjusted_mutual_info_score.py:31``)."""
+
+    plot_lower_bound = -1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def compute(self) -> Array:
+        return self._compute(adjusted_mutual_info_score, self.average_method)
+
+
+class NormalizedMutualInfoScore(_LabelPairClusteringMetric):
+    """Normalized mutual info score (reference ``clustering/normalized_mutual_info_score.py:31``)."""
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def compute(self) -> Array:
+        return self._compute(normalized_mutual_info_score, self.average_method)
+
+
+class RandScore(_LabelPairClusteringMetric):
+    """Rand score (reference ``clustering/rand_score.py:30``)."""
+
+    def compute(self) -> Array:
+        return self._compute(rand_score)
+
+
+class AdjustedRandScore(_LabelPairClusteringMetric):
+    """Adjusted Rand score (reference ``clustering/adjusted_rand_score.py:30``)."""
+
+    plot_lower_bound = -0.5
+
+    def compute(self) -> Array:
+        return self._compute(adjusted_rand_score)
+
+
+class FowlkesMallowsIndex(_LabelPairClusteringMetric):
+    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:30``)."""
+
+    def compute(self) -> Array:
+        return self._compute(fowlkes_mallows_index)
+
+
+class HomogeneityScore(_LabelPairClusteringMetric):
+    """Homogeneity score (reference ``clustering/homogeneity_completeness_v_measure.py:31``)."""
+
+    def compute(self) -> Array:
+        return self._compute(homogeneity_score)
+
+
+class CompletenessScore(_LabelPairClusteringMetric):
+    """Completeness score (reference ``clustering/homogeneity_completeness_v_measure.py:113``)."""
+
+    def compute(self) -> Array:
+        return self._compute(completeness_score)
+
+
+class VMeasureScore(_LabelPairClusteringMetric):
+    """V-measure score (reference ``clustering/homogeneity_completeness_v_measure.py:195``)."""
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = beta
+
+    def compute(self) -> Array:
+        return self._compute(v_measure_score, self.beta)
+
+
+class _IntrinsicClusteringMetric(Metric):
+    """Shared cat-state machine for intrinsic (embedded-data) metrics
+    (e.g. reference ``clustering/calinski_harabasz_score.py:30``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", [], dist_reduce_fx="cat")
+        self.add_state("labels", [], dist_reduce_fx="cat")
+
+    def update(self, data: Array, labels: Array) -> None:
+        """Append embedded data and their cluster labels."""
+        import jax.numpy as jnp
+
+        self.data.append(jnp.asarray(data))
+        self.labels.append(jnp.asarray(labels))
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class CalinskiHarabaszScore(_IntrinsicClusteringMetric):
+    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:30``)."""
+
+    def compute(self) -> Array:
+        return calinski_harabasz_score(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class DaviesBouldinScore(_IntrinsicClusteringMetric):
+    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:30``)."""
+
+    higher_is_better = False
+
+    def compute(self) -> Array:
+        return davies_bouldin_score(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class DunnIndex(_IntrinsicClusteringMetric):
+    """Dunn index (reference ``clustering/dunn_index.py:29``)."""
+
+    def __init__(self, p: float = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def compute(self) -> Array:
+        return dunn_index(dim_zero_cat(self.data), dim_zero_cat(self.labels), self.p)

@@ -6,7 +6,11 @@ Flat namespace mirroring reference ``src/torchmetrics/functional/__init__.py``.
 """
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
+from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 
-__all__ = list(_classification_all) + list(_regression_all)
+__all__ = list(_classification_all) + list(_clustering_all) + list(_nominal_all) + list(_regression_all)

@@ -1,0 +1,89 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Adjusted mutual information (reference
+``src/torchmetrics/functional/clustering/adjusted_mutual_info_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.mutual_info_score import (
+    _mutual_info_score_compute,
+    _mutual_info_score_update,
+)
+from torchmetrics_tpu.functional.clustering.utils import (
+    _validate_average_method_arg,
+    calculate_entropy,
+    calculate_generalized_mean,
+)
+
+Array = jax.Array
+
+
+def expected_mutual_info_score(contingency: Array, n_samples: int) -> Array:
+    """Expected MI of two random clusterings with fixed marginals
+    (reference ``:78-131``, sklearn's hypergeometric model).
+
+    The reference's triple Python loop over (i, j, nij) becomes a dense
+    masked grid evaluated per nij-chunk. This is terminal compute-time work
+    on small (R, C) marginals, so it runs host-side in numpy float64 with the
+    nij axis chunked to bound memory at ``R*C*chunk`` even when the largest
+    cluster holds millions of samples.
+    """
+    import numpy as np
+    from scipy.special import gammaln
+
+    a = np.ravel(np.asarray(contingency).sum(axis=1)).astype(np.float64)
+    b = np.ravel(np.asarray(contingency).sum(axis=0)).astype(np.float64)
+    if a.shape[0] == 1 or b.shape[0] == 1:
+        return jnp.asarray(0.0)
+
+    n = float(n_samples)
+    max_nij = int(min(a.max(), b.max()))
+    log_a = np.log(a)[:, None, None]
+    log_b = np.log(b)[None, :, None]
+    gln_a = gammaln(a + 1)[:, None, None]
+    gln_b = gammaln(b + 1)[None, :, None]
+    gln_na = gammaln(n - a + 1)[:, None, None]
+    gln_nb = gammaln(n - b + 1)[None, :, None]
+    gln_n = gammaln(n + 1)
+    aij = a[:, None, None]
+    bij = b[None, :, None]
+
+    emi = 0.0
+    chunk = 1 << 14
+    for lo in range(1, max_nij + 1, chunk):
+        nij = np.arange(lo, min(lo + chunk, max_nij + 1), dtype=np.float64)[None, None, :]
+        # valid hypergeometric support: max(1, a+b-n) <= nij <= min(a, b)
+        start = np.maximum(1.0, aij + bij - n)
+        end = np.minimum(aij, bij)
+        valid = (nij >= start) & (nij <= end)
+        nij_c = np.where(valid, nij, 1.0)  # clamp so lgamma args stay positive
+        term1 = nij_c / n
+        term2 = np.log(n) + np.log(nij_c) - log_a - log_b
+        gln = (
+            gln_a + gln_b + gln_na + gln_nb - (gammaln(nij_c + 1) + gln_n)
+            - gammaln(aij - nij_c + 1)
+            - gammaln(bij - nij_c + 1)
+            - gammaln(n - aij - bij + nij_c + 1)
+        )
+        emi += float(np.where(valid, term1 * term2 * np.exp(gln), 0.0).sum())
+    return jnp.asarray(emi, dtype=jnp.float32)
+
+
+def adjusted_mutual_info_score(
+    preds: Array, target: Array, average_method: str = "arithmetic"
+) -> Array:
+    """Adjusted mutual information (reference ``:24-75``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _validate_average_method_arg(average_method)
+    contingency = _mutual_info_score_update(preds, target)
+    mutual_info = _mutual_info_score_compute(contingency)
+    expected_mutual_info = expected_mutual_info_score(contingency, preds.size)
+    normalizer = calculate_generalized_mean(
+        jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
+    )
+    denominator = normalizer - expected_mutual_info
+    eps = jnp.finfo(jnp.float32).eps
+    denominator = jnp.where(denominator < 0, jnp.minimum(denominator, -eps), jnp.maximum(denominator, eps))
+    return (mutual_info - expected_mutual_info) / denominator

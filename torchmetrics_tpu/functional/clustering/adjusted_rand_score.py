@@ -1,0 +1,41 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Adjusted Rand score (reference ``src/torchmetrics/functional/clustering/adjusted_rand_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    calculate_contingency_matrix,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def _adjusted_rand_score_update(preds: Array, target: Array) -> Array:
+    """Contingency matrix (reference ``adjusted_rand_score.py:22-36``)."""
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target)
+
+
+def _adjusted_rand_score_compute(contingency: Array) -> Array:
+    """ARI from the pair confusion matrix (reference ``:39-53``)."""
+    import numpy as np
+
+    pair_matrix = np.asarray(calculate_pair_cluster_confusion_matrix(contingency=contingency), dtype=np.float64)
+    (tn, fp), (fn, tp) = pair_matrix[0], pair_matrix[1]
+    if fn == 0 and fp == 0:
+        return jnp.asarray(1.0)
+    return jnp.asarray(
+        2.0 * (tp * tn - fn * fp) / ((tp + fn) * (fn + tn) + (tp + fp) * (fp + tn)), dtype=jnp.float32
+    )
+
+
+def adjusted_rand_score(preds: Array, target: Array) -> Array:
+    """Adjusted Rand score between two clusterings (reference ``:56-83``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    contingency = _adjusted_rand_score_update(preds, target)
+    return _adjusted_rand_score_compute(contingency)

@@ -1,0 +1,36 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Calinski-Harabasz score (reference ``src/torchmetrics/functional/clustering/calinski_harabasz_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _cluster_stats,
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+)
+
+Array = jax.Array
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Between- vs within-cluster dispersion ratio (reference ``:22-62``).
+
+    Per-cluster means/dispersions come from one-hot segment reductions rather
+    than the reference's per-cluster boolean-index loop.
+    """
+    data, labels = jnp.asarray(data), jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    inverse, counts, centroids = _cluster_stats(data, labels)
+    num_labels = counts.shape[0]
+    num_samples = data.shape[0]
+    _validate_intrinsic_labels_to_samples(num_labels, num_samples)
+
+    mean = data.mean(axis=0)
+    between = (counts * ((centroids - mean[None, :]) ** 2).sum(axis=1)).sum()
+    within = ((data - centroids[inverse]) ** 2).sum()
+    if bool(within == 0):
+        return jnp.asarray(1.0)
+    return between * (num_samples - num_labels) / (within * (num_labels - 1.0))

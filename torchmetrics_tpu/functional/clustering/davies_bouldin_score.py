@@ -1,0 +1,40 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Davies-Bouldin score (reference ``src/torchmetrics/functional/clustering/davies_bouldin_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _cluster_stats,
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+)
+
+Array = jax.Array
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Mean worst-case intra/inter cluster distance ratio (reference ``:22-66``)."""
+    data, labels = jnp.asarray(data), jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    inverse, counts, centroids = _cluster_stats(data, labels)
+    num_labels = counts.shape[0]
+    num_samples = data.shape[0]
+    _validate_intrinsic_labels_to_samples(num_labels, num_samples)
+
+    # per-cluster mean distance to centroid via one-hot segment mean
+    dists = jnp.sqrt(((data - centroids[inverse]) ** 2).sum(axis=1))
+    onehot = jax.nn.one_hot(inverse, num_labels, dtype=data.dtype)
+    intra_dists = (onehot.T @ dists) / counts
+
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    centroid_distances = jnp.sqrt((diff**2).sum(axis=-1))
+
+    if bool(jnp.allclose(intra_dists, 0.0)) or bool(jnp.allclose(centroid_distances, 0.0)):
+        return jnp.asarray(0.0)
+    centroid_distances = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
+    combined_intra = intra_dists[None, :] + intra_dists[:, None]
+    scores = (combined_intra / centroid_distances).max(axis=1)
+    return scores.mean()

@@ -1,0 +1,43 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Dunn index (reference ``src/torchmetrics/functional/clustering/dunn_index.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import _cluster_stats
+
+Array = jax.Array
+
+
+def _dunn_index_update(data: Array, labels: Array, p: float) -> Tuple[Array, Array]:
+    """Pairwise inter-centroid distances + per-cluster max intra distance
+    (reference ``dunn_index.py:22-45``), fully vectorized."""
+    data = data.astype(jnp.float32)
+    inverse, counts, centroids = _cluster_stats(data, labels)
+    num_labels = counts.shape[0]
+
+    diff = centroids[:, None, :] - centroids[None, :, :]  # (K, K, d)
+    dist = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu = jnp.triu_indices(num_labels, k=1)
+    intercluster_distance = dist[iu]
+
+    sample_dist = jnp.sum(jnp.abs(data - centroids[inverse]) ** p, axis=-1) ** (1.0 / p)
+    onehot = jax.nn.one_hot(inverse, num_labels, dtype=data.dtype)
+    max_intracluster_distance = jnp.max(jnp.where(onehot > 0, sample_dist[:, None], -jnp.inf), axis=0)
+    return intercluster_distance, max_intracluster_distance
+
+
+def _dunn_index_compute(intercluster_distance: Array, max_intracluster_distance: Array) -> Array:
+    """min inter / max intra (reference ``:48-60``)."""
+    return intercluster_distance.min() / max_intracluster_distance.max()
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2) -> Array:
+    """Dunn index of a clustering of embedded data (reference ``:63-88``)."""
+    data, labels = jnp.asarray(data), jnp.asarray(labels)
+    pairwise_distance, max_distance = _dunn_index_update(data, labels, p)
+    return _dunn_index_compute(pairwise_distance, max_distance)

@@ -1,0 +1,43 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Fowlkes-Mallows index (reference ``src/torchmetrics/functional/clustering/fowlkes_mallows_index.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import calculate_contingency_matrix, check_cluster_labels
+
+Array = jax.Array
+
+
+def _fowlkes_mallows_index_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Contingency matrix + sample count (reference ``fowlkes_mallows_index.py:22-37``)."""
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target), preds.shape[0]
+
+
+def _fowlkes_mallows_index_compute(contingency: Array, n: int) -> Array:
+    """FMI from the contingency matrix (reference ``:40-58``).
+
+    Squared marginal sums overflow int32 past ~46k samples, so the terminal
+    (non-jitted) reduction runs host-side in int64.
+    """
+    import numpy as np
+
+    cont = np.asarray(contingency).astype(np.int64)
+    tk = float((cont**2).sum() - n)
+    if np.isclose(tk, 0):
+        return jnp.asarray(0.0)
+    pk = float((cont.sum(axis=0).astype(np.int64) ** 2).sum() - n)
+    qk = float((cont.sum(axis=1).astype(np.int64) ** 2).sum() - n)
+    return jnp.asarray(np.sqrt(tk / pk) * np.sqrt(tk / qk), dtype=jnp.float32)
+
+
+def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
+    """Fowlkes-Mallows index between two clusterings (reference ``:61-84``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    contingency, n = _fowlkes_mallows_index_update(preds, target)
+    return _fowlkes_mallows_index_compute(contingency, n)

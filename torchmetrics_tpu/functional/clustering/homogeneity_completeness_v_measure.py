@@ -1,0 +1,58 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Homogeneity / completeness / V-measure (reference
+``src/torchmetrics/functional/clustering/homogeneity_completeness_v_measure.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.mutual_info_score import mutual_info_score
+from torchmetrics_tpu.functional.clustering.utils import calculate_entropy, check_cluster_labels
+
+Array = jax.Array
+
+
+def _homogeneity_score_compute(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array]:
+    """homogeneity = MI / H(target) (reference ``:24-37``)."""
+    check_cluster_labels(preds, target)
+    if target.size == 0:
+        zero = jnp.asarray(0.0)
+        return zero, zero, zero, zero
+    entropy_target = calculate_entropy(target)
+    entropy_preds = calculate_entropy(preds)
+    mutual_info = mutual_info_score(preds, target)
+    homogeneity = jnp.where(entropy_target != 0, mutual_info / jnp.where(entropy_target != 0, entropy_target, 1.0), 1.0)
+    return homogeneity, mutual_info, entropy_preds, entropy_target
+
+
+def _completeness_score_compute(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """completeness = MI / H(preds) (reference ``:40-46``)."""
+    homogeneity, mutual_info, entropy_preds, _ = _homogeneity_score_compute(preds, target)
+    completeness = jnp.where(entropy_preds != 0, mutual_info / jnp.where(entropy_preds != 0, entropy_preds, 1.0), 1.0)
+    return completeness, homogeneity
+
+
+def homogeneity_score(preds: Array, target: Array) -> Array:
+    """Homogeneity: each cluster contains only one class (reference ``:49-74``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    homogeneity, _, _, _ = _homogeneity_score_compute(preds, target)
+    return homogeneity
+
+
+def completeness_score(preds: Array, target: Array) -> Array:
+    """Completeness: all members of a class are in one cluster (reference ``:77-102``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    completeness, _ = _completeness_score_compute(preds, target)
+    return completeness
+
+
+def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
+    """Weighted harmonic mean of homogeneity and completeness (reference ``:105-135``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    completeness, homogeneity = _completeness_score_compute(preds, target)
+    if bool(homogeneity + completeness == 0):
+        return jnp.asarray(0.0)
+    return (1 + beta) * homogeneity * completeness / (beta * homogeneity + completeness)

@@ -1,0 +1,41 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Mutual information score (reference ``src/torchmetrics/functional/clustering/mutual_info_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import calculate_contingency_matrix, check_cluster_labels
+
+Array = jax.Array
+
+
+def _mutual_info_score_update(preds: Array, target: Array) -> Array:
+    """Contingency matrix (reference ``mutual_info_score.py:24-38``)."""
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target)
+
+
+def _mutual_info_score_compute(contingency: Array) -> Array:
+    """MI from the contingency matrix (reference ``:41-64``).
+
+    The reference gathers nonzero entries; here zero entries contribute 0 via
+    masking — static shapes.
+    """
+    n = contingency.sum()
+    u = contingency.sum(axis=1)
+    v = contingency.sum(axis=0)
+    if u.shape[0] == 1 or v.shape[0] == 1:
+        return jnp.asarray(0.0)
+    nz = contingency > 0
+    log_outer = jnp.log(jnp.maximum(u, 1))[:, None] + jnp.log(jnp.maximum(v, 1))[None, :]
+    terms = contingency / n * (jnp.log(n) + jnp.log(jnp.maximum(contingency, 1)) - log_outer)
+    return jnp.where(nz, terms, 0.0).sum()
+
+
+def mutual_info_score(preds: Array, target: Array) -> Array:
+    """Mutual information between two clusterings (reference ``:67-93``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    contingency = _mutual_info_score_update(preds, target)
+    return _mutual_info_score_compute(contingency)

@@ -1,0 +1,32 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Normalized mutual information (reference
+``src/torchmetrics/functional/clustering/normalized_mutual_info_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.mutual_info_score import mutual_info_score
+from torchmetrics_tpu.functional.clustering.utils import (
+    _validate_average_method_arg,
+    calculate_entropy,
+    calculate_generalized_mean,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def normalized_mutual_info_score(preds: Array, target: Array, average_method: str = "arithmetic") -> Array:
+    """NMI = MI / gen_mean(H(preds), H(target)) (reference ``:24-66``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    check_cluster_labels(preds, target)
+    _validate_average_method_arg(average_method)
+    mutual_info = mutual_info_score(preds, target)
+    if bool(jnp.isclose(mutual_info, 0.0, atol=jnp.finfo(jnp.float32).eps)):
+        return mutual_info
+    normalizer = calculate_generalized_mean(
+        jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
+    )
+    return mutual_info / normalizer

@@ -1,0 +1,41 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Rand score (reference ``src/torchmetrics/functional/clustering/rand_score.py``)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    calculate_contingency_matrix,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def _rand_score_update(preds: Array, target: Array) -> Array:
+    """Contingency matrix (reference ``rand_score.py:22-36``)."""
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target)
+
+
+def _rand_score_compute(contingency: Array) -> Array:
+    """Rand score from the contingency matrix (reference ``:39-60``)."""
+    import numpy as np
+
+    pair_matrix = np.asarray(calculate_pair_cluster_confusion_matrix(contingency=contingency), dtype=np.float64)
+    numerator = np.diagonal(pair_matrix).sum()
+    denominator = pair_matrix.sum()
+    if numerator == denominator or denominator == 0:
+        # trivial clusterings are perfect matches (reference ``:52-56``)
+        return jnp.asarray(1.0)
+    return jnp.asarray(numerator / denominator, dtype=jnp.float32)
+
+
+def rand_score(preds: Array, target: Array) -> Array:
+    """Rand score between two clusterings (reference ``:63-89``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    contingency = _rand_score_update(preds, target)
+    return _rand_score_compute(contingency)

@@ -1,0 +1,160 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Clustering helpers (reference ``src/torchmetrics/functional/clustering/utils.py``).
+
+TPU-native formulation: the contingency matrix and all per-cluster statistics
+are one-hot segment reductions (matmul-shaped, static once the label count is
+known) instead of the reference's boolean-indexing loops.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def is_nonnegative(x: Array, atol: float = 1e-5) -> bool:
+    """Return True if all elements are nonnegative within tolerance (reference ``:23-34``)."""
+    return bool(jnp.all(x >= -atol))
+
+
+def _validate_average_method_arg(average_method: str = "arithmetic") -> None:
+    """Validate the generalized-mean method (reference ``:37-44``)."""
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+            f" but got {average_method}"
+        )
+
+
+def calculate_entropy(x: Array) -> Array:
+    """Entropy of a label tensor, in log form for roundoff (reference ``:47-75``)."""
+    if x.size == 0:
+        return jnp.asarray(1.0)
+    _, inverse = jnp.unique(x, return_inverse=True)
+    p = jnp.bincount(inverse.reshape(-1))
+    p = p[p > 0]
+    if p.size == 1:
+        return jnp.asarray(0.0)
+    n = p.sum()
+    return -jnp.sum((p / n) * (jnp.log(p) - jnp.log(n)))
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, float, str]) -> Array:
+    """Generalized (power) mean (reference ``:78-116``)."""
+    if jnp.iscomplexobj(x) or not is_nonnegative(x):
+        raise ValueError("`x` must contain positive real numbers")
+    if isinstance(p, str):
+        if p == "min":
+            return x.min()
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(x)))
+        if p == "arithmetic":
+            return x.mean()
+        if p == "max":
+            return x.max()
+        raise ValueError("'method' must be 'min', 'geometric', 'arirthmetic', or 'max'")
+    return jnp.mean(x**p) ** (1.0 / p)
+
+
+def calculate_contingency_matrix(
+    preds: Array,
+    target: Array,
+    eps: Optional[float] = None,
+) -> Array:
+    """Contingency matrix between two clusterings (reference ``:119-173``).
+
+    Built as a single bincount over ``row * n_cols + col`` after relabeling
+    with ``unique`` inverses — the confusion-matrix trick of
+    ``functional/classification/stat_scores.py:412-418``.
+    """
+    preds_classes, preds_idx = jnp.unique(preds.reshape(-1), return_inverse=True)
+    target_classes, target_idx = jnp.unique(target.reshape(-1), return_inverse=True)
+    n_rows = int(preds_classes.shape[0])
+    n_cols = int(target_classes.shape[0])
+    linear = preds_idx.reshape(-1) * n_cols + target_idx.reshape(-1)
+    contingency = jnp.bincount(linear, length=n_rows * n_cols).reshape(n_rows, n_cols)
+    if eps is not None:
+        contingency = contingency + eps
+    return contingency
+
+
+def _is_real_discrete_label(x: Array) -> bool:
+    """True for 1D integer label tensors (reference ``:176-180``)."""
+    if x.ndim != 1:
+        raise ValueError(f"Expected arguments to be 1-d tensors but got {x.ndim}-d tensors.")
+    return bool(jnp.issubdtype(x.dtype, jnp.integer) or jnp.all(jnp.floor(x) == x))
+
+
+def check_cluster_labels(preds: Array, target: Array) -> None:
+    """Validate shapes/dtypes of cluster labels (reference ``:183-193``)."""
+    if preds.shape != target.shape:
+        raise ValueError(f"Expected preds and target to have the same shape, got {preds.shape} and {target.shape}.")
+    if not (_is_real_discrete_label(preds) and _is_real_discrete_label(target)):
+        raise ValueError(f"Expected real, discrete values but received {preds.dtype} for"
+                         f" predictions and {target.dtype} for target labels instead.")
+
+
+def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:
+    """Validate (data, labels) inputs of intrinsic metrics (reference ``:196-203``)."""
+    if data.ndim != 2:
+        raise ValueError(f"Expected 2D data, got {data.ndim}D data instead")
+    if not jnp.issubdtype(data.dtype, jnp.floating):
+        raise ValueError(f"Expected floating point data, got {data.dtype} data instead")
+    if labels.ndim != 1:
+        raise ValueError(f"Expected 1D labels, got {labels.ndim}D labels instead")
+
+
+def _validate_intrinsic_labels_to_samples(num_labels: int, num_samples: int) -> None:
+    """Require 1 < num_labels < num_samples (reference ``:206-212``)."""
+    if not 1 < num_labels < num_samples:
+        raise ValueError(
+            "Number of detected clusters must be greater than one and less than the number of samples."
+            f"Got {num_labels} clusters and {num_samples} samples."
+        )
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds: Optional[Array] = None,
+    target: Optional[Array] = None,
+    contingency: Optional[Array] = None,
+) -> Array:
+    """2x2 pair confusion matrix between two clusterings (reference ``:215-283``)."""
+    if preds is None and target is None and contingency is None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+    if preds is not None and target is not None and contingency is not None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+    if preds is not None and target is not None:
+        contingency = calculate_contingency_matrix(preds, target)
+    if contingency is None:
+        raise ValueError("Must provide `contingency` if `preds` and `target` are not provided.")
+
+    # pair counts scale as n^2 and overflow int32 beyond ~46k samples; this is
+    # terminal compute-time (non-jitted) work, so do it host-side in int64
+    import numpy as np
+
+    cont = np.asarray(contingency).astype(np.int64)
+    num_samples = cont.sum()
+    sum_c = cont.sum(axis=1)
+    sum_k = cont.sum(axis=0)
+    sum_squared = (cont**2).sum()
+
+    c11 = sum_squared - num_samples
+    c10 = (cont * sum_k[None, :]).sum() - sum_squared
+    c01 = (cont.T * sum_c[None, :]).sum() - sum_squared
+    c00 = num_samples**2 - c11 - c10 - c01 - num_samples
+    return np.array([[c00, c01], [c10, c11]], dtype=np.float64)
+
+
+def _cluster_stats(data: Array, labels: Array) -> Tuple[Array, Array, Array]:
+    """Zero-indexed labels, per-cluster counts and centroids via one-hot
+    segment means (replaces the reference's per-cluster loops)."""
+    unique_labels, inverse = jnp.unique(labels, return_inverse=True)
+    num_labels = int(unique_labels.shape[0])
+    onehot = jax.nn.one_hot(inverse.reshape(-1), num_labels, dtype=data.dtype)  # (N, K)
+    counts = onehot.sum(axis=0)  # (K,)
+    centroids = (onehot.T @ data) / counts[:, None]  # (K, d)
+    return inverse.reshape(-1), counts, centroids

@@ -1,0 +1,321 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Nominal-association kernels: Cramer's V, Pearson's contingency coefficient,
+Theil's U, Tschuprow's T, Fleiss kappa (reference
+``src/torchmetrics/functional/nominal/{cramers,pearson,theils_u,tschuprows,fleiss_kappa}.py``)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_confmat,
+    _nominal_input_validation,
+    _relabel_nominal,
+    _unable_to_use_bias_correction_warning,
+)
+
+def _prepare_nominal(preds, target, nan_strategy, nan_replace_value):
+    """NaN-handle 1D label inputs, then remap the union of values onto
+    ``0..K-1`` so arbitrary category ids never fall outside the confmat."""
+    if preds.ndim == 2:
+        return preds, target, preds.shape[1]
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    preds, target, num_classes = _relabel_nominal(preds, target)
+    return preds, target, num_classes
+
+
+Array = jax.Array
+
+
+# ------------------------------------------------------------------ Cramer's V
+def _cramers_v_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Confusion matrix for Cramer's V (reference ``cramers.py:33-58``)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Cramer's V from the confusion matrix (reference ``cramers.py:61-90``)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    num_rows, num_cols = confmat.shape
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        if bool(jnp.minimum(rows_corrected, cols_corrected) == 1):
+            _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
+            return jnp.asarray(float("nan"))
+        cramers_v_value = jnp.sqrt(phi_squared_corrected / jnp.minimum(rows_corrected - 1, cols_corrected - 1))
+    else:
+        cramers_v_value = jnp.sqrt(phi_squared / min(num_rows - 1, num_cols - 1))
+    return jnp.clip(cramers_v_value, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramer's V statistic between two categorical variables (reference ``cramers.py:93-144``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target, num_classes = _prepare_nominal(preds, target, nan_strategy, nan_replace_value)
+    confmat = _cramers_v_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Cramer's V over matrix columns (reference ``cramers.py:147-189``)."""
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i in range(num_variables):
+        for j in range(i + 1, num_variables):
+            v = cramers_v(matrix[:, i], matrix[:, j], bias_correction, nan_strategy, nan_replace_value)
+            out = out.at[i, j].set(v).at[j, i].set(v)
+    return out
+
+
+# ---------------------------------------------- Pearson contingency coefficient
+def _pearsons_contingency_coefficient_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Confusion matrix (reference ``pearson.py:32-57``)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """Pearson = sqrt(phi^2 / (1 + phi^2)) (reference ``pearson.py:60-74``)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction=False)
+    phi_squared = chi_squared / cm_sum
+    value = jnp.sqrt(phi_squared / (1 + phi_squared))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient (reference ``pearson.py:77-131``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target, num_classes = _prepare_nominal(preds, target, nan_strategy, nan_replace_value)
+    confmat = _pearsons_contingency_coefficient_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Pearson contingency coefficients (reference ``pearson.py:134-174``)."""
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i in range(num_variables):
+        for j in range(i + 1, num_variables):
+            v = pearsons_contingency_coefficient(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value)
+            out = out.at[i, j].set(v).at[j, i].set(v)
+    return out
+
+
+# ------------------------------------------------------------------- Theil's U
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    """H(X|Y) from the confusion matrix (reference ``theils_u.py:24-44``)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    total_occurrences = confmat.sum()
+    p_xy_m = confmat / total_occurrences
+    p_y = confmat.sum(axis=1) / total_occurrences
+    p_y_m = jnp.broadcast_to(p_y[:, None], p_xy_m.shape)
+    terms = p_xy_m * jnp.log(jnp.where(p_xy_m > 0, p_y_m / jnp.where(p_xy_m > 0, p_xy_m, 1.0), 1.0))
+    return jnp.where(p_xy_m > 0, terms, 0.0).sum()
+
+
+def _theils_u_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Confusion matrix (reference ``theils_u.py:47-72``)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """U = (H(X) - H(X|Y)) / H(X) (reference ``theils_u.py:75-96``)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    s_xy = _conditional_entropy_compute(confmat)
+    total_occurrences = confmat.sum()
+    p_x = confmat.sum(axis=0) / total_occurrences
+    s_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.where(p_x > 0, p_x, 1.0)), 0.0))
+    if bool(s_x == 0):
+        return jnp.asarray(0.0)
+    return (s_x - s_xy) / s_x
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U statistic (uncertainty coefficient) (reference ``theils_u.py:99-141``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target, num_classes = _prepare_nominal(preds, target, nan_strategy, nan_replace_value)
+    confmat = _theils_u_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Theil's U (asymmetric) (reference ``theils_u.py:144-185``)."""
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i in range(num_variables):
+        for j in range(num_variables):
+            if i != j:
+                out = out.at[i, j].set(theils_u(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value))
+    return out
+
+
+# ---------------------------------------------------------------- Tschuprow's T
+def _tschuprows_t_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Confusion matrix (reference ``tschuprows.py:33-58``)."""
+    return _nominal_confmat(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Tschuprow's T from the confusion matrix (reference ``tschuprows.py:61-92``)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    num_rows, num_cols = confmat.shape
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        if bool(jnp.minimum(rows_corrected, cols_corrected) == 1):
+            _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
+            return jnp.asarray(float("nan"))
+        value = jnp.sqrt(phi_squared_corrected / jnp.sqrt((rows_corrected - 1) * (cols_corrected - 1)))
+    else:
+        value = jnp.sqrt(phi_squared / jnp.sqrt(jnp.asarray((num_rows - 1) * (num_cols - 1), jnp.float32)))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T statistic (reference ``tschuprows.py:95-146``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target, num_classes = _prepare_nominal(preds, target, nan_strategy, nan_replace_value)
+    confmat = _tschuprows_t_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pairwise Tschuprow's T (reference ``tschuprows.py:149-191``)."""
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = jnp.ones((num_variables, num_variables), dtype=jnp.float32)
+    for i in range(num_variables):
+        for j in range(i + 1, num_variables):
+            v = tschuprows_t(matrix[:, i], matrix[:, j], bias_correction, nan_strategy, nan_replace_value)
+            out = out.at[i, j].set(v).at[j, i].set(v)
+    return out
+
+
+# ---------------------------------------------------------------- Fleiss kappa
+def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
+    """Normalize ratings to a [n_samples, n_categories] counts matrix
+    (reference ``fleiss_kappa.py:22-44``)."""
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        n_categories = ratings.shape[1]
+        rater_choice = jnp.argmax(ratings, axis=1)  # (n_samples, n_raters)
+        one_hot = jax.nn.one_hot(rater_choice, n_categories, dtype=jnp.int32)  # (n_samples, n_raters, n_categories)
+        return one_hot.sum(axis=1)
+    if mode == "counts" and (ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating)):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    """Fleiss kappa from the counts matrix (reference ``fleiss_kappa.py:47-60``)."""
+    total = counts.shape[0]
+    num_raters = counts.sum(axis=1).max()
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = ((counts**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = (p_i**2).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    """Fleiss kappa inter-rater agreement (reference ``fleiss_kappa.py:63-103``)."""
+    if mode not in ("counts", "probs"):
+        raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+    ratings = jnp.asarray(ratings)
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

@@ -1,0 +1,130 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Nominal-association helpers (reference ``src/torchmetrics/functional/nominal/utils.py``)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    """Validate NaN-handling args (reference ``:23-34``)."""
+    if nan_strategy not in ("replace", "drop"):
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _compute_expected_freqs(confmat: Array) -> Array:
+    """Outer product of margins / total (reference ``:37-40``)."""
+    margin_sum_rows, margin_sum_cols = confmat.sum(axis=1), confmat.sum(axis=0)
+    return jnp.einsum("r,c->rc", margin_sum_rows, margin_sum_cols) / confmat.sum()
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """Chi-squared statistic with optional Yates correction (reference ``:43-57``)."""
+    expected_freqs = _compute_expected_freqs(confmat)
+    df = expected_freqs.size - sum(expected_freqs.shape) + expected_freqs.ndim - 1
+    if df == 0:
+        return jnp.asarray(0.0)
+    if df == 1 and bias_correction:
+        diff = expected_freqs - confmat
+        direction = jnp.sign(diff)
+        confmat = confmat + direction * jnp.minimum(0.5, jnp.abs(direction))
+    return jnp.sum((confmat - expected_freqs) ** 2 / expected_freqs)
+
+
+def _drop_empty_rows_and_cols(confmat: Array) -> Array:
+    """Drop all-zero rows and columns (reference ``:60-77``). Host-side
+    (concrete shapes) — used only at compute time."""
+    confmat = confmat[confmat.sum(axis=1) != 0]
+    return confmat[:, confmat.sum(axis=0) != 0]
+
+
+def _compute_phi_squared_corrected(phi_squared: Array, num_rows: int, num_cols: int, confmat_sum: Array) -> Array:
+    """Bias-corrected phi^2 (reference ``:80-90``)."""
+    return jnp.maximum(jnp.asarray(0.0), phi_squared - ((num_rows - 1) * (num_cols - 1)) / (confmat_sum - 1))
+
+
+def _compute_rows_and_cols_corrected(num_rows: int, num_cols: int, confmat_sum: Array) -> Tuple[Array, Array]:
+    """Bias-corrected row/col counts (reference ``:93-96``)."""
+    rows_corrected = num_rows - (num_rows - 1) ** 2 / (confmat_sum - 1)
+    cols_corrected = num_cols - (num_cols - 1) ** 2 / (confmat_sum - 1)
+    return rows_corrected, cols_corrected
+
+
+def _compute_bias_corrected_values(
+    phi_squared: Array, num_rows: int, num_cols: int, confmat_sum: Array
+) -> Tuple[Array, Array, Array]:
+    """All bias-corrected quantities (reference ``:99-104``)."""
+    phi_squared_corrected = _compute_phi_squared_corrected(phi_squared, num_rows, num_cols, confmat_sum)
+    rows_corrected, cols_corrected = _compute_rows_and_cols_corrected(num_rows, num_cols, confmat_sum)
+    return phi_squared_corrected, rows_corrected, cols_corrected
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace or drop NaNs (reference ``:107-140``)."""
+    if nan_strategy == "replace":
+        return jnp.nan_to_num(preds, nan=nan_replace_value), jnp.nan_to_num(target, nan=nan_replace_value)
+    if jnp.issubdtype(preds.dtype, jnp.floating) or jnp.issubdtype(target.dtype, jnp.floating):
+        rows_contain_nan = jnp.logical_or(jnp.isnan(preds.astype(jnp.float32)), jnp.isnan(target.astype(jnp.float32)))
+        return preds[~rows_contain_nan], target[~rows_contain_nan]
+    return preds, target
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    """Warn about degenerate bias correction (reference ``:143-146``)."""
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )
+
+
+def _nominal_confmat(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Shared update: argmax 2D inputs, handle NaNs, bincount confusion matrix
+    (the ``_<metric>_update`` body shared by every nominal metric).
+
+    Labels must be ``0..num_classes-1`` — out-of-range values would be
+    silently dropped by the bincount scatter, so they error loudly instead.
+    """
+    from torchmetrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+
+    preds = jnp.argmax(preds, axis=1) if preds.ndim == 2 else preds
+    target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
+    if max_label >= num_classes:
+        raise ValueError(
+            f"Detected label value {max_label} but `num_classes`={num_classes}; nominal metrics expect labels"
+            " in 0..num_classes-1. Relabel the data or pass a larger `num_classes`."
+        )
+    return _multiclass_confusion_matrix_update(preds.astype(jnp.int32), target.astype(jnp.int32), num_classes)
+
+
+def _relabel_nominal(preds: Array, target: Array) -> Tuple[Array, Array, int]:
+    """Map arbitrary categorical values onto ``0..K-1`` over the union of
+    both variables' values (used by the top-level functionals, which derive
+    ``num_classes`` from the data)."""
+    vals = jnp.unique(jnp.concatenate([preds.reshape(-1), target.reshape(-1)]))
+    return jnp.searchsorted(vals, preds), jnp.searchsorted(vals, target), int(vals.shape[0])

@@ -1,0 +1,142 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Nominal module metrics (reference ``src/torchmetrics/nominal/*.py``).
+
+State machine: the num_classes × num_classes confusion matrix with ``"sum"``
+reduction (reference e.g. ``nominal/cramers.py:76-80``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal.metrics import (
+    _cramers_v_compute,
+    _cramers_v_update,
+    _fleiss_kappa_compute,
+    _fleiss_kappa_update,
+    _pearsons_contingency_coefficient_compute,
+    _pearsons_contingency_coefficient_update,
+    _theils_u_compute,
+    _theils_u_update,
+    _tschuprows_t_compute,
+    _tschuprows_t_update,
+)
+from torchmetrics_tpu.functional.nominal.utils import _nominal_input_validation
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _ConfmatNominalMetric(Metric):
+    """Shared confusion-matrix state machine for nominal metrics."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _update_fn = None
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError(f"Expected argument `num_classes` to be a positive integer, but got {num_classes}")
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.num_classes = num_classes
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold the batch confusion matrix into the state."""
+        confmat = type(self)._update_fn(
+            jnp.asarray(preds), jnp.asarray(target), self.num_classes, self.nan_strategy, self.nan_replace_value
+        )
+        self.confmat = self.confmat + confmat
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class CramersV(_ConfmatNominalMetric):
+    """Cramer's V (reference ``nominal/cramers.py:27``)."""
+
+    _update_fn = staticmethod(_cramers_v_update)
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _cramers_v_compute(self.confmat, self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_ConfmatNominalMetric):
+    """Pearson's contingency coefficient (reference ``nominal/pearson.py:27``)."""
+
+    _update_fn = staticmethod(_pearsons_contingency_coefficient_update)
+
+    def compute(self) -> Array:
+        return _pearsons_contingency_coefficient_compute(self.confmat)
+
+
+class TheilsU(_ConfmatNominalMetric):
+    """Theil's U (reference ``nominal/theils_u.py:27``)."""
+
+    _update_fn = staticmethod(_theils_u_update)
+
+    def compute(self) -> Array:
+        return _theils_u_compute(self.confmat)
+
+
+class TschuprowsT(_ConfmatNominalMetric):
+    """Tschuprow's T (reference ``nominal/tschuprows.py:27``)."""
+
+    _update_fn = staticmethod(_tschuprows_t_update)
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _tschuprows_t_compute(self.confmat, self.bias_correction)
+
+
+class FleissKappa(Metric):
+    """Fleiss kappa (reference ``nominal/fleiss_kappa.py:26``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+        self.mode = mode
+        self.add_state("counts", [], dist_reduce_fx="cat")
+
+    def update(self, ratings: Array) -> None:
+        """Append the batch counts matrix."""
+        counts = _fleiss_kappa_update(jnp.asarray(ratings), self.mode)
+        self.counts.append(counts)
+
+    def compute(self) -> Array:
+        """Fleiss kappa over the whole stream."""
+        return _fleiss_kappa_compute(dim_zero_cat(self.counts))
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
