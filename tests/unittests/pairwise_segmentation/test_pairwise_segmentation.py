@@ -1,0 +1,102 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Pairwise + segmentation suites vs sklearn/manual oracles (reference tests:
+``tests/unittests/pairwise/*.py``, ``tests/unittests/segmentation/*.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics.pairwise as skp
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.segmentation import GeneralizedDiceScore, MeanIoU
+
+
+def _xy(seed=0, n=24, m=16, d=8):
+    rng = np.random.RandomState(seed)
+    return rng.randn(n, d).astype(np.float32), rng.randn(m, d).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    ("fn", "oracle", "kwargs"),
+    [
+        (F.pairwise_cosine_similarity, skp.cosine_similarity, {}),
+        (F.pairwise_euclidean_distance, skp.euclidean_distances, {}),
+        (F.pairwise_linear_similarity, skp.linear_kernel, {}),
+        (F.pairwise_manhattan_distance, skp.manhattan_distances, {}),
+        (F.pairwise_minkowski_distance, lambda x, y: skp.distance_metrics()["manhattan"](x, y), {"exponent": 1}),
+    ],
+)
+def test_pairwise(fn, oracle, kwargs):
+    x, y = _xy()
+    np.testing.assert_allclose(np.asarray(fn(x, y, **kwargs)), oracle(x, y), rtol=1e-4, atol=1e-4)
+    # x-only: zero diagonal
+    res = np.asarray(fn(x, **kwargs))
+    expected = oracle(x, x)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(res, expected, rtol=1e-4, atol=1e-4)
+    # reductions
+    np.testing.assert_allclose(
+        np.asarray(fn(x, y, reduction="mean", **kwargs)), oracle(x, y).mean(-1), rtol=1e-4, atol=1e-4
+    )
+
+
+def _onehot(labels, C):
+    return np.moveaxis(np.eye(C, dtype=np.int32)[labels], -1, 1)
+
+
+def test_mean_iou():
+    rng = np.random.RandomState(1)
+    C, N = 4, 8
+    preds_idx = rng.randint(0, C, (N, 16, 16))
+    target_idx = rng.randint(0, C, (N, 16, 16))
+    preds, target = _onehot(preds_idx, C), _onehot(target_idx, C)
+
+    # manual per-sample-per-class oracle
+    inter = np.stack([[(preds[n, c] & target[n, c]).sum() for c in range(C)] for n in range(N)])
+    union = np.stack(
+        [[preds[n, c].sum() + target[n, c].sum() - inter[n, c] for c in range(C)] for n in range(N)]
+    )
+    expected = (inter / np.maximum(union, 1)).mean(1)
+    np.testing.assert_allclose(np.asarray(F.mean_iou(preds, target, num_classes=C)), expected, rtol=1e-5)
+    # index format gives identical result
+    np.testing.assert_allclose(
+        np.asarray(F.mean_iou(preds_idx, target_idx, num_classes=C, input_format="index")), expected, rtol=1e-5
+    )
+    # module: mean over batches of batch-means
+    m = MeanIoU(num_classes=C)
+    m.update(preds[:4], target[:4])
+    m.update(preds[4:], target[4:])
+    expected_mod = (expected[:4].mean() + expected[4:].mean()) / 2
+    np.testing.assert_allclose(float(m.compute()), expected_mod, rtol=1e-5)
+    # per-class
+    out = np.asarray(F.mean_iou(preds, target, num_classes=C, per_class=True))
+    assert out.shape == (N, C)
+
+
+def test_generalized_dice():
+    rng = np.random.RandomState(2)
+    C, N = 3, 6
+    preds_idx = rng.randint(0, C, (N, 12, 12))
+    target_idx = rng.randint(0, C, (N, 12, 12))
+    preds, target = _onehot(preds_idx, C), _onehot(target_idx, C)
+
+    # manual oracle, weight_type=square
+    inter = np.stack([[(preds[n, c] * target[n, c]).sum() for c in range(C)] for n in range(N)]).astype(float)
+    tsum = np.stack([[target[n, c].sum() for c in range(C)] for n in range(N)]).astype(float)
+    psum = np.stack([[preds[n, c].sum() for c in range(C)] for n in range(N)]).astype(float)
+    w = 1.0 / np.maximum(tsum, 1e-12) ** 2
+    numer = (2 * inter * w).sum(1)
+    denom = ((tsum + psum) * w).sum(1)
+    expected = numer / denom
+    np.testing.assert_allclose(
+        np.asarray(F.generalized_dice_score(preds, target, num_classes=C)), expected, rtol=1e-4
+    )
+    m = GeneralizedDiceScore(num_classes=C)
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), expected.mean(), rtol=1e-4)
+    # other weight types run
+    for wt in ("simple", "linear"):
+        out = np.asarray(F.generalized_dice_score(preds, target, num_classes=C, weight_type=wt))
+        assert out.shape == (N,)
+    # exclude background
+    out = np.asarray(F.generalized_dice_score(preds, target, num_classes=C, include_background=False, per_class=True))
+    assert out.shape == (N, C - 1)

@@ -10,7 +10,18 @@ from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
+from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.pairwise import __all__ as _pairwise_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.segmentation import __all__ as _segmentation_all
 
-__all__ = list(_classification_all) + list(_clustering_all) + list(_nominal_all) + list(_regression_all)
+__all__ = (
+    list(_classification_all)
+    + list(_clustering_all)
+    + list(_nominal_all)
+    + list(_pairwise_all)
+    + list(_regression_all)
+    + list(_segmentation_all)
+)

@@ -51,7 +51,8 @@ def expected_mutual_info_score(contingency: Array, n_samples: int) -> Array:
     bij = b[None, :, None]
 
     emi = 0.0
-    chunk = 1 << 14
+    # bound temporaries to ~128 MB of float64 regardless of cluster counts
+    chunk = max(1, (1 << 24) // (a.shape[0] * b.shape[0]))
     for lo in range(1, max_nij + 1, chunk):
         nij = np.arange(lo, min(lo + chunk, max_nij + 1), dtype=np.float64)[None, None, :]
         # valid hypergeometric support: max(1, a+b-n) <= nij <= min(a, b)

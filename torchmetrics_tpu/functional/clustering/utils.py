@@ -143,8 +143,8 @@ def calculate_pair_cluster_confusion_matrix(
     sum_squared = (cont**2).sum()
 
     c11 = sum_squared - num_samples
-    c10 = (cont * sum_k[None, :]).sum() - sum_squared
-    c01 = (cont.T * sum_c[None, :]).sum() - sum_squared
+    c01 = (cont * sum_k[None, :]).sum() - sum_squared
+    c10 = (cont.T * sum_c[None, :]).sum() - sum_squared
     c00 = num_samples**2 - c11 - c10 - c01 - num_samples
     return np.array([[c00, c01], [c10, c11]], dtype=np.float64)
 

@@ -24,8 +24,11 @@ from torchmetrics_tpu.functional.nominal.utils import (
 def _prepare_nominal(preds, target, nan_strategy, nan_replace_value):
     """NaN-handle 1D label inputs, then remap the union of values onto
     ``0..K-1`` so arbitrary category ids never fall outside the confmat."""
-    if preds.ndim == 2:
-        return preds, target, preds.shape[1]
+    if preds.ndim == 2 or target.ndim == 2:
+        num_classes = preds.shape[1] if preds.ndim == 2 else target.shape[1]
+        preds = jnp.argmax(preds, axis=1) if preds.ndim == 2 else preds
+        target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
+        return preds, target, num_classes
     preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
     preds, target, num_classes = _relabel_nominal(preds, target)
     return preds, target, num_classes

@@ -41,7 +41,7 @@ def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
     if df == 1 and bias_correction:
         diff = expected_freqs - confmat
         direction = jnp.sign(diff)
-        confmat = confmat + direction * jnp.minimum(0.5, jnp.abs(direction))
+        confmat = confmat + direction * jnp.minimum(0.5, jnp.abs(diff))
     return jnp.sum((confmat - expected_freqs) ** 2 / expected_freqs)
 
 
@@ -114,10 +114,11 @@ def _nominal_confmat(
     target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
     preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
     max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
-    if max_label >= num_classes:
+    min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))
+    if max_label >= num_classes or min_label < 0:
         raise ValueError(
-            f"Detected label value {max_label} but `num_classes`={num_classes}; nominal metrics expect labels"
-            " in 0..num_classes-1. Relabel the data or pass a larger `num_classes`."
+            f"Detected label values in [{min_label}, {max_label}] but `num_classes`={num_classes}; nominal"
+            " metrics expect labels in 0..num_classes-1. Relabel the data or pass a larger `num_classes`."
         )
     return _multiclass_confusion_matrix_update(preds.astype(jnp.int32), target.astype(jnp.int32), num_classes)
 

@@ -1,0 +1,174 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Pairwise similarity/distance kernels (reference
+``src/torchmetrics/functional/pairwise/{cosine,euclidean,linear,manhattan,minkowski}.py``).
+
+All five are MXU-shaped: the pairwise matrix comes from one matmul (cosine,
+linear, euclidean via the norm expansion) or a broadcasted reduction
+(manhattan, minkowski).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.compute import _safe_matmul
+
+Array = jax.Array
+
+
+def _check_input(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Tuple[Array, Array, bool]:
+    """Validate [N,d] / [M,d] inputs (reference ``helpers.py:19-43``)."""
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """Reduce along the last dim (reference ``helpers.py:46-62``)."""
+    if reduction == "mean":
+        return distmat.mean(axis=-1)
+    if reduction == "sum":
+        return distmat.sum(axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def _zero_diagonal(distance: Array, zero_diagonal: bool) -> Array:
+    if zero_diagonal:
+        n = min(distance.shape)
+        distance = distance.at[jnp.arange(n), jnp.arange(n)].set(0)
+    return distance
+
+
+def _pairwise_cosine_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Normalized rows → one matmul (reference ``cosine.py:24-44``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
+    distance = _safe_matmul(x, y)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise cosine similarity (reference ``cosine.py:47-91``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y) if y is not None else None
+    distance = _pairwise_cosine_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_euclidean_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """||x||^2 + ||y||^2 - 2<x,y> expansion (reference ``euclidean.py:24-44``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = (x * x).sum(axis=1, keepdims=True)
+    y_norm = (y * y).sum(axis=1)
+    distance = x_norm + y_norm[None, :] - 2 * _safe_matmul(x, y)
+    distance = _zero_diagonal(distance, zero_diagonal)
+    return jnp.sqrt(jnp.maximum(distance, 0.0))
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise euclidean distance (reference ``euclidean.py:47-87``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y) if y is not None else None
+    distance = _pairwise_euclidean_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_linear_similarity_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Inner products (reference ``linear.py:24-40``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _safe_matmul(x, y)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise linear similarity (reference ``linear.py:43-83``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y) if y is not None else None
+    distance = _pairwise_linear_similarity_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_manhattan_distance_update(
+    x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Broadcasted |x - y| sums (reference ``manhattan.py:24-40``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.abs(x[:, None, :] - y[None, :, :]).sum(axis=-1)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise manhattan distance (reference ``manhattan.py:43-83``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y) if y is not None else None
+    distance = _pairwise_manhattan_distance_update(x, y, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def _pairwise_minkowski_distance_update(
+    x: Array, y: Optional[Array] = None, exponent: float = 2, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Broadcasted p-norm (reference ``minkowski.py:25-46``)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    if not (isinstance(exponent, (float, int)) and exponent >= 1):
+        raise ValueError(f"Argument `exponent` must be a float larger than 1, but got {exponent}")
+    distance = (jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent).sum(axis=-1) ** (1.0 / exponent)
+    return _zero_diagonal(distance, zero_diagonal)
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: float = 2,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise minkowski distance (reference ``minkowski.py:49-91``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y) if y is not None else None
+    distance = _pairwise_minkowski_distance_update(x, y, exponent, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

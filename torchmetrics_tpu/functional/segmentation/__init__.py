@@ -1,0 +1,7 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Functional segmentation kernels (reference ``functional/segmentation/__init__.py``)."""
+from torchmetrics_tpu.functional.segmentation.generalized_dice import generalized_dice_score
+from torchmetrics_tpu.functional.segmentation.mean_iou import mean_iou
+
+__all__ = ["generalized_dice_score", "mean_iou"]

@@ -1,0 +1,104 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Generalized dice score (reference ``src/torchmetrics/functional/segmentation/generalized_dice.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.segmentation.utils import _ignore_background, _segmentation_format
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _generalized_dice_validate_args(
+    num_classes: int,
+    include_background: bool,
+    per_class: bool,
+    weight_type: str,
+    input_format: str,
+) -> None:
+    """Validate non-tensor args (reference ``:28-47``)."""
+    if num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if weight_type not in ("square", "simple", "linear"):
+        raise ValueError(
+            f"Expected argument `weight_type` to be one of 'square', 'simple', 'linear', but got {weight_type}."
+        )
+    if input_format not in ("one-hot", "index"):
+        raise ValueError(f"Expected argument `input_format` to be one of 'one-hot', 'index', but got {input_format}.")
+
+
+def _generalized_dice_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool,
+    weight_type: str = "square",
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array]:
+    """Per-sample-per-class weighted numerator/denominator (reference ``:50-99``)."""
+    if input_format == "one-hot":
+        _check_same_shape(preds, target)
+    if preds.ndim < (3 if input_format == "one-hot" else 2):
+        raise ValueError(f"Expected both `preds` and `target` to have at least 3 dimensions, but got {preds.ndim}.")
+    preds, target = _segmentation_format(preds, target, num_classes, input_format)
+    if not include_background:
+        preds, target = _ignore_background(preds, target)
+
+    reduce_axis = tuple(range(2, target.ndim))
+    intersection = jnp.sum(preds * target, axis=reduce_axis).astype(jnp.float32)
+    target_sum = jnp.sum(target, axis=reduce_axis).astype(jnp.float32)
+    pred_sum = jnp.sum(preds, axis=reduce_axis).astype(jnp.float32)
+    cardinality = target_sum + pred_sum
+
+    if weight_type == "simple":
+        weights = 1.0 / target_sum
+    elif weight_type == "linear":
+        weights = jnp.ones_like(target_sum)
+    else:  # square
+        weights = 1.0 / (target_sum**2)
+
+    # replace inf weights (empty classes) with the per-sample max finite weight
+    infs = jnp.isinf(weights)
+    finite = jnp.where(infs, 0.0, weights)
+    w_max = finite.max(axis=1, keepdims=True)
+    weights = jnp.where(infs, jnp.broadcast_to(w_max, weights.shape), weights)
+
+    numerator = 2.0 * intersection * weights
+    denominator = cardinality * weights
+    return numerator, denominator
+
+
+def _generalized_dice_compute(numerator: Array, denominator: Array, per_class: bool = True) -> Array:
+    """Final reduction (reference ``:102-108``)."""
+    if not per_class:
+        numerator = jnp.sum(numerator, axis=1)
+        denominator = jnp.sum(denominator, axis=1)
+    return _safe_divide(numerator, denominator)
+
+
+def generalized_dice_score(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    per_class: bool = False,
+    weight_type: str = "square",
+    input_format: str = "one-hot",
+) -> Array:
+    """Generalized dice score (reference ``:111-164``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _generalized_dice_validate_args(num_classes, include_background, per_class, weight_type, input_format)
+    numerator, denominator = _generalized_dice_update(
+        preds, target, num_classes, include_background, weight_type, input_format
+    )
+    return _generalized_dice_compute(numerator, denominator, per_class)

@@ -1,0 +1,76 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Mean IoU for segmentation (reference ``src/torchmetrics/functional/segmentation/mean_iou.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.segmentation.utils import _ignore_background, _segmentation_format
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _mean_iou_validate_args(
+    num_classes: int,
+    include_background: bool,
+    per_class: bool,
+    input_format: str = "one-hot",
+) -> None:
+    """Validate non-tensor args (reference ``:26-41``)."""
+    if num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if input_format not in ("one-hot", "index"):
+        raise ValueError(f"Expected argument `input_format` to be one of 'one-hot', 'index', but got {input_format}.")
+
+
+def _mean_iou_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = False,
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array]:
+    """Per-sample-per-class intersection/union (reference ``:44-68``)."""
+    if input_format == "one-hot":
+        _check_same_shape(preds, target)
+    preds, target = _segmentation_format(preds, target, num_classes, input_format)
+    if not include_background:
+        preds, target = _ignore_background(preds, target)
+    reduce_axis = tuple(range(2, preds.ndim))
+    preds_b = preds.astype(bool)
+    target_b = target.astype(bool)
+    intersection = jnp.sum(preds_b & target_b, axis=reduce_axis).astype(jnp.float32)
+    target_sum = jnp.sum(target_b, axis=reduce_axis).astype(jnp.float32)
+    pred_sum = jnp.sum(preds_b, axis=reduce_axis).astype(jnp.float32)
+    union = target_sum + pred_sum - intersection
+    return intersection, union
+
+
+def _mean_iou_compute(intersection: Array, union: Array, per_class: bool = False) -> Array:
+    """Final reduction (reference ``:71-77``)."""
+    val = _safe_divide(intersection, union)
+    return val if per_class else jnp.mean(val, axis=1)
+
+
+def mean_iou(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    per_class: bool = False,
+    input_format: str = "one-hot",
+) -> Array:
+    """Mean intersection over union (reference ``:80-125``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    _mean_iou_validate_args(num_classes, include_background, per_class, input_format)
+    intersection, union = _mean_iou_update(preds, target, num_classes, include_background, input_format)
+    return _mean_iou_compute(intersection, union, per_class)

@@ -1,0 +1,37 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Segmentation helpers (reference ``src/torchmetrics/functional/segmentation/utils.py``)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _ignore_background(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop the background class (channel 0) (reference ``utils.py:26-30``)."""
+    preds = preds[:, 1:] if preds.shape[1] > 1 else preds
+    target = target[:, 1:] if target.shape[1] > 1 else target
+    return preds, target
+
+
+def _segmentation_format(preds: Array, target: Array, num_classes: int, input_format: str) -> Tuple[Array, Array]:
+    """Index → one-hot with channel dim at position 1 (shared by both kernels).
+
+    Out-of-range index labels would be silently one-hot-encoded to all-zero
+    rows, so they error loudly instead (matching the torch reference).
+    """
+    if input_format == "index":
+        max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
+        min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))
+        if max_label >= num_classes or min_label < 0:
+            raise ValueError(
+                f"Detected index labels in [{min_label}, {max_label}] outside the valid range"
+                f" 0..{num_classes - 1} implied by `num_classes`={num_classes}."
+            )
+        preds = jnp.moveaxis(jax.nn.one_hot(preds, num_classes, dtype=jnp.int32), -1, 1)
+        target = jnp.moveaxis(jax.nn.one_hot(target, num_classes, dtype=jnp.int32), -1, 1)
+    return preds, target

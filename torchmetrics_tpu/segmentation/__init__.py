@@ -1,0 +1,6 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Segmentation module metrics (reference ``src/torchmetrics/segmentation/``)."""
+from torchmetrics_tpu.segmentation.metrics import GeneralizedDiceScore, MeanIoU
+
+__all__ = ["GeneralizedDiceScore", "MeanIoU"]

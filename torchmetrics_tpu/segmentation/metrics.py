@@ -1,0 +1,122 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Segmentation module metrics (reference ``src/torchmetrics/segmentation/*.py``)."""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.segmentation.generalized_dice import (
+    _generalized_dice_compute,
+    _generalized_dice_update,
+    _generalized_dice_validate_args,
+)
+from torchmetrics_tpu.functional.segmentation.mean_iou import (
+    _mean_iou_compute,
+    _mean_iou_update,
+    _mean_iou_validate_args,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class GeneralizedDiceScore(Metric):
+    """Generalized dice score (reference ``segmentation/generalized_dice.py:33``).
+
+    State: running sum of per-sample scores + sample count, ``"sum"`` reduce
+    (reference ``:134-135``).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        weight_type: str = "square",
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _generalized_dice_validate_args(num_classes, include_background, per_class, weight_type, input_format)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.weight_type = weight_type
+        self.input_format = input_format
+        num_scores = num_classes - (0 if include_background else 1) if per_class else 1
+        self.add_state("score", jnp.zeros(num_scores), dist_reduce_fx="sum")
+        self.add_state("samples", jnp.zeros(1), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold per-sample generalized dice into the state (reference ``:137-143``)."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        numerator, denominator = _generalized_dice_update(
+            preds, target, self.num_classes, self.include_background, self.weight_type, self.input_format
+        )
+        self.score = self.score + _generalized_dice_compute(numerator, denominator, self.per_class).sum(axis=0)
+        self.samples = self.samples + preds.shape[0]
+
+    def compute(self) -> Array:
+        """Mean over samples (reference ``:145-147``)."""
+        return self.score / self.samples
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class MeanIoU(Metric):
+    """Mean IoU (reference ``segmentation/mean_iou.py:29``).
+
+    State: running sum of per-batch mean IoU + batch count (reference
+    ``:113-114``).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _mean_iou_validate_args(num_classes, include_background, per_class, input_format)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.input_format = input_format
+        num_scores = num_classes - (0 if include_background else 1) if per_class else 1
+        self.add_state("score", jnp.zeros(num_scores), dist_reduce_fx="sum")
+        self.add_state("num_batches", jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold the batch mean IoU into the state (reference ``:116-123``)."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        intersection, union = _mean_iou_update(
+            preds, target, self.num_classes, self.include_background, self.input_format
+        )
+        score = _mean_iou_compute(intersection, union, per_class=self.per_class)
+        self.score = self.score + (score.mean(axis=0) if self.per_class else score.mean())
+        self.num_batches = self.num_batches + 1
+
+    def compute(self) -> Array:
+        """Mean over batches (reference ``:125-127``)."""
+        return self.score / self.num_batches
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
