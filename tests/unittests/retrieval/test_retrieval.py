@@ -1,0 +1,264 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Retrieval suite vs sklearn/manual oracles (reference tests:
+``tests/unittests/retrieval/test_*.py``)."""
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.retrieval import (
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+
+
+def _query(seed=0, n=20, frac_pos=0.3):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n).astype(np.float32)
+    target = (rng.rand(n) < frac_pos).astype(np.int64)
+    if target.sum() == 0:
+        target[0] = 1
+    if target.sum() == n:
+        target[0] = 0
+    return preds, target
+
+
+def _stream(seed=3, n_queries=8, docs=16):
+    """Flat (indexes, preds, target) stream with variable per-query lengths."""
+    rng = np.random.RandomState(seed)
+    idx, preds, tgt = [], [], []
+    for q in range(n_queries):
+        n = rng.randint(4, docs)
+        idx += [q] * n
+        preds += list(rng.rand(n))
+        t = (rng.rand(n) < 0.4).astype(int)
+        tgt += list(t)
+    return np.array(idx), np.array(preds, dtype=np.float32), np.array(tgt)
+
+
+# ------------------------------------------------------- single-query kernels
+def test_functional_average_precision():
+    preds, target = _query(1)
+    np.testing.assert_allclose(
+        float(F.retrieval_average_precision(preds, target)),
+        skm.average_precision_score(target, preds),
+        rtol=1e-5,
+    )
+
+
+def test_functional_reciprocal_rank():
+    preds, target = _query(2)
+    order = np.argsort(-preds)
+    first = np.nonzero(target[order])[0][0]
+    np.testing.assert_allclose(float(F.retrieval_reciprocal_rank(preds, target)), 1.0 / (first + 1), rtol=1e-6)
+
+
+def test_functional_precision_recall_hit_fallout_rprec():
+    preds, target = _query(3)
+    order = np.argsort(-preds)
+    k = 5
+    rel_k = target[order][:k].sum()
+    np.testing.assert_allclose(float(F.retrieval_precision(preds, target, top_k=k)), rel_k / k, rtol=1e-6)
+    np.testing.assert_allclose(float(F.retrieval_recall(preds, target, top_k=k)), rel_k / target.sum(), rtol=1e-6)
+    np.testing.assert_allclose(float(F.retrieval_hit_rate(preds, target, top_k=k)), float(rel_k > 0), rtol=1e-6)
+    nonrel_k = (1 - target[order][:k]).sum()
+    np.testing.assert_allclose(
+        float(F.retrieval_fall_out(preds, target, top_k=k)), nonrel_k / (1 - target).sum(), rtol=1e-6
+    )
+    r = int(target.sum())
+    np.testing.assert_allclose(float(F.retrieval_r_precision(preds, target)), target[order][:r].sum() / r, rtol=1e-6)
+    # top_k None: precision denominator is the query length
+    np.testing.assert_allclose(float(F.retrieval_precision(preds, target)), target.sum() / len(preds), rtol=1e-6)
+
+
+def test_functional_ndcg():
+    preds, target = _query(4)
+    np.testing.assert_allclose(
+        float(F.retrieval_normalized_dcg(preds, target)), skm.ndcg_score(target[None], preds[None]), rtol=1e-5
+    )
+    # graded relevance + top_k
+    rng = np.random.RandomState(5)
+    graded = rng.randint(0, 4, len(preds))
+    np.testing.assert_allclose(
+        float(F.retrieval_normalized_dcg(preds, graded, top_k=8)),
+        skm.ndcg_score(graded[None], preds[None], k=8),
+        rtol=1e-5,
+    )
+    # ties are averaged like sklearn (ignore_ties=False default)
+    preds_tied = np.round(preds, 1)
+    np.testing.assert_allclose(
+        float(F.retrieval_normalized_dcg(preds_tied, graded)),
+        skm.ndcg_score(graded[None], preds_tied[None]),
+        rtol=1e-5,
+    )
+
+
+def test_functional_auroc():
+    preds, target = _query(6)
+    np.testing.assert_allclose(float(F.retrieval_auroc(preds, target)), skm.roc_auc_score(target, preds), rtol=1e-5)
+    # with ties
+    preds_tied = np.round(preds, 1)
+    np.testing.assert_allclose(
+        float(F.retrieval_auroc(preds_tied, target)), skm.roc_auc_score(target, preds_tied), rtol=1e-5
+    )
+    # max_fpr path
+    np.testing.assert_allclose(
+        float(F.retrieval_auroc(preds, target, max_fpr=0.5)),
+        skm.roc_auc_score(target, preds, max_fpr=0.5),
+        rtol=1e-4,
+    )
+
+
+def test_functional_pr_curve():
+    preds, target = _query(7)
+    prec, rec, topk = F.retrieval_precision_recall_curve(preds, target, max_k=6)
+    order = np.argsort(-preds)
+    rel = np.cumsum(target[order][:6])
+    np.testing.assert_allclose(np.asarray(prec), rel / np.arange(1, 7), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(rec), rel / target.sum(), rtol=1e-5)
+
+
+# ----------------------------------------------------------- module (grouped)
+def _loop_oracle(idx, preds, tgt, per_query_fn, empty="neg"):
+    vals = []
+    for q in np.unique(idx):
+        m = idx == q
+        if tgt[m].sum() == 0:
+            if empty == "neg":
+                vals.append(0.0)
+            elif empty == "pos":
+                vals.append(1.0)
+            continue
+        vals.append(per_query_fn(preds[m], tgt[m]))
+    return np.mean(vals) if vals else 0.0
+
+
+@pytest.mark.parametrize(
+    ("cls", "oracle_fn"),
+    [
+        (RetrievalMAP, lambda p, t: skm.average_precision_score(t, p)),
+        (RetrievalMRR, lambda p, t: 1.0 / (np.nonzero(t[np.argsort(-p)])[0][0] + 1)),
+        (RetrievalNormalizedDCG, lambda p, t: skm.ndcg_score(t[None], p[None])),
+        (RetrievalRPrecision, lambda p, t: t[np.argsort(-p)][: int(t.sum())].sum() / int(t.sum())),
+        (
+            RetrievalAUROC,
+            lambda p, t: skm.roc_auc_score(t, p) if 0 < t.sum() < len(t) else 0.0,
+        ),
+    ],
+)
+def test_module_metrics(cls, oracle_fn):
+    idx, preds, tgt = _stream()
+    expected = _loop_oracle(idx, preds, tgt, oracle_fn)
+    m = cls()
+    # stream in 3 chunks
+    for lo in range(0, len(idx), 37):
+        s = slice(lo, lo + 37)
+        m.update(preds[s], tgt[s], indexes=idx[s])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_module_precision_topk_and_empty_action():
+    idx, preds, tgt = _stream(11)
+    # force one empty-target query
+    tgt[idx == 2] = 0
+    k = 3
+
+    def prec_at_k(p, t):
+        return t[np.argsort(-p)][:k].sum() / k
+
+    for action in ("neg", "pos", "skip"):
+        expected = _loop_oracle(idx, preds, tgt, prec_at_k, empty=action)
+        m = RetrievalPrecision(top_k=k, empty_target_action=action)
+        m.update(preds, tgt, indexes=idx)
+        np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+    with pytest.raises(ValueError, match="no positive target"):
+        m = RetrievalPrecision(top_k=k, empty_target_action="error")
+        m.update(preds, tgt, indexes=idx)
+        m.compute()
+
+
+def test_module_fallout_hitrate_recall():
+    idx, preds, tgt = _stream(13)
+    k = 4
+    m = RetrievalFallOut(top_k=k)
+    m.update(preds, tgt, indexes=idx)
+    vals = []
+    for q in np.unique(idx):
+        msk = idx == q
+        t, p = tgt[msk], preds[msk]
+        if (1 - t).sum() == 0:
+            vals.append(0.0)
+            continue
+        vals.append((1 - t[np.argsort(-p)][:k]).sum() / (1 - t).sum())
+    np.testing.assert_allclose(float(m.compute()), np.mean(vals), rtol=1e-5)
+
+    m = RetrievalHitRate(top_k=k)
+    m.update(preds, tgt, indexes=idx)
+    expected = _loop_oracle(idx, preds, tgt, lambda p, t: float(t[np.argsort(-p)][:k].sum() > 0))
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+    m = RetrievalRecall(top_k=k)
+    m.update(preds, tgt, indexes=idx)
+    expected = _loop_oracle(idx, preds, tgt, lambda p, t: t[np.argsort(-p)][:k].sum() / t.sum())
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_module_aggregations_and_ignore_index():
+    idx, preds, tgt = _stream(17)
+    vals = []
+    for q in np.unique(idx):
+        m_ = idx == q
+        vals.append(
+            skm.average_precision_score(tgt[m_], preds[m_]) if tgt[m_].sum() else 0.0
+        )
+    for agg, red in [("median", np.median), ("min", np.min), ("max", np.max)]:
+        m = RetrievalMAP(aggregation=agg)
+        m.update(preds, tgt, indexes=idx)
+        np.testing.assert_allclose(float(m.compute()), red(vals), rtol=1e-5)
+    # ignore_index drops those docs entirely
+    tgt2 = tgt.copy()
+    tgt2[5:10] = -1
+    m = RetrievalMAP(ignore_index=-1)
+    m.update(preds, tgt2, indexes=idx)
+    keep = tgt2 != -1
+    expected = _loop_oracle(idx[keep], preds[keep], tgt2[keep], lambda p, t: skm.average_precision_score(t, p))
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_pr_curve_module_and_recall_at_precision():
+    idx, preds, tgt = _stream(19)
+    m = RetrievalPrecisionRecallCurve(max_k=5)
+    m.update(preds, tgt, indexes=idx)
+    prec, rec, topk = m.compute()
+    assert prec.shape == (5,) and rec.shape == (5,)
+    # oracle: mean of per-query curves
+    pcs, rcs = [], []
+    for q in np.unique(idx):
+        msk = idx == q
+        t, p = tgt[msk], preds[msk]
+        order = np.argsort(-p)
+        rel = np.cumsum(np.pad(t[order][:5].astype(float), (0, max(0, 5 - msk.sum()))))
+        if t.sum() == 0:
+            pcs.append(np.zeros(5)); rcs.append(np.zeros(5))
+        else:
+            pcs.append(rel / np.arange(1, 6)); rcs.append(rel / t.sum())
+    np.testing.assert_allclose(np.asarray(prec), np.mean(pcs, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(rec), np.mean(rcs, axis=0), rtol=1e-5)
+
+    m2 = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=5)
+    m2.update(preds, tgt, indexes=idx)
+    max_recall, best_k = m2.compute()
+    p_np, r_np = np.mean(pcs, axis=0), np.mean(rcs, axis=0)
+    valid = p_np >= 0.3
+    expected = max(r_np[valid]) if valid.any() else 0.0
+    np.testing.assert_allclose(float(max_recall), expected, rtol=1e-5)

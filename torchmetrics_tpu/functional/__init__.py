@@ -14,6 +14,8 @@ from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.pairwise import __all__ as _pairwise_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import __all__ as _segmentation_all
 
@@ -23,5 +25,6 @@ __all__ = (
     + list(_nominal_all)
     + list(_pairwise_all)
     + list(_regression_all)
+    + list(_retrieval_all)
     + list(_segmentation_all)
 )

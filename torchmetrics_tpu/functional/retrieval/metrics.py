@@ -1,0 +1,307 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Retrieval kernels (reference ``src/torchmetrics/functional/retrieval/*.py``).
+
+TPU-native design: every kernel has a *masked row* form
+``_<name>_kernel(preds, target, valid, ...)`` that operates on a fixed-width
+row where padded slots carry ``valid=False``, ``preds=-inf``, ``target=0``.
+The module layer packs each query into such a row and ``vmap``s the kernel
+over all queries — one fused XLA program instead of the reference's Python
+loop over queries (reference ``retrieval/base.py:147-182``). The public
+functions wrap the kernels for single-query 1D inputs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+_NEG_INF = -jnp.inf
+
+
+def _validate_top_k(top_k) -> None:
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+def _sorted_by_score(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """Row sorted by descending score; padded slots (-inf) land last."""
+    order = jnp.argsort(-preds)
+    return preds[order], target[order].astype(jnp.float32), valid[order]
+
+
+# ------------------------------------------------------------------ kernels
+def _average_precision_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """AP over a masked row (reference ``average_precision.py:22-61``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    k = n if top_k is None else min(top_k, n)
+    in_k = jnp.arange(n) < k
+    rel = (st > 0) & sv & in_k
+    positions = jnp.arange(1, n + 1, dtype=jnp.float32)
+    hits = jnp.cumsum(rel.astype(jnp.float32))
+    prec_at_hit = jnp.where(rel, hits / positions, 0.0)
+    n_rel = rel.sum()
+    return jnp.where(n_rel > 0, prec_at_hit.sum() / jnp.maximum(n_rel, 1), 0.0)
+
+
+def _reciprocal_rank_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """RR over a masked row (reference ``reciprocal_rank.py:22-58``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    k = n if top_k is None else min(top_k, n)
+    rel = (st > 0) & sv & (jnp.arange(n) < k)
+    first = jnp.argmax(rel)  # first True, or 0 if none
+    return jnp.where(rel.any(), 1.0 / (first + 1.0), 0.0)
+
+
+def _precision_kernel(
+    preds: Array, target: Array, valid: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Precision@k over a masked row (reference ``precision.py:22-62``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n_docs = sv.sum()
+    n = st.shape[0]
+    if top_k is None:
+        k = n_docs  # per-query length
+        in_k = jnp.arange(n) < k
+        denom = n_docs.astype(jnp.float32)
+    elif adaptive_k:
+        k = jnp.minimum(top_k, n_docs)
+        in_k = jnp.arange(n) < k
+        denom = k.astype(jnp.float32)
+    else:
+        in_k = jnp.arange(n) < min(top_k, n)
+        denom = float(top_k)
+    rel = ((st > 0) & sv & in_k).sum().astype(jnp.float32)
+    has_pos = ((target > 0) & valid).sum() > 0
+    return jnp.where(has_pos, rel / denom, 0.0)
+
+
+def _recall_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k over a masked row (reference ``recall.py:22-59``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    k = n if top_k is None else min(top_k, n)
+    rel = ((st > 0) & sv & (jnp.arange(n) < k)).sum().astype(jnp.float32)
+    total = ((target > 0) & valid).sum().astype(jnp.float32)
+    return jnp.where(total > 0, rel / jnp.maximum(total, 1.0), 0.0)
+
+
+def _hit_rate_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """HitRate@k over a masked row (reference ``hit_rate.py:22-58``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    k = n if top_k is None else min(top_k, n)
+    rel = ((st > 0) & sv & (jnp.arange(n) < k)).sum()
+    return (rel > 0).astype(jnp.float32)
+
+
+def _fall_out_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k over a masked row (reference ``fall_out.py:22-59``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    k = n if top_k is None else min(top_k, n)
+    nonrel_at_k = ((st == 0) & sv & (jnp.arange(n) < k)).sum().astype(jnp.float32)
+    total_nonrel = ((target == 0) & valid).sum().astype(jnp.float32)
+    return jnp.where(total_nonrel > 0, nonrel_at_k / jnp.maximum(total_nonrel, 1.0), 0.0)
+
+
+def _r_precision_kernel(preds: Array, target: Array, valid: Array) -> Array:
+    """R-precision over a masked row (reference ``r_precision.py:21-53``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    n_rel = ((target > 0) & valid).sum()
+    in_r = jnp.arange(n) < n_rel
+    rel = ((st > 0) & sv & in_r).sum().astype(jnp.float32)
+    return jnp.where(n_rel > 0, rel / jnp.maximum(n_rel, 1).astype(jnp.float32), 0.0)
+
+
+def _dcg_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int], ignore_ties: bool) -> Array:
+    """(Tie-averaged) DCG over a masked row (reference ``ndcg.py:25-59``).
+
+    Tie averaging uses the elementwise identity: sum over tie-groups of
+    (group mean gain)·(sum of group discounts) equals the per-position sum of
+    group-mean gain times discount — computed with segment sums, vmappable.
+    """
+    n = target.shape[0]
+    k = n if top_k is None else min(top_k, n)
+    discount = 1.0 / jnp.log2(jnp.arange(n, dtype=jnp.float32) + 2.0)
+    discount = jnp.where(jnp.arange(n) < k, discount, 0.0)
+
+    sp, st, sv = _sorted_by_score(preds, target, valid)
+    gains = jnp.where(sv, st, 0.0)
+    if ignore_ties:
+        return (discount * gains).sum()
+    # segment ids over equal sorted scores
+    new_seg = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32), (sp[1:] != sp[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(new_seg)
+    gsum = jax.ops.segment_sum(gains, seg, num_segments=n)
+    gcount = jax.ops.segment_sum(jnp.ones_like(gains), seg, num_segments=n)
+    gmean = gsum / jnp.maximum(gcount, 1.0)
+    return (gmean[seg] * discount).sum()
+
+
+def _ndcg_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """Normalized DCG over a masked row (reference ``ndcg.py:62-113``)."""
+    gain = _dcg_kernel(preds, target, valid, top_k, ignore_ties=False)
+    # ideal ordering: by target descending (no pred ties in the ideal ranking)
+    ideal_gain = _dcg_kernel(jnp.where(valid, target.astype(jnp.float32), _NEG_INF), target, valid, top_k, True)
+    return jnp.where(ideal_gain > 0, gain / jnp.maximum(ideal_gain, 1e-12), 0.0)
+
+
+def _auroc_kernel(preds: Array, target: Array, valid: Array, top_k: Optional[int] = None) -> Array:
+    """Exact AUROC over a masked row via the rank statistic
+    (Mann-Whitney U with midranks for ties — identical to the trapezoidal
+    exact-ROC AUC; reference ``auroc.py:22-73`` delegates to binary_auroc)."""
+    sp, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    if top_k is not None:
+        sv = sv & (jnp.arange(n) < min(top_k, n))
+    pos = (st > 0) & sv
+    neg = (st == 0) & sv
+    n_pos = pos.sum().astype(jnp.float32)
+    n_neg = neg.sum().astype(jnp.float32)
+    n_valid = sv.sum().astype(jnp.float32)
+    # ascending midranks from the descending-sorted row in O(n log n): the tie
+    # group's midrank is n_valid minus the mean 0-based sorted position of the
+    # group (same segment-sum trick as _dcg_kernel; padded -inf slots form a
+    # trailing group that the `pos` mask excludes)
+    new_seg = jnp.concatenate([jnp.zeros(1, dtype=jnp.int32), (sp[1:] != sp[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(new_seg)
+    positions = jnp.arange(n, dtype=jnp.float32)
+    gsum = jax.ops.segment_sum(positions, seg, num_segments=n)
+    gcount = jax.ops.segment_sum(jnp.ones(n), seg, num_segments=n)
+    gmean_pos = gsum / jnp.maximum(gcount, 1.0)
+    midrank = n_valid - gmean_pos[seg]
+    rank_sum_pos = jnp.where(pos, midrank, 0.0).sum()
+    auc = (rank_sum_pos - n_pos * (n_pos + 1) / 2) / jnp.maximum(n_pos * n_neg, 1.0)
+    return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.0)
+
+
+def _precision_recall_curve_kernel(
+    preds: Array, target: Array, valid: Array, max_k: int, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Per-k precision/recall over a masked row (reference
+    ``precision_recall_curve.py:24-77``)."""
+    _, st, sv = _sorted_by_score(preds, target, valid)
+    n = st.shape[0]
+    n_docs = sv.sum()
+    kk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+    if adaptive_k:
+        topk = jnp.minimum(kk, jnp.maximum(n_docs, 1).astype(jnp.float32))
+    else:
+        topk = kk
+    rel_sorted = jnp.where(sv, st, 0.0)[: min(max_k, n)]
+    rel_cum = jnp.cumsum(rel_sorted)
+    rel_cum = jnp.pad(rel_cum, (0, max(0, max_k - rel_cum.shape[0])), mode="edge") if rel_cum.shape[0] else jnp.zeros(max_k)
+    total = ((target > 0) & valid).sum().astype(jnp.float32)
+    recall = jnp.where(total > 0, rel_cum / jnp.maximum(total, 1.0), 0.0)
+    precision = jnp.where(total > 0, rel_cum / topk, 0.0)
+    return precision, recall, topk
+
+
+# ------------------------------------------------------------- public wrappers
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """AP of a single query (reference ``average_precision.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _average_precision_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """RR of a single query (reference ``reciprocal_rank.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _reciprocal_rank_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_precision(preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k of a single query (reference ``precision.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _precision_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k, adaptive_k)
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k of a single query (reference ``recall.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _recall_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """HitRate@k of a single query (reference ``hit_rate.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _hit_rate_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k of a single query (reference ``fall_out.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _fall_out_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision of a single query (reference ``r_precision.py:21``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    return _r_precision_kernel(preds, target, jnp.ones_like(preds, dtype=bool))
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """nDCG of a single query (reference ``ndcg.py:62``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    return _ndcg_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_auroc(
+    preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    """AUROC of a single query (reference ``auroc.py:22``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if top_k is not None:
+        _validate_top_k(top_k)
+    if max_fpr is not None:
+        # partial AUC rides the exact binary curve (host path)
+        from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+        n = preds.shape[0]
+        k = n if top_k is None else min(top_k, n)
+        order = jnp.argsort(-preds)[:k]
+        t = target[order]
+        if bool((t > 0).sum() == 0) or bool((t == 0).sum() == 0):
+            return jnp.asarray(0.0)
+        return binary_auroc(preds[order], t.astype(jnp.int32), max_fpr=max_fpr)
+    return _auroc_kernel(preds, target, jnp.ones_like(preds, dtype=bool), top_k)
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Per-k precision/recall of a single query (reference
+    ``precision_recall_curve.py:24``)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    return _precision_recall_curve_kernel(preds, target, jnp.ones_like(preds, dtype=bool), max_k, adaptive_k)

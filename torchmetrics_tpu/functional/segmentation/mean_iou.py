@@ -42,6 +42,8 @@ def _mean_iou_update(
     """Per-sample-per-class intersection/union (reference ``:44-68``)."""
     if input_format == "one-hot":
         _check_same_shape(preds, target)
+    if preds.ndim < (3 if input_format == "one-hot" else 2):
+        raise ValueError(f"Expected both `preds` and `target` to have at least 3 dimensions, but got {preds.ndim}.")
     preds, target = _segmentation_format(preds, target, num_classes, input_format)
     if not include_background:
         preds, target = _ignore_background(preds, target)

@@ -1,0 +1,155 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""RetrievalMetric base (reference ``src/torchmetrics/retrieval/base.py``).
+
+TPU-native compute: instead of sorting + splitting + a Python loop over
+queries (reference ``base.py:147-182``), queries are packed into a dense
+``(Q, Lmax)`` grid (row = query, columns = its documents, padded slots
+masked) and the per-query kernel is ``vmap``-ed over rows — one fused XLA
+program for the whole compute.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _check_retrieval_inputs
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable] = "mean", dim: Optional[int] = None) -> Array:
+    """Aggregate per-query values (reference ``base.py:26-40``)."""
+    if aggregation == "mean":
+        return values.mean() if dim is None else values.mean(axis=dim)
+    if aggregation == "median":
+        return jnp.median(values) if dim is None else jnp.median(values, axis=dim)
+    if aggregation == "min":
+        return values.min() if dim is None else values.min(axis=dim)
+    if aggregation == "max":
+        return values.max() if dim is None else values.max(axis=dim)
+    return aggregation(values, dim=dim)
+
+
+def _pack_queries(indexes: Array, preds: Array, target: Array) -> Tuple[Array, Array, Array]:
+    """Pack the flat (index, pred, target) stream into a dense (Q, Lmax) grid.
+
+    Padded slots carry ``valid=False``, ``preds=-inf``, ``target=0`` — the
+    contract of the masked row kernels in ``functional/retrieval/metrics.py``.
+    """
+    idx = np.asarray(indexes)
+    order = np.argsort(idx, kind="stable")
+    idx_sorted = idx[order]
+    # row id per element + position within its query
+    uniq, row = np.unique(idx_sorted, return_inverse=True)
+    counts = np.bincount(row)
+    q, lmax = len(uniq), int(counts.max()) if len(counts) else 0
+    col = np.arange(len(idx_sorted)) - np.concatenate([[0], np.cumsum(counts)[:-1]])[row]
+
+    preds_grid = np.full((q, lmax), -np.inf, dtype=np.float32)
+    target_grid = np.zeros((q, lmax), dtype=np.float32)
+    valid_grid = np.zeros((q, lmax), dtype=bool)
+    preds_np = np.asarray(preds)[order]
+    target_np = np.asarray(target)[order]
+    preds_grid[row, col] = preds_np
+    target_grid[row, col] = target_np
+    valid_grid[row, col] = True
+    return jnp.asarray(preds_grid), jnp.asarray(target_grid), jnp.asarray(valid_grid)
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for retrieval metrics (reference ``base.py:43``).
+
+    States: ``indexes``/``preds``/``target`` lists with gather-no-reduce
+    (reference ``:130-132``). ``compute`` groups by query and evaluates the
+    vmapped row kernel.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable function"
+                f"which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Append flattened (indexes, preds, target) (reference ``:134-145``)."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            jnp.asarray(indexes),
+            jnp.asarray(preds),
+            jnp.asarray(target),
+            allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _apply_empty_action(self, values: Array, mask: Array, missing: str = "positive") -> Array:
+        """Apply the empty-target policy to per-query values (reference ``:160-171``).
+
+        ``mask`` is True for queries that have the required target kind;
+        ``values`` may be ``(Q,)`` or ``(Q, K)`` (curve metrics).
+        """
+        if self.empty_target_action == "error" and bool((~mask).any()):
+            raise ValueError(f"`compute` method was provided with a query with no {missing} target.")
+        m = mask if values.ndim == 1 else mask[:, None]
+        if self.empty_target_action == "pos":
+            return jnp.where(m, values, 1.0)
+        if self.empty_target_action == "neg":
+            return jnp.where(m, values, 0.0)
+        if self.empty_target_action == "skip":
+            return values[jnp.asarray(np.asarray(mask))]
+        return values
+
+    def compute(self) -> Array:
+        """Group by query and evaluate the vmapped kernel (reference ``:147-182``)."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        preds_grid, target_grid, valid_grid = _pack_queries(indexes, preds, target)
+
+        values = jax.vmap(self._metric_row)(preds_grid, target_grid, valid_grid)  # (Q,)
+        has_pos = ((target_grid > 0) & valid_grid).sum(axis=1) > 0
+        values = self._apply_empty_action(values, has_pos)
+        if values.size == 0:
+            return jnp.asarray(0.0)
+        return _retrieval_aggregate(values, self.aggregation)
+
+    @abstractmethod
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        """Single-query masked-row kernel; vmapped over queries."""
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,250 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Retrieval module metrics (reference ``src/torchmetrics/retrieval/*.py``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.retrieval.metrics import (
+    _auroc_kernel,
+    _average_precision_kernel,
+    _fall_out_kernel,
+    _hit_rate_kernel,
+    _ndcg_kernel,
+    _precision_kernel,
+    _precision_recall_curve_kernel,
+    _r_precision_kernel,
+    _recall_kernel,
+    _reciprocal_rank_kernel,
+    _validate_top_k,
+)
+from torchmetrics_tpu.retrieval.base import RetrievalMetric, _pack_queries, _retrieval_aggregate
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision (reference ``retrieval/average_precision.py:30``)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _average_precision_kernel(preds, target, valid, self.top_k)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank (reference ``retrieval/reciprocal_rank.py:30``)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _reciprocal_rank_kernel(preds, target, valid, self.top_k)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k (reference ``retrieval/precision.py:30``)."""
+
+    def __init__(self, top_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _precision_kernel(preds, target, valid, self.top_k, self.adaptive_k)
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Recall@k (reference ``retrieval/recall.py:30``)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _recall_kernel(preds, target, valid, self.top_k)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """HitRate@k (reference ``retrieval/hit_rate.py:30``)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _hit_rate_kernel(preds, target, valid, self.top_k)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Fall-out@k (reference ``retrieval/fall_out.py:30``); empty-target
+    policy applies to queries with no NEGATIVE targets (reference ``:116-139``)."""
+
+    higher_is_better = False
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _fall_out_kernel(preds, target, valid, self.top_k)
+
+    def compute(self) -> Array:
+        """Same as base but keyed on queries with no negative target."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        preds_grid, target_grid, valid_grid = _pack_queries(indexes, preds, target)
+        values = jax.vmap(self._metric_row)(preds_grid, target_grid, valid_grid)
+        has_neg = ((target_grid == 0) & valid_grid).sum(axis=1) > 0
+        values = self._apply_empty_action(values, has_neg, missing="negative")
+        if values.size == 0:
+            return jnp.asarray(0.0)
+        return _retrieval_aggregate(values, self.aggregation)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-precision (reference ``retrieval/r_precision.py:30``)."""
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _r_precision_kernel(preds, target, valid)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Normalized DCG (reference ``retrieval/ndcg.py:30``); allows graded
+    relevance targets."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        self.top_k = top_k
+        self.allow_non_binary_target = True
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _ndcg_kernel(preds, target, valid, self.top_k)
+
+
+class RetrievalAUROC(RetrievalMetric):
+    """Mean AUROC over queries (reference ``retrieval/auroc.py:30``)."""
+
+    def __init__(self, top_k: Optional[int] = None, max_fpr: Optional[float] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if top_k is not None:
+            _validate_top_k(top_k)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.top_k = top_k
+        self.max_fpr = max_fpr
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:
+        return _auroc_kernel(preds, target, valid, self.top_k)
+
+    def compute(self) -> Array:
+        if self.max_fpr is None:
+            return super().compute()
+        # partial-AUC path: per-query host loop on the exact binary curve
+        from torchmetrics_tpu.functional.retrieval.metrics import retrieval_auroc
+
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = np.asarray(dim_zero_cat(self.preds))
+        target = np.asarray(dim_zero_cat(self.target))
+        values, has_pos = [], []
+        for q in np.unique(indexes):
+            m = indexes == q
+            has_pos.append(bool(target[m].sum() > 0))
+            values.append(float(retrieval_auroc(jnp.asarray(preds[m]), jnp.asarray(target[m]), self.top_k, self.max_fpr)))
+        values = self._apply_empty_action(jnp.asarray(values), jnp.asarray(has_pos))
+        if values.size == 0:
+            return jnp.asarray(0.0)
+        return _retrieval_aggregate(values, self.aggregation)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged per-k precision/recall curves (reference
+    ``retrieval/precision_recall_curve.py:45``)."""
+
+    def __init__(self, max_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if (max_k is not None) and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _metric_row(self, preds: Array, target: Array, valid: Array) -> Array:  # pragma: no cover - unused
+        raise NotImplementedError
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Mean per-k curves over queries (reference ``:169-201``)."""
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        preds_grid, target_grid, valid_grid = _pack_queries(indexes, preds, target)
+        lmax = preds_grid.shape[1]
+        max_k = self.max_k or lmax
+
+        prec, rec, topk = jax.vmap(
+            lambda p, t, v: _precision_recall_curve_kernel(p, t, v, max_k, self.adaptive_k)
+        )(preds_grid, target_grid, valid_grid)
+        has_pos = ((target_grid > 0) & valid_grid).sum(axis=1) > 0
+        prec = self._apply_empty_action(prec, has_pos)
+        rec = self._apply_empty_action(rec, has_pos)
+        precision = _retrieval_aggregate(prec, self.aggregation, dim=0) if prec.size else jnp.zeros(max_k)
+        recall = _retrieval_aggregate(rec, self.aggregation, dim=0) if rec.size else jnp.zeros(max_k)
+        return precision, recall, jnp.arange(1, max_k + 1)
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array, recall: Array, top_k: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Max recall whose precision >= min_precision (reference
+    ``retrieval/precision_recall_curve.py:26-42``)."""
+    p, r, k = np.asarray(precision), np.asarray(recall), np.asarray(top_k)
+    valid = p >= min_precision
+    if valid.any():
+        cand = [(rr, kk) for pp, rr, kk in zip(p, r, k) if pp >= min_precision]
+        max_recall, best_k = max(cand)
+    else:
+        max_recall, best_k = 0.0, len(k)
+    if max_recall == 0.0:
+        best_k = len(k)
+    return jnp.asarray(max_recall, jnp.float32), jnp.asarray(best_k)
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Highest recall@k at a minimum precision (reference
+    ``retrieval/precision_recall_curve.py:204``)."""
+
+    def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
+        super().__init__(max_k=max_k, adaptive_k=adaptive_k, **kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precision, recall, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)
