@@ -1,0 +1,112 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""BLEU score (reference ``src/torchmetrics/functional/text/bleu.py``)."""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _count_ngram
+
+Array = jax.Array
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    """Whitespace tokenizer (reference ``bleu.py:44-51``)."""
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: Array,
+    denominator: Array,
+    preds_len: Array,
+    target_len: Array,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[Array, Array, Array, Array]:
+    """Clipped n-gram counts + closest-length bookkeeping (reference ``bleu.py:54-101``).
+
+    Differs from the reference in that the accumulators are returned
+    functionally (immutable arrays) instead of mutated in place.
+    """
+    target_tok: Sequence[Sequence[Sequence[str]]] = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok: Sequence[Sequence[str]] = [tokenizer(line) if line else [] for line in preds]
+    numerator_np = jnp.asarray(numerator).tolist()
+    denominator_np = jnp.asarray(denominator).tolist()
+    preds_len_acc = float(preds_len)
+    target_len_acc = float(target_len)
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len_acc += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        target_len_acc += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter: Counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+        ngram_counter_clip = preds_counter & target_counter
+        for counter_clip in ngram_counter_clip:
+            numerator_np[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in preds_counter:
+            denominator_np[len(counter) - 1] += preds_counter[counter]
+    return (
+        jnp.asarray(numerator_np),
+        jnp.asarray(denominator_np),
+        jnp.asarray(preds_len_acc),
+        jnp.asarray(target_len_acc),
+    )
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Geometric-mean precision with brevity penalty (reference ``bleu.py:104-137``)."""
+    if float(jnp.min(numerator)) == 0.0:
+        return jnp.asarray(0.0)
+    if smooth:
+        precision_scores = (numerator + jnp.ones(n_gram)) / (denominator + jnp.ones(n_gram))
+        precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision_scores = numerator / denominator
+    log_precision_scores = jnp.asarray(weights) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    return brevity_penalty * geometric_mean
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU score of a translated corpus (reference ``bleu.py:140-192``)."""
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = jnp.zeros(n_gram)
+    denominator = jnp.zeros(n_gram)
+    preds_len = jnp.asarray(0.0)
+    target_len = jnp.asarray(0.0)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, preds_len, target_len, n_gram
+    )
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

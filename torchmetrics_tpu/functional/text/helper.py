@@ -1,0 +1,56 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Text helpers: edit distance and n-gram counting (reference
+``src/torchmetrics/functional/text/helper.py``).
+
+String processing is inherently host-side scalar work; these helpers stay in
+Python/numpy and feed scalar counts into device-resident metric states.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1) -> int:
+    """Levenshtein distance between token sequences (reference ``helper.py:34-51``),
+    vectorized row-wise in numpy (the DP recurrence stays, the inner loop goes)."""
+    m, n = len(prediction_tokens), len(reference_tokens)
+    if m == 0:
+        return n
+    if n == 0:
+        return m
+    ref = np.array([hash(t) for t in reference_tokens])
+    prev = np.arange(n + 1)
+    idx = np.arange(n + 1)
+    for i, p_tok in enumerate(prediction_tokens, start=1):
+        sub = prev[:-1] + np.where(ref == hash(p_tok), 0, substitution_cost)
+        delete = prev[1:] + 1
+        best = np.minimum(sub, delete)
+        # fold the sequential insertion recurrence cur[j] = min(best[j], cur[j-1]+1)
+        # via e[j] = cur[j] - j  =>  e[j] = min(best[j] - j, e[j-1]), a prefix min
+        e = np.minimum.accumulate(np.concatenate(([i], best - idx[1:])))
+        prev = e + idx
+    return int(prev[n])
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """All n-grams up to ``n_gram`` (reference ``bleu.py:25-41``)."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j : i + j])] += 1
+    return ngram_counter
+
+
+def _normalize_inputs(
+    preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+) -> Tuple[List[str], List[str]]:
+    """Promote single strings to lists and validate pairing."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    return list(preds), list(target)

@@ -1,0 +1,66 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Perplexity (reference ``src/torchmetrics/functional/text/perplexity.py``).
+
+Fully jnp — the one text metric whose hot path belongs on the TPU (log-probs
+over a [B, T, V] logits tensor).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    """Validate [B, T, V] logits vs [B, T] targets (reference ``:21-60``)."""
+    if preds.ndim != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of floating point type but got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """Summed -log p(target) + token count (reference ``:63-96``), via
+    log-softmax gather (no explicit softmax materialization)."""
+    _check_shape_and_type_consistency(preds, target)
+    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]), axis=-1)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        mask = target != ignore_index
+        target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+    token_log_probs = jnp.take_along_axis(log_probs, target[:, None], axis=1).squeeze(1)
+    total_log_probs = -jnp.where(mask, token_log_probs, 0.0).sum()
+    count = mask.sum()
+    return total_log_probs, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    """exp(mean -log p) (reference ``:99-110``)."""
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity of a language model (reference ``:113-140``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

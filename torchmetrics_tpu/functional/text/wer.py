@@ -1,0 +1,120 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Word/char/match error rates and word-information metrics (reference
+``src/torchmetrics/functional/text/{wer,cer,mer,wil,wip}.py``)."""
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance, _normalize_inputs
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed edit ops + reference word count (reference ``wer.py:22-47``)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens, tgt_tokens = pred.split(), tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    """errors / total (reference ``wer.py:50-59``)."""
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word error rate (reference ``wer.py:62-84``)."""
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)
+
+
+def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed char edit ops + reference char count (reference ``cer.py:22-48``)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = total = 0
+    for pred, tgt in zip(preds, target):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    """errors / total (reference ``cer.py:51-60``)."""
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Character error rate (reference ``cer.py:63-85``)."""
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Summed edit ops + max(len) count (reference ``mer.py:22-48``)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens, tgt_tokens = pred.split(), tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    """errors / total (reference ``mer.py:51-60``)."""
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate (reference ``mer.py:63-86``)."""
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)
+
+
+def _wil_wip_update(
+    preds: Union[str, List[str]], target: Union[str, List[str]]
+) -> Tuple[Array, Array, Array]:
+    """Shared accumulation of WIL/WIP (reference ``wil.py:21-52``, ``wip.py:21-52``)."""
+    preds, target = _normalize_inputs(preds, target)
+    errors = total = target_total = preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens, target_tokens = pred.split(), tgt.split()
+        errors += _edit_distance(pred_tokens, target_tokens)
+        target_total += len(target_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(target_tokens), len(pred_tokens))
+    return (
+        jnp.asarray(float(errors - total)),
+        jnp.asarray(float(target_total)),
+        jnp.asarray(float(preds_total)),
+    )
+
+
+def _word_info_lost_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """1 - (C/N_t)(C/N_p) (reference ``wil.py:55-66``)."""
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information lost (reference ``wil.py:69-90``)."""
+    errors, target_total, preds_total = _wil_wip_update(preds, target)
+    return _word_info_lost_compute(errors, target_total, preds_total)
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    """(C/N_t)(C/N_p) (reference ``wip.py:55-66``)."""
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information preserved (reference ``wip.py:69-90``)."""
+    errors, target_total, preds_total = _wil_wip_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)
