@@ -124,3 +124,42 @@ def test_graft_dryrun_multichip(n_devices):
     import __graft_entry__ as graft
 
     graft.dryrun_multichip(n_devices)
+
+
+class _MeanState(Metric):
+    """Metric with a dist_reduce_fx="mean" state — regression guard for the
+    weighted running-average merge (repeated pairwise (a+b)/2 would decay the
+    first batch's weight exponentially)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("avg", jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, values):
+        self.avg = jnp.mean(values)
+
+    def compute(self):
+        return self.avg
+
+
+def test_jit_update_mean_state_weighted_merge():
+    batches = [jnp.full((8,), float(i)) for i in range(4)]  # batch means 0,1,2,3
+    metric = _MeanState()
+    step, state = make_jit_update(metric)
+    for b in batches:
+        state = step(state, b)
+    metric.load_state_tree(state)
+    assert metric._update_count == 4
+    # true mean of the 4 batch means is 1.5; decaying pairwise merge gives
+    # 0*2^-3 + 1*2^-3 + 2*2^-2 + 3*2^-1 = 2.125
+    assert np.allclose(float(metric.compute()), 1.5)
+
+
+def test_sharded_update_mean_state_weighted_merge():
+    mesh = _mesh()
+    metric = _MeanState()
+    for i in range(3):
+        sharded_update(metric, mesh, jnp.full((16,), float(i)))
+    assert np.allclose(float(metric.compute()), 1.0)

@@ -63,9 +63,9 @@ def test_binary_calibration_error(norm):
     rng = _rng()
     preds = rng.rand(N).astype(np.float32)
     target = (rng.rand(N) < preds).astype(np.int32)
-    conf = np.where(preds >= 0.5, preds, 1 - preds)
-    acc = ((preds >= 0.5).astype(int) == target).astype(float)
-    expected = _ece_oracle(conf, acc, 15, norm)
+    # reference semantics (_binary_calibration_error_update): confidences are
+    # the raw positive-class probabilities, accuracies are the binary targets
+    expected = _ece_oracle(preds, target.astype(float), 15, norm)
     got = float(F.binary_calibration_error(preds, target, n_bins=15, norm=norm))
     np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
     # module path, streamed
@@ -177,6 +177,15 @@ def test_dice():
     logits = rng.randn(N, C).astype(np.float32)
     expected = skm.f1_score(target, logits.argmax(-1), average="micro")
     np.testing.assert_allclose(float(F.dice(logits, target, average="micro")), expected, rtol=1e-5)
+
+
+def test_dice_macro_drops_zero_support_classes():
+    # classes absent from both preds and target must not dilute the macro mean
+    # (reference dice.py:46-49 filters tp+fp+fn == 0 rows before averaging)
+    preds = np.array([0, 0, 1, 1])
+    target = np.array([0, 1, 1, 1])
+    got = float(F.dice(preds, target, num_classes=3, average="macro"))
+    np.testing.assert_allclose(got, (2 / 3 + 4 / 5) / 2, rtol=1e-6)
 
 
 def test_group_fairness():

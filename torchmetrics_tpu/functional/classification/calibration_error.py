@@ -106,15 +106,18 @@ def _binary_calibration_error_format(
 
 
 def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
-    """Top-1 confidences and accuracies (reference ``:136-138``).
+    """Confidences and accuracies (reference ``:136-138``): the confidence is
+    the raw positive-class probability and the accuracy is the binary target —
+    no top-1 folding, matching the reference's ``confidences, accuracies =
+    preds, target``.
 
     Ignored positions (target == -1) are encoded with the out-of-range
     confidence sentinel 2.0, which ``_binning_bucketize`` masks out of every
     bin — shapes stay static, so this is jit/shard_map-safe.
     """
     valid = target >= 0
-    confidences = jnp.where(valid, jnp.where(preds >= 0.5, preds, 1 - preds), 2.0)
-    accuracies = (valid & (jnp.where(preds >= 0.5, 1, 0) == target)).astype(preds.dtype)
+    confidences = jnp.where(valid, preds, 2.0)
+    accuracies = jnp.where(valid, target, 0).astype(preds.dtype)
     return confidences, accuracies
 
 

@@ -119,7 +119,11 @@ def _dice_compute(
     if average in (None, "none"):
         return per_class
     if average == "macro":
-        return jnp.where(keep, per_class, 0.0).sum() / keep.sum()
+        # drop classes with zero support from the mean (reference ``dice.py:46-49``:
+        # cond = tp+fp+fn == 0 rows are filtered before averaging)
+        support = (tp + fp + fn) > 0
+        keep_sup = keep & support
+        return _safe_divide(jnp.where(keep_sup, per_class, 0.0).sum(), keep_sup.sum(), zero_division)
     if average == "weighted":
         weights = jnp.where(keep, tp + fn, 0.0)
         return _safe_divide((per_class * weights).sum(), weights.sum(), zero_division)

@@ -67,7 +67,12 @@ def _generalized_dice_update(
     else:  # square
         weights = 1.0 / (target_sum**2)
 
-    # replace inf weights (empty classes) with the per-sample max finite weight
+    # Replace inf weights (empty ground-truth classes) with the per-sample max
+    # finite weight. DELIBERATE DEVIATION from the reference
+    # (``generalized_dice.py:73-78``), which substitutes a per-class max over
+    # the batch through transpose-based flat indexing that mismatches the
+    # row-major layout of the weights; the per-sample max used here matches
+    # MONAI's GeneralizedDiceScore behavior and is batch-size invariant.
     infs = jnp.isinf(weights)
     finite = jnp.where(infs, 0.0, weights)
     w_max = finite.max(axis=1, keepdims=True)

@@ -179,8 +179,16 @@ class Metric:
         return {attr: getattr(self, attr) for attr in self._defaults}
 
     def load_state_tree(self, tree: Dict[str, Any]) -> None:
-        """Install a pytree of (possibly traced) values as the current state."""
+        """Install a pytree of (possibly traced) values as the current state.
+
+        The reserved key ``"_update_count"`` (threaded by
+        ``parallel.make_jit_update`` so ``"mean"`` states merge as a weighted
+        running average) restores the update counter instead of a state.
+        """
         for attr, value in tree.items():
+            if attr == "_update_count":
+                self._update_count = int(value)
+                continue
             if attr not in self._defaults:
                 raise KeyError(f"Unknown metric state {attr!r}")
             setattr(self, attr, value)

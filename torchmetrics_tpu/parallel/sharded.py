@@ -46,18 +46,22 @@ _SHARDED_FN_CACHE: Dict[Tuple, Tuple] = {}
 # ------------------------------------------------------------------ pure merge
 
 
-def metric_merge(reduction: Optional[str | Callable], a: Any, b: Any) -> Any:
+def metric_merge(
+    reduction: Optional[str | Callable], a: Any, b: Any, weight_a: Any = 1.0, weight_b: Any = 1.0
+) -> Any:
     """Pairwise-merge two state values under a declared reduction.
 
     The pure generalization of reference ``Metric._reduce_states``
-    (``metric.py:401-433``); jit-safe for array states.
+    (``metric.py:401-433``); jit-safe for array states. ``weight_a``/``weight_b``
+    are the update counts behind each part, used to merge ``"mean"`` states as
+    a correctly weighted average (the reference's ``metric.py:317`` running-avg
+    semantics) — with the defaults, a pair of equal-weight parts averages to
+    ``(a + b) / 2``.
     """
     if reduction == "sum":
         return a + b
     if reduction == "mean":
-        # matches the reference gather-then-``dim_zero_mean`` semantics
-        # (metric.py:459-474): the merged value is the mean of the parts
-        return (a + b) / 2
+        return (weight_a * a + weight_b * b) / (weight_a + weight_b)
     if reduction == "max":
         return jnp.maximum(a, b)
     if reduction == "min":
@@ -73,9 +77,19 @@ def metric_merge(reduction: Optional[str | Callable], a: Any, b: Any) -> Any:
     raise ValueError(f"Unknown reduction {reduction!r}")
 
 
-def tree_merge(reductions: Dict[str, Any], state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
-    """Merge two state pytrees keyed by per-state reductions."""
-    return {k: metric_merge(reductions[k], state_a[k], state_b[k]) for k in state_a}
+def tree_merge(
+    reductions: Dict[str, Any],
+    state_a: Dict[str, Any],
+    state_b: Dict[str, Any],
+    weight_a: Any = 1.0,
+    weight_b: Any = 1.0,
+) -> Dict[str, Any]:
+    """Merge two state pytrees keyed by per-state reductions.
+
+    ``weight_a``/``weight_b`` are the update counts behind each pytree; they
+    only affect ``"mean"`` states (weighted running average).
+    """
+    return {k: metric_merge(reductions[k], state_a[k], state_b[k], weight_a, weight_b) for k in state_a}
 
 
 def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
@@ -121,8 +135,11 @@ def make_jit_update(metric: "Any") -> Tuple[Callable[..., Dict[str, Any]], Dict[
     device speed with no per-op dispatch. Array states only (``cat``/list
     states are inherently dynamic; use binned variants).
 
-    Fold the final state back with ``metric.load_state_tree(state)`` followed
-    by ``metric._update_count += n`` (or just call ``compute`` on a clone).
+    The state pytree carries the update count under the reserved key
+    ``"_update_count"`` so ``"mean"`` states merge as a correctly weighted
+    running average (reference ``metric.py:317``) instead of decaying
+    pairwise means. Fold the final state back with
+    ``metric.load_state_tree(state)`` — the count is restored too.
     """
     reductions = dict(metric._reductions)
     list_state_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
@@ -132,10 +149,17 @@ def make_jit_update(metric: "Any") -> Tuple[Callable[..., Dict[str, Any]], Dict[
             " jitted accumulation requires fixed-shape array states."
         )
     init_state = {k: jnp.asarray(v) for k, v in metric._defaults.items()}
+    init_state["_update_count"] = jnp.asarray(0, jnp.int32)
 
     def step(state: Dict[str, Any], *batch: Any) -> Dict[str, Any]:
+        state = dict(state)
+        count = state.pop("_update_count")
         fresh = _batch_update_state(metric, batch, {})
-        return tree_merge(reductions, state, fresh)
+        # mean states: weighted running average; count==0 degenerates to the
+        # fresh state exactly ((0*a + 1*b)/1 == b), so no special first step
+        merged = tree_merge(reductions, state, fresh, weight_a=count, weight_b=1)
+        merged["_update_count"] = count + 1
+        return merged
 
     return jax.jit(step), init_state
 
@@ -241,14 +265,15 @@ def sharded_update(
     update_fn = entry[2]
     merged = update_fn(*args)
     current = metric.state_tree()
-    defaults = metric._defaults
-    is_first = metric._update_count == 0
+    prev_count = metric._update_count
     metric._computed = None
     metric._update_count += 1
-    if is_first:
+    if prev_count == 0:
         metric.load_state_tree(merged)
     else:
-        metric.load_state_tree(tree_merge(metric._reductions, current, merged))
+        # mean states: weight the running state by its update count so
+        # repeated folds stay a true running average (reference metric.py:317)
+        metric.load_state_tree(tree_merge(metric._reductions, current, merged, weight_a=prev_count, weight_b=1))
 
 
 class ShardedMetric:
