@@ -1,0 +1,204 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Independent numpy COCO-evaluation oracle for the detection tests.
+
+A from-scratch reimplementation of the published pycocotools ``COCOeval``
+bbox algorithm (greedy per-category matching, crowd/ignore/area-range/maxDet
+rules, 101-point interpolation) using explicit Python loops — deliberately
+structured nothing like the framework's vectorized JAX evaluator so that
+agreement between the two is meaningful (the role sklearn plays for the
+classification tests; pycocotools itself is not installed in this image).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AREA_RNGS = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+
+
+def _iou_single(d: np.ndarray, g: np.ndarray, crowd: bool) -> float:
+    ix = max(0.0, min(d[2], g[2]) - max(d[0], g[0]))
+    iy = max(0.0, min(d[3], g[3]) - max(d[1], g[1]))
+    inter = ix * iy
+    da = (d[2] - d[0]) * (d[3] - d[1])
+    ga = (g[2] - g[0]) * (g[3] - g[1])
+    union = da if crowd else da + ga - inter
+    return inter / union if union > 0 else 0.0
+
+
+def _evaluate_img(
+    dt_boxes, dt_scores, gt_boxes, gt_crowd, gt_area, iou_thrs, area_rng, max_det
+) -> Optional[dict]:
+    """pycocotools evaluateImg for one (image, category, areaRng)."""
+    num_gt, num_dt = len(gt_boxes), len(dt_boxes)
+    if num_gt == 0 and num_dt == 0:
+        return None
+    gt_ig_base = np.array(
+        [bool(c) or a < area_rng[0] or a > area_rng[1] for c, a in zip(gt_crowd, gt_area)], dtype=bool
+    )
+    # sort gts ignored-last, dets by score desc (stable), truncate dets
+    gtind = np.argsort(gt_ig_base.astype(np.uint8), kind="mergesort")
+    dtind = np.argsort(-np.asarray(dt_scores), kind="mergesort")[:max_det]
+    gt_boxes = [gt_boxes[i] for i in gtind]
+    gt_crowd_s = [gt_crowd[i] for i in gtind]
+    gt_ig = gt_ig_base[gtind]
+    dt_boxes = [dt_boxes[i] for i in dtind]
+    dt_scores_s = [dt_scores[i] for i in dtind]
+    num_dt = len(dt_boxes)
+
+    T = len(iou_thrs)
+    gtm = -np.ones((T, num_gt), dtype=np.int64)
+    dtm = -np.ones((T, num_dt), dtype=np.int64)
+    dt_ig = np.zeros((T, num_dt), dtype=bool)
+    for tind, t in enumerate(iou_thrs):
+        for dind in range(num_dt):
+            iou = min(t, 1 - 1e-10)
+            m = -1
+            for gind in range(num_gt):
+                if gtm[tind, gind] >= 0 and not gt_crowd_s[gind]:
+                    continue
+                if m > -1 and not gt_ig[m] and gt_ig[gind]:
+                    break
+                val = _iou_single(np.asarray(dt_boxes[dind]), np.asarray(gt_boxes[gind]), bool(gt_crowd_s[gind]))
+                if val < iou:
+                    continue
+                iou = val
+                m = gind
+            if m == -1:
+                continue
+            dt_ig[tind, dind] = gt_ig[m]
+            dtm[tind, dind] = m
+            gtm[tind, m] = dind
+    # unmatched dets outside the area range are ignored
+    a = np.array(
+        [
+            (b[2] - b[0]) * (b[3] - b[1]) < area_rng[0] or (b[2] - b[0]) * (b[3] - b[1]) > area_rng[1]
+            for b in dt_boxes
+        ],
+        dtype=bool,
+    ).reshape(1, -1)
+    dt_ig = np.logical_or(dt_ig, np.logical_and(dtm < 0, np.repeat(a, T, 0)))
+    return {
+        "dtMatches": dtm >= 0,
+        "dtScores": np.asarray(dt_scores_s, np.float64),
+        "gtIgnore": gt_ig,
+        "dtIgnore": dt_ig,
+    }
+
+
+def coco_eval_oracle(
+    preds: Sequence[Dict[str, np.ndarray]],
+    target: Sequence[Dict[str, np.ndarray]],
+    iou_thrs: Optional[Sequence[float]] = None,
+    rec_thrs: Optional[Sequence[float]] = None,
+    max_dets: Sequence[int] = (1, 10, 100),
+) -> Dict[str, float]:
+    """Full bbox COCO evaluation; returns the torchmetrics result keys."""
+    iou_thrs = np.asarray(iou_thrs if iou_thrs is not None else np.linspace(0.5, 0.95, 10), np.float64)
+    rec_thrs = np.asarray(rec_thrs if rec_thrs is not None else np.linspace(0.0, 1.0, 101), np.float64)
+    max_dets = sorted(max_dets)
+    n_imgs = len(preds)
+    cats = sorted(
+        {int(c) for p in preds for c in np.asarray(p["labels"]).ravel()}
+        | {int(c) for t in target for c in np.asarray(t["labels"]).ravel()}
+    )
+    area_names = list(AREA_RNGS)
+    T, R, K, A, M = len(iou_thrs), len(rec_thrs), len(cats), len(area_names), len(max_dets)
+    precision = -np.ones((T, R, K, A, M))
+    recall = -np.ones((T, K, A, M))
+
+    eval_imgs = {}
+    for ki, cat in enumerate(cats):
+        for ai, aname in enumerate(area_names):
+            for i in range(n_imgs):
+                p, t = preds[i], target[i]
+                psel = np.asarray(p["labels"]).ravel() == cat
+                tsel = np.asarray(t["labels"]).ravel() == cat
+                gt_boxes = np.asarray(t["boxes"], np.float64).reshape(-1, 4)[tsel]
+                crowd_full = np.asarray(t.get("iscrowd", np.zeros(np.asarray(t["labels"]).size))).ravel()
+                crowd = crowd_full[tsel]
+                area = t.get("area")
+                if area is not None and np.asarray(area).size:
+                    garea = np.asarray(area, np.float64).ravel()[tsel]
+                else:
+                    garea = (gt_boxes[:, 2] - gt_boxes[:, 0]) * (gt_boxes[:, 3] - gt_boxes[:, 1])
+                eval_imgs[(ki, ai, i)] = _evaluate_img(
+                    list(np.asarray(p["boxes"], np.float64).reshape(-1, 4)[psel]),
+                    list(np.asarray(p["scores"], np.float64).ravel()[psel]),
+                    list(gt_boxes),
+                    list(crowd),
+                    list(garea),
+                    iou_thrs,
+                    AREA_RNGS[aname],
+                    max_dets[-1],
+                )
+
+    eps = np.spacing(np.float64(1))
+    for ki in range(K):
+        for ai in range(A):
+            for mi, mdet in enumerate(max_dets):
+                es = [eval_imgs[(ki, ai, i)] for i in range(n_imgs)]
+                es = [e for e in es if e is not None]
+                if not es:
+                    continue
+                dt_scores = np.concatenate([e["dtScores"][:mdet] for e in es])
+                inds = np.argsort(-dt_scores, kind="mergesort")
+                dt_scores_sorted = dt_scores[inds]
+                dtm = np.concatenate([e["dtMatches"][:, :mdet] for e in es], axis=1)[:, inds]
+                dt_ig = np.concatenate([e["dtIgnore"][:, :mdet] for e in es], axis=1)[:, inds]
+                gt_ig = np.concatenate([e["gtIgnore"] for e in es])
+                npig = int((~gt_ig).sum())
+                if npig == 0:
+                    continue
+                tps = np.logical_and(dtm, ~dt_ig)
+                fps = np.logical_and(~dtm, ~dt_ig)
+                tp_sum = np.cumsum(tps, axis=1).astype(np.float64)
+                fp_sum = np.cumsum(fps, axis=1).astype(np.float64)
+                for ti in range(T):
+                    tp, fp = tp_sum[ti], fp_sum[ti]
+                    nd = len(tp)
+                    rc = tp / npig
+                    pr = tp / (fp + tp + eps)
+                    recall[ti, ki, ai, mi] = rc[-1] if nd else 0
+                    pr = pr.tolist()
+                    for i in range(nd - 1, 0, -1):
+                        if pr[i] > pr[i - 1]:
+                            pr[i - 1] = pr[i]
+                    q = np.zeros(R)
+                    inds_r = np.searchsorted(rc, rec_thrs, side="left")
+                    for ri, pi in enumerate(inds_r):
+                        if pi < nd:
+                            q[ri] = pr[pi]
+                    precision[ti, :, ki, ai, mi] = q
+
+    def _summ(ap: bool, iou_thr=None, area="all", mdet=max_dets[-1]) -> float:
+        ai = area_names.index(area)
+        mi = max_dets.index(mdet)
+        s = precision[:, :, :, ai, mi] if ap else recall[:, :, ai, mi]
+        if iou_thr is not None:
+            tidx = np.where(np.isclose(iou_thrs, iou_thr))[0]
+            s = s[tidx]
+        s = s[s > -1]
+        return float(np.mean(s)) if s.size else -1.0
+
+    out = {
+        "map": _summ(True),
+        "map_50": _summ(True, 0.5) if np.any(np.isclose(iou_thrs, 0.5)) else -1.0,
+        "map_75": _summ(True, 0.75) if np.any(np.isclose(iou_thrs, 0.75)) else -1.0,
+        "map_small": _summ(True, area="small"),
+        "map_medium": _summ(True, area="medium"),
+        "map_large": _summ(True, area="large"),
+        "mar_small": _summ(False, area="small"),
+        "mar_medium": _summ(False, area="medium"),
+        "mar_large": _summ(False, area="large"),
+    }
+    for mdet in max_dets:
+        out[f"mar_{mdet}"] = _summ(False, mdet=mdet)
+    return out

@@ -1,0 +1,192 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""IoU-family and panoptic quality tests (analogue of reference
+``tests/unittests/detection/test_intersection.py`` and
+``test_panoptic_quality.py``; fixture values from the reference's documented
+examples)."""
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.functional.detection as FD
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+
+# the reference's shared doctest fixtures (functional/detection/iou.py:70-92)
+_PREDS = np.array(
+    [
+        [296.55, 93.96, 314.97, 152.79],
+        [328.94, 97.05, 342.49, 122.98],
+        [356.62, 95.47, 372.33, 147.55],
+    ]
+)
+_TARGET = np.array(
+    [
+        [300.00, 100.00, 315.00, 150.00],
+        [330.00, 100.00, 350.00, 125.00],
+        [350.00, 100.00, 375.00, 150.00],
+    ]
+)
+
+
+def _iou_oracle(a, b):
+    ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+    iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+    inter = ix * iy
+    union = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / union
+
+
+def test_iou_functional_reference_values():
+    # documented aggregate value (reference functional/detection/iou.py:89)
+    np.testing.assert_allclose(float(FD.intersection_over_union(_PREDS, _TARGET)), 0.5879, atol=1e-4)
+    mat = np.asarray(FD.intersection_over_union(_PREDS, _TARGET, aggregate=False))
+    expected = np.array([[_iou_oracle(p, t) for t in _TARGET] for p in _PREDS])
+    np.testing.assert_allclose(mat, expected, atol=1e-5)
+
+
+def test_giou_diou_ciou_reference_diagonal():
+    # reference doctest values: giou 0.5638, diou 0.5793, ciou 0.5790
+    np.testing.assert_allclose(float(FD.generalized_intersection_over_union(_PREDS, _TARGET)), 0.5638, atol=1e-4)
+    np.testing.assert_allclose(float(FD.distance_intersection_over_union(_PREDS, _TARGET)), 0.5793, atol=1e-4)
+    np.testing.assert_allclose(float(FD.complete_intersection_over_union(_PREDS, _TARGET)), 0.5790, atol=1e-4)
+
+
+def test_iou_self_comparison_is_one():
+    for fn in (
+        FD.intersection_over_union,
+        FD.generalized_intersection_over_union,
+        FD.distance_intersection_over_union,
+        FD.complete_intersection_over_union,
+    ):
+        np.testing.assert_allclose(float(fn(_PREDS, _PREDS)), 1.0, atol=1e-5)
+
+
+def test_iou_module_respect_labels():
+    # reference detection/iou.py doctest: mixed labels -> 0.8614 for matching pair
+    preds = [
+        {
+            "boxes": np.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "labels": np.array([4, 5]),
+        }
+    ]
+    target = [{"boxes": np.array([[300.00, 100.00, 315.00, 150.00]]), "labels": np.array([5])}]
+    metric = IntersectionOverUnion()
+    metric.update(preds, target)
+    res = metric.compute()
+    np.testing.assert_allclose(float(res["iou"]), 0.8614, atol=1e-4)
+
+
+def test_iou_module_class_metrics():
+    preds = [
+        {
+            "boxes": np.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "labels": np.array([4, 5]),
+        }
+    ]
+    target = [
+        {
+            "boxes": np.array([[300.00, 100.00, 315.00, 150.00], [300.00, 100.00, 315.00, 150.00]]),
+            "labels": np.array([4, 5]),
+        }
+    ]
+    metric = IntersectionOverUnion(class_metrics=True)
+    metric.update(preds, target)
+    res = metric.compute()
+    np.testing.assert_allclose(float(res["iou"]), 0.7756, atol=1e-4)
+    np.testing.assert_allclose(float(res["iou/cl_4"]), 0.6898, atol=1e-4)
+    np.testing.assert_allclose(float(res["iou/cl_5"]), 0.8614, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "cls", [GeneralizedIntersectionOverUnion, DistanceIntersectionOverUnion, CompleteIntersectionOverUnion]
+)
+def test_iou_variant_modules_run(cls):
+    preds = [{"boxes": _PREDS, "labels": np.array([0, 1, 2])}]
+    target = [{"boxes": _TARGET, "labels": np.array([0, 1, 2])}]
+    metric = cls()
+    metric.update(preds, target)
+    res = metric.compute()
+    assert metric._iou_type in res
+    assert np.isfinite(float(res[metric._iou_type]))
+
+
+# ---------------------------------------------------------------- panoptic
+# fixtures from the reference doctest (functional/detection/panoptic_qualities.py:91-118)
+_PQ_PREDS = np.array(
+    [
+        [[[6, 0], [0, 0], [6, 0], [6, 0]],
+         [[0, 0], [0, 0], [6, 0], [0, 1]],
+         [[0, 0], [0, 0], [6, 0], [0, 1]],
+         [[0, 0], [7, 0], [6, 0], [1, 0]],
+         [[0, 0], [7, 0], [7, 0], [7, 0]]]
+    ]
+)
+_PQ_TARGET = np.array(
+    [
+        [[[6, 0], [0, 1], [6, 0], [0, 1]],
+         [[0, 1], [0, 1], [6, 0], [0, 1]],
+         [[0, 1], [0, 1], [6, 0], [1, 0]],
+         [[0, 1], [7, 0], [1, 0], [1, 0]],
+         [[0, 1], [7, 0], [7, 0], [7, 0]]]
+    ]
+)
+
+
+def test_panoptic_quality_reference_values():
+    val = FD.panoptic_quality(_PQ_PREDS, _PQ_TARGET, things={0, 1}, stuffs={6, 7})
+    np.testing.assert_allclose(float(val), 0.5463, atol=1e-4)
+    val3 = FD.panoptic_quality(_PQ_PREDS, _PQ_TARGET, things={0, 1}, stuffs={6, 7}, return_sq_and_rq=True)
+    np.testing.assert_allclose(np.asarray(val3), [0.5463, 0.6111, 0.6667], atol=1e-4)
+    per_class = FD.panoptic_quality(_PQ_PREDS, _PQ_TARGET, things={0, 1}, stuffs={6, 7}, return_per_class=True)
+    np.testing.assert_allclose(np.asarray(per_class), [[0.5185, 0.0000, 0.6667, 1.0000]], atol=1e-4)
+    both = FD.panoptic_quality(
+        _PQ_PREDS, _PQ_TARGET, things={0, 1}, stuffs={6, 7}, return_per_class=True, return_sq_and_rq=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(both),
+        [[0.5185, 0.7778, 0.6667], [0.0000, 0.0000, 0.0000], [0.6667, 0.6667, 1.0000], [1.0000, 1.0000, 1.0000]],
+        atol=1e-4,
+    )
+
+
+def test_modified_panoptic_quality_reference_value():
+    preds = np.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    target = np.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    val = FD.modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})
+    np.testing.assert_allclose(float(val), 0.7667, atol=1e-4)
+
+
+def test_panoptic_quality_module_streaming():
+    metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    metric.update(_PQ_PREDS, _PQ_TARGET)
+    metric.update(_PQ_PREDS, _PQ_TARGET)  # same batch twice: same quality
+    np.testing.assert_allclose(float(metric.compute()), 0.5463, atol=1e-4)
+    metric.reset()
+    metric.update(_PQ_PREDS, _PQ_TARGET)
+    np.testing.assert_allclose(float(metric.compute()), 0.5463, atol=1e-4)
+
+
+def test_modified_panoptic_quality_module():
+    preds = np.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    target = np.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    metric = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7}, allow_unknown_preds_category=True)
+    metric.update(preds, target)
+    np.testing.assert_allclose(float(metric.compute()), 0.7667, atol=1e-4)
+
+
+def test_panoptic_quality_validation_errors():
+    with pytest.raises(ValueError, match="distinct"):
+        PanopticQuality(things={0, 1}, stuffs={1, 2})
+    with pytest.raises(TypeError, match="int"):
+        PanopticQuality(things={"a"}, stuffs={1})
+    metric = PanopticQuality(things={0}, stuffs={1})
+    with pytest.raises(ValueError, match="shape"):
+        metric.update(np.zeros((1, 4, 2), int), np.zeros((1, 5, 2), int))
+    with pytest.raises(ValueError, match="Unknown categories"):
+        metric.update(np.full((1, 4, 2), 9, int), np.zeros((1, 4, 2), int))

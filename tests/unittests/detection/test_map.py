@@ -1,0 +1,175 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""MeanAveragePrecision parity tests against an independent numpy COCO oracle
+(the analogue of reference ``tests/unittests/detection/test_map.py``, which
+compares against pycocotools)."""
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.functional.detection.map import coco_mean_average_precision
+
+from tests.unittests.detection._coco_oracle import coco_eval_oracle
+
+KEYS = [
+    "map",
+    "map_50",
+    "map_75",
+    "map_small",
+    "map_medium",
+    "map_large",
+    "mar_1",
+    "mar_10",
+    "mar_100",
+    "mar_small",
+    "mar_medium",
+    "mar_large",
+]
+
+
+def _rand_boxes(rng, n, size=400.0):
+    xy = rng.rand(n, 2) * size
+    wh = rng.rand(n, 2) * (size / 3) + 2.0
+    return np.round(np.concatenate([xy, xy + wh], axis=1), 2)
+
+
+def _make_dataset(rng, n_imgs=6, n_classes=4, max_gt=12, max_det=18, crowd_frac=0.0):
+    preds, target = [], []
+    for _ in range(n_imgs):
+        n_gt = rng.randint(0, max_gt + 1)
+        n_dt = rng.randint(0, max_det + 1)
+        gt_boxes = _rand_boxes(rng, n_gt)
+        gt_labels = rng.randint(0, n_classes, n_gt)
+        crowd = (rng.rand(n_gt) < crowd_frac).astype(np.int64)
+        # perturb half the detections from ground truths for realistic overlap
+        dt_boxes = _rand_boxes(rng, n_dt)
+        for j in range(min(n_dt, n_gt)):
+            if rng.rand() < 0.6:
+                dt_boxes[j] = np.round(gt_boxes[j] + rng.randn(4) * 6.0, 2)
+        if n_gt:
+            dt_labels = np.where(
+                (rng.rand(n_dt) < 0.7) & (np.arange(n_dt) < n_gt),
+                gt_labels[np.minimum(np.arange(n_dt), n_gt - 1)],
+                rng.randint(0, n_classes, n_dt),
+            )
+        else:
+            dt_labels = rng.randint(0, n_classes, n_dt)
+        preds.append(
+            {"boxes": dt_boxes, "scores": np.round(rng.rand(n_dt), 3), "labels": dt_labels}
+        )
+        target.append({"boxes": gt_boxes, "labels": gt_labels, "iscrowd": crowd})
+    return preds, target
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_map_matches_oracle(seed):
+    rng = np.random.RandomState(seed)
+    preds, target = _make_dataset(rng)
+    expected = coco_eval_oracle(preds, target)
+    got = coco_mean_average_precision(preds, target)
+    for key in KEYS:
+        np.testing.assert_allclose(
+            float(got[key]), expected[key], rtol=1e-5, atol=1e-6, err_msg=f"mismatch on {key} (seed={seed})"
+        )
+
+
+def test_map_with_crowds_matches_oracle():
+    rng = np.random.RandomState(7)
+    preds, target = _make_dataset(rng, n_imgs=8, crowd_frac=0.3)
+    expected = coco_eval_oracle(preds, target)
+    got = coco_mean_average_precision(preds, target)
+    for key in KEYS:
+        np.testing.assert_allclose(
+            float(got[key]), expected[key], rtol=1e-5, atol=1e-6, err_msg=f"mismatch on {key} (crowds)"
+        )
+
+
+def test_map_module_streaming_and_reset():
+    rng = np.random.RandomState(3)
+    preds, target = _make_dataset(rng, n_imgs=6)
+    metric = MeanAveragePrecision()
+    for i in range(0, 6, 2):
+        metric.update(preds[i : i + 2], target[i : i + 2])
+    got = metric.compute()
+    expected = coco_eval_oracle(preds, target)
+    for key in KEYS:
+        np.testing.assert_allclose(float(got[key]), expected[key], rtol=1e-5, atol=1e-6, err_msg=key)
+    metric.reset()
+    assert metric.detection_box == []
+
+
+def test_map_perfect_predictions():
+    boxes = np.array([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 120.0, 140.0]])
+    labels = np.array([0, 1])
+    preds = [{"boxes": boxes, "scores": np.array([0.9, 0.8]), "labels": labels}]
+    target = [{"boxes": boxes, "labels": labels}]
+    res = coco_mean_average_precision(preds, target)
+    np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["map_50"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+
+def test_map_empty_inputs():
+    preds = [{"boxes": np.zeros((0, 4)), "scores": np.zeros(0), "labels": np.zeros(0, np.int64)}]
+    target = [{"boxes": np.zeros((0, 4)), "labels": np.zeros(0, np.int64)}]
+    res = coco_mean_average_precision(preds, target)
+    assert float(res["map"]) == -1.0
+
+
+def test_map_missed_gt_halves_recall():
+    # one gt detected perfectly, one not detected at all
+    target = [
+        {
+            "boxes": np.array([[0.0, 0.0, 40.0, 40.0], [100.0, 100.0, 160.0, 160.0]]),
+            "labels": np.array([0, 0]),
+        }
+    ]
+    preds = [
+        {"boxes": np.array([[0.0, 0.0, 40.0, 40.0]]), "scores": np.array([0.9]), "labels": np.array([0])}
+    ]
+    res = coco_mean_average_precision(preds, target)
+    np.testing.assert_allclose(float(res["mar_100"]), 0.5, atol=1e-6)
+    # AP: precision 1.0 up to recall 0.5, 0 beyond -> 101-pt interpolation
+    np.testing.assert_allclose(float(res["map"]), 51 / 101, atol=1e-6)
+
+
+def test_map_class_metrics_and_micro():
+    rng = np.random.RandomState(11)
+    preds, target = _make_dataset(rng, n_imgs=4)
+    res = coco_mean_average_precision(preds, target, class_metrics=True)
+    per_class = np.asarray(res["map_per_class"])
+    classes = np.asarray(res["classes"])
+    assert per_class.shape == classes.shape
+    valid = per_class[per_class > -1]
+    if valid.size:
+        np.testing.assert_allclose(valid.mean(), float(res["map"]), atol=1e-6)
+    # micro pools labels: equivalent to the oracle on label-zeroed data
+    micro = coco_mean_average_precision(preds, target, average="micro")
+    preds0 = [{**p, "labels": np.zeros_like(p["labels"])} for p in preds]
+    target0 = [{**t, "labels": np.zeros_like(t["labels"])} for t in target]
+    expected = coco_eval_oracle(preds0, target0)
+    np.testing.assert_allclose(float(micro["map"]), expected["map"], rtol=1e-5, atol=1e-6)
+
+
+def test_map_box_format_conversion():
+    xyxy = np.array([[10.0, 20.0, 50.0, 80.0]])
+    xywh = np.array([[10.0, 20.0, 40.0, 60.0]])
+    preds_a = [{"boxes": xyxy, "scores": np.array([0.5]), "labels": np.array([0])}]
+    preds_b = [{"boxes": xywh, "scores": np.array([0.5]), "labels": np.array([0])}]
+    tgt_a = [{"boxes": xyxy, "labels": np.array([0])}]
+    tgt_b = [{"boxes": xywh, "labels": np.array([0])}]
+    res_a = coco_mean_average_precision(preds_a, tgt_a, box_format="xyxy")
+    res_b = coco_mean_average_precision(preds_b, tgt_b, box_format="xywh")
+    np.testing.assert_allclose(float(res_a["map"]), float(res_b["map"]), atol=1e-6)
+
+
+def test_map_input_validation_errors():
+    metric = MeanAveragePrecision()
+    with pytest.raises(ValueError, match="Expected all dicts in `preds`"):
+        metric.update([{"boxes": np.zeros((0, 4)), "labels": np.zeros(0)}], [{"boxes": np.zeros((0, 4)), "labels": np.zeros(0)}])
+    with pytest.raises(ValueError, match="same length"):
+        metric.update([], [{"boxes": np.zeros((0, 4)), "labels": np.zeros(0)}])
+    with pytest.raises(ValueError, match="box_format"):
+        MeanAveragePrecision(box_format="bad")
+    with pytest.raises(ValueError, match="max detection"):
+        MeanAveragePrecision(max_detection_thresholds=[1, 10])

@@ -1,0 +1,21 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Detection module metrics (reference ``src/torchmetrics/detection/__init__.py``)."""
+from torchmetrics_tpu.detection.iou import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+)
+from torchmetrics_tpu.detection.mean_ap import MeanAveragePrecision
+from torchmetrics_tpu.detection.panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

@@ -1,0 +1,141 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""IoU-family module metrics (reference ``detection/{iou,giou,diou,ciou}.py``).
+
+One base class parameterized by the pairwise kernel; the reference repeats the
+same class body four times. States are list ('cat') states of per-update IoU
+matrices, like the reference (``detection/iou.py:170-171``).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.detection.helpers import (
+    _fix_empty_arrays,
+    _input_validator,
+    box_convert,
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+_ALLOWED_BOX_FORMATS = ("xyxy", "xywh", "cxcywh")
+
+
+class IntersectionOverUnion(Metric):
+    """Intersection over union for detection boxes (reference ``detection/iou.py:32``).
+
+    Input: per-image dicts with ``boxes``/``labels`` (+ ``scores`` ignored).
+    Output: ``{"iou": scalar}`` plus per-class entries with ``class_metrics``.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+    _kernel: staticmethod = staticmethod(box_iou)
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if box_format not in _ALLOWED_BOX_FORMATS:
+            raise ValueError(f"Expected argument `box_format` to be one of {_ALLOWED_BOX_FORMATS} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("iou_matrix", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        """Append the per-image pairwise matrix (reference ``detection/iou.py:181-196``)."""
+        _input_validator(preds, target, ignore_score=True)
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            t_labels = jnp.asarray(t["labels"]).reshape(-1)
+            p_labels = jnp.asarray(p["labels"]).reshape(-1)
+            self.groundtruth_labels.append(t_labels)
+            mat = self._kernel(det_boxes, gt_boxes)
+            if self.iou_threshold is not None:
+                mat = jnp.where(mat < self.iou_threshold, self._invalid_val, mat)
+            if self.respect_labels:
+                label_eq = p_labels[:, None] == t_labels[None, :]
+                mat = jnp.where(label_eq, mat, self._invalid_val)
+            self.iou_matrix.append(mat)
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = jnp.asarray(_fix_empty_arrays(np.asarray(boxes, np.float32)))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes.reshape(-1, 4)
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean over valid pairs (reference ``detection/iou.py:211-226``)."""
+        valid = [np.asarray(m)[np.asarray(m) != self._invalid_val] for m in self.iou_matrix]
+        flat = np.concatenate(valid) if valid else np.zeros(0, np.float32)
+        score = jnp.asarray(flat.mean() if flat.size else 0.0, jnp.float32)
+        results: Dict[str, Array] = {f"{self._iou_type}": score}
+        if self.class_metrics:
+            gt_labels = (
+                np.concatenate([np.asarray(x) for x in self.groundtruth_labels])
+                if self.groundtruth_labels
+                else np.zeros(0, np.int64)
+            )
+            for cl in np.unique(gt_labels).tolist():
+                total, count = 0.0, 0
+                for mat, lab in zip(self.iou_matrix, self.groundtruth_labels):
+                    mat, lab = np.asarray(mat), np.asarray(lab)
+                    sub = mat[:, lab == cl]
+                    sub = sub[sub != self._invalid_val]
+                    total += sub.sum()
+                    count += sub.size
+                results[f"{self._iou_type}/cl_{int(cl)}"] = jnp.asarray(total / count if count else 0.0, jnp.float32)
+        return results
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """GIoU (reference ``detection/giou.py:29``)."""
+
+    _iou_type = "giou"
+    _invalid_val = -1.5  # giou range is (-1, 1], so -1 is a valid value
+    _kernel = staticmethod(generalized_box_iou)
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """DIoU (reference ``detection/diou.py:29``)."""
+
+    _iou_type = "diou"
+    _invalid_val = -1.5
+    _kernel = staticmethod(distance_box_iou)
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIoU (reference ``detection/ciou.py:29``)."""
+
+    _iou_type = "ciou"
+    _invalid_val = -2.0
+    _kernel = staticmethod(complete_box_iou)

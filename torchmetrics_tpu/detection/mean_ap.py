@@ -1,0 +1,157 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Mean average precision module metric (reference ``detection/mean_ap.py:76``).
+
+Where the reference delegates ``compute`` to pycocotools/faster-coco-eval
+(``mean_ap.py:534-546``), this class runs the framework's own pure-JAX COCO
+evaluator (:mod:`torchmetrics_tpu.functional.detection.map`) whose greedy
+matching executes on the accelerator.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.detection.helpers import _input_validator, _validate_iou_type_arg
+from torchmetrics_tpu.functional.detection.map import (
+    DEFAULT_IOU_THRESHOLDS,
+    DEFAULT_MAX_DETECTIONS,
+    DEFAULT_REC_THRESHOLDS,
+    coco_mean_average_precision,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanAveragePrecision(Metric):
+    """COCO-style mean average precision / recall for object detection.
+
+    API-compatible with reference ``detection/mean_ap.py:372-475``: per-image
+    dict inputs (``boxes``/``scores``/``labels``; targets may add
+    ``iscrowd``/``area``), result keys ``map``, ``map_50``, ``map_75``,
+    ``map_small/medium/large``, ``mar_{k}``, ``mar_small/medium/large``,
+    ``map_per_class``, ``mar_{k}_per_class``, ``classes``.
+
+    Only ``iou_type="bbox"`` is supported (``"segm"`` requires the RLE mask
+    codec, tracked separately).
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        extended_summary: bool = False,
+        average: str = "macro",
+        backend: str = "jax",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_type = _validate_iou_type_arg(iou_type)
+        if any(tp == "segm" for tp in self.iou_type):
+            raise NotImplementedError(
+                "iou_type='segm' requires the RLE mask codec which is not yet available; use iou_type='bbox'."
+            )
+        if iou_thresholds is not None and not isinstance(iou_thresholds, list):
+            raise ValueError(
+                f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
+            )
+        self.iou_thresholds = list(iou_thresholds or DEFAULT_IOU_THRESHOLDS)
+        if rec_thresholds is not None and not isinstance(rec_thresholds, list):
+            raise ValueError(
+                f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}"
+            )
+        self.rec_thresholds = list(rec_thresholds or DEFAULT_REC_THRESHOLDS)
+        if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, list):
+            raise ValueError(
+                f"Expected argument `max_detection_thresholds` to either be `None` or a list of ints"
+                f" but got {max_detection_thresholds}"
+            )
+        if max_detection_thresholds is not None and len(max_detection_thresholds) != 3:
+            raise ValueError(
+                "When providing a list of max detection thresholds it should have length 3."
+                f" Got value {len(max_detection_thresholds)}"
+            )
+        self.max_detection_thresholds = sorted(max_detection_thresholds or DEFAULT_MAX_DETECTIONS)
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        self.extended_summary = extended_summary
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+        self.average = average
+        self.backend = backend
+
+        self.add_state("detection_box", default=[], dist_reduce_fx=None)
+        self.add_state("detection_scores", default=[], dist_reduce_fx=None)
+        self.add_state("detection_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_box", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Sequence[Dict[str, Any]], target: Sequence[Dict[str, Any]]) -> None:
+        """Append per-image detections/ground truths (reference ``mean_ap.py:477-519``)."""
+        _input_validator(preds, target, iou_type=self.iou_type)
+        for item in preds:
+            self.detection_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
+            self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32).reshape(-1))
+            self.detection_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
+        for item in target:
+            n = np.asarray(item["labels"]).size
+            self.groundtruth_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
+            self.groundtruth_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
+            crowds = item.get("iscrowd")
+            self.groundtruth_crowds.append(
+                jnp.asarray(crowds, jnp.int32).reshape(-1) if crowds is not None else jnp.zeros(n, jnp.int32)
+            )
+            area = item.get("area")
+            self.groundtruth_area.append(
+                jnp.asarray(area, jnp.float32).reshape(-1) if area is not None else jnp.zeros(0, jnp.float32)
+            )
+
+    def compute(self) -> Dict[str, Array]:
+        """Run the pure-JAX COCO evaluation over the accumulated stream."""
+        preds = [
+            {"boxes": b, "scores": s, "labels": l}
+            for b, s, l in zip(self.detection_box, self.detection_scores, self.detection_labels)
+        ]
+        target = [
+            {"boxes": b, "labels": l, "iscrowd": c, "area": (a if np.asarray(a).size else None)}
+            for b, l, c, a in zip(
+                self.groundtruth_box, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area
+            )
+        ]
+        return coco_mean_average_precision(
+            preds,
+            target,
+            box_format=self.box_format,
+            iou_thresholds=self.iou_thresholds,
+            rec_thresholds=self.rec_thresholds,
+            max_detection_thresholds=self.max_detection_thresholds,
+            class_metrics=self.class_metrics,
+            extended_summary=self.extended_summary,
+            average=self.average,
+        )
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,106 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Panoptic quality module metrics (reference ``detection/panoptic_qualities.py:40/:299``)."""
+from __future__ import annotations
+
+from typing import Any, Collection, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.detection.panoptic_quality import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+    _validate_inputs,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PanopticQuality(Metric):
+    """Panoptic quality (reference ``detection/panoptic_qualities.py:40``).
+
+    Inputs: ``(B, *spatial, 2)`` int maps of ``(category_id, instance_id)``.
+    States: per-category ``iou_sum``/``tp``/``fp``/``fn`` with ``"sum"``
+    reduction — fixed shapes, sharding-friendly.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    _modified: bool = False
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        return_sq_and_rq: bool = False,
+        return_per_class: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things_p, stuffs_p = _parse_categories(things, stuffs)
+        self.things = things_p
+        self.stuffs = stuffs_p
+        self.void_color = _get_void_color(things_p, stuffs_p)
+        self.cat_id_to_continuous_id = _get_category_id_to_continuous_id(things_p, stuffs_p)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self.return_sq_and_rq = return_sq_and_rq
+        self.return_per_class = return_per_class
+
+        num_categories = len(things_p) + len(stuffs_p)
+        self.add_state("iou_sum", default=jnp.zeros(num_categories, jnp.float64 if jax.config.jax_enable_x64 else jnp.float32), dist_reduce_fx="sum")
+        self.add_state("true_positives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold a batch of color maps into the stat states (reference ``:252-281``)."""
+        _validate_inputs(preds, target)
+        preds_f = _preprocess_inputs(
+            self.things, self.stuffs, np.asarray(preds), self.void_color, self.allow_unknown_preds_category
+        )
+        target_f = _preprocess_inputs(self.things, self.stuffs, np.asarray(target), self.void_color, True)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            preds_f,
+            target_f,
+            self.cat_id_to_continuous_id,
+            self.void_color,
+            modified_metric_stuffs=self.stuffs if self._modified else None,
+        )
+        self.iou_sum = self.iou_sum + iou_sum.astype(self.iou_sum.dtype)
+        self.true_positives = self.true_positives + tp.astype(jnp.int32)
+        self.false_positives = self.false_positives + fp.astype(jnp.int32)
+        self.false_negatives = self.false_negatives + fn.astype(jnp.int32)
+
+    def compute(self) -> Array:
+        """Final PQ (/SQ/RQ, per-class) from the stat states (reference ``:283-296``)."""
+        pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
+            self.iou_sum, self.true_positives, self.false_positives, self.false_negatives
+        )
+        if self.return_per_class:
+            if self.return_sq_and_rq:
+                return jnp.stack([pq, sq, rq], axis=-1)
+            return pq[None, :]
+        if self.return_sq_and_rq:
+            return jnp.stack([pq_avg, sq_avg, rq_avg])
+        return pq_avg
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """Modified PQ: stuff segments matched at IoU>0 with per-segment counting
+    (reference ``detection/panoptic_qualities.py:299``)."""
+
+    _modified = True

@@ -1,0 +1,208 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Box geometry kernels + detection input validation.
+
+TPU-native replacements for the torchvision ops the reference calls
+(``box_convert``/``box_area``/``box_iou``, reference
+``functional/detection/iou.py:33``, ``detection/mean_ap.py:824-857``) and the
+shared input validator (reference ``detection/helpers.py:19-101``). All box
+kernels are pure ``jax.numpy`` — batched, static-shape, vmap/jit-safe.
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_ALLOWED_BOX_FORMATS = ("xyxy", "xywh", "cxcywh")
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert boxes between ``xyxy``/``xywh``/``cxcywh`` formats.
+
+    Capability of torchvision ``box_convert`` (used by reference
+    ``detection/iou.py:200``, ``mean_ap.py:403``), expressed as pure jnp.
+    """
+    if in_fmt not in _ALLOWED_BOX_FORMATS or out_fmt not in _ALLOWED_BOX_FORMATS:
+        raise ValueError(f"Unsupported box format conversion {in_fmt} -> {out_fmt}")
+    boxes = jnp.asarray(boxes)
+    if in_fmt == out_fmt:
+        return boxes
+    # normalize to xyxy
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        xyxy = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    else:
+        xyxy = boxes
+    if out_fmt == "xyxy":
+        return xyxy
+    x1, y1, x2, y2 = jnp.split(xyxy, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+
+
+def box_area(boxes: Array) -> Array:
+    """Area of ``xyxy`` boxes (torchvision ``box_area`` capability)."""
+    boxes = jnp.asarray(boxes)
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _pairwise_intersection(preds: Array, target: Array) -> Array:
+    """Intersection areas for every (pred, target) pair of ``xyxy`` boxes."""
+    lt = jnp.maximum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.minimum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    return wh[..., 0] * wh[..., 1]
+
+
+def box_iou(preds: Array, target: Array, iscrowd: Union[Array, None] = None) -> Array:
+    """Pairwise IoU matrix ``(N, M)`` between ``xyxy`` boxes.
+
+    ``iscrowd`` (shape ``(M,)`` bool) switches a column to the COCO crowd
+    convention: IoU = intersection / pred-area (the gt is a region the
+    detection may lie inside, pycocotools ``maskUtils.iou`` semantics used by
+    reference ``mean_ap.py:534-546``).
+    """
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    inter = _pairwise_intersection(preds, target)
+    area_p = box_area(preds)[..., :, None]
+    area_t = box_area(target)[..., None, :]
+    union = area_p + area_t - inter
+    if iscrowd is not None:
+        union = jnp.where(jnp.asarray(iscrowd)[..., None, :], area_p * jnp.ones_like(union), union)
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def generalized_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise GIoU matrix: IoU - (hull - union) / hull."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    inter = _pairwise_intersection(preds, target)
+    area_p = box_area(preds)[..., :, None]
+    area_t = box_area(target)[..., None, :]
+    union = area_p + area_t - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+    lt = jnp.minimum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.maximum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - jnp.where(hull > 0, (hull - union) / hull, 0.0)
+
+
+def distance_box_iou(preds: Array, target: Array) -> Array:
+    """Pairwise DIoU: IoU - center-distance² / hull-diagonal²."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    inter = _pairwise_intersection(preds, target)
+    area_p = box_area(preds)[..., :, None]
+    area_t = box_area(target)[..., None, :]
+    union = area_p + area_t - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+    lt = jnp.minimum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.maximum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    diag = jnp.sum((rb - lt) ** 2, axis=-1)
+    cp = (preds[..., :, None, :2] + preds[..., :, None, 2:]) / 2
+    ct = (target[..., None, :, :2] + target[..., None, :, 2:]) / 2
+    dist = jnp.sum((cp - ct) ** 2, axis=-1)
+    return iou - jnp.where(diag > 0, dist / diag, 0.0)
+
+
+def complete_box_iou(preds: Array, target: Array, eps: float = 1e-7) -> Array:
+    """Pairwise CIoU: DIoU - aspect-ratio consistency term."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    diou = distance_box_iou(preds, target)
+    inter = _pairwise_intersection(preds, target)
+    area_p = box_area(preds)[..., :, None]
+    area_t = box_area(target)[..., None, :]
+    union = area_p + area_t - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+    wp = preds[..., 2] - preds[..., 0]
+    hp = preds[..., 3] - preds[..., 1]
+    wt = target[..., 2] - target[..., 0]
+    ht = target[..., 3] - target[..., 1]
+    v = (4 / (jnp.pi**2)) * (
+        jnp.arctan(wt / (ht + eps))[..., None, :] - jnp.arctan(wp / (hp + eps))[..., :, None]
+    ) ** 2
+    alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v
+
+
+def _fix_empty_arrays(boxes: np.ndarray) -> np.ndarray:
+    """Give degenerate empty box arrays a ``(0, 4)`` shape (reference
+    ``detection/helpers.py:104-108``)."""
+    boxes = np.asarray(boxes)
+    if boxes.size == 0:
+        return boxes.reshape(0, 4) if boxes.ndim != 1 or boxes.shape[0] == 0 else boxes
+    return boxes
+
+
+def _input_validator(
+    preds: Sequence[Dict[str, Array]],
+    targets: Sequence[Dict[str, Array]],
+    iou_type: Union[str, Tuple[str, ...]] = "bbox",
+    ignore_score: bool = False,
+) -> None:
+    """Validate the list-of-dicts detection input format (reference
+    ``detection/helpers.py:19-101``; error strings kept API-compatible)."""
+    if isinstance(iou_type, str):
+        iou_type = (iou_type,)
+    name_map = {"bbox": "boxes", "segm": "masks"}
+    if any(tp not in name_map for tp in iou_type):
+        raise Exception(f"IOU type {iou_type} is not supported")
+    item_val_name = [name_map[tp] for tp in iou_type]
+
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+    for k in [*item_val_name, "labels"] + ([] if ignore_score else ["scores"]):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in [*item_val_name, "labels"]:
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+    for i, item in enumerate(targets):
+        for ivn in item_val_name:
+            if np.asarray(item[ivn]).shape[0] != np.asarray(item["labels"]).shape[0]:
+                raise ValueError(
+                    f"Input '{ivn}' and labels of sample {i} in targets have a"
+                    f" different length (expected {np.asarray(item[ivn]).shape[0]} labels,"
+                    f" got {np.asarray(item['labels']).shape[0]})"
+                )
+    if ignore_score:
+        return
+    for i, item in enumerate(preds):
+        for ivn in item_val_name:
+            if not (
+                np.asarray(item[ivn]).shape[0]
+                == np.asarray(item["labels"]).shape[0]
+                == np.asarray(item["scores"]).shape[0]
+            ):
+                raise ValueError(
+                    f"Input '{ivn}', labels and scores of sample {i} in predictions have a"
+                    f" different length (expected {np.asarray(item[ivn]).shape[0]} labels and scores,"
+                    f" got {np.asarray(item['labels']).shape[0]} labels"
+                    f" and {np.asarray(item['scores']).shape[0]} scores)"
+                )
+
+
+def _validate_iou_type_arg(iou_type: Union[str, Tuple[str, ...]] = "bbox") -> Tuple[str, ...]:
+    """Validate the ``iou_type`` argument (reference ``detection/helpers.py:111-122``)."""
+    allowed_iou_types = ("segm", "bbox")
+    if isinstance(iou_type, str):
+        iou_type = (iou_type,)
+    if any(tp not in allowed_iou_types for tp in iou_type):
+        raise ValueError(
+            f"Expected argument `iou_type` to be one of {allowed_iou_types} or a tuple of, but got {iou_type}"
+        )
+    return iou_type

@@ -1,0 +1,79 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""IoU-family functional metrics (reference ``functional/detection/{iou,giou,diou,ciou}.py``).
+
+One shared pipeline parameterized by the pairwise kernel — the reference
+repeats the identical update/compute pair in four files; here the kernels
+live in :mod:`helpers` and the public functions share the machinery.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.detection.helpers import (
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+
+Array = jax.Array
+
+
+def _iou_family_update(
+    kernel: Callable[[Array, Array], Array],
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float],
+    replacement_val: float = 0,
+) -> Array:
+    """Pairwise matrix with sub-threshold entries replaced (reference
+    ``functional/detection/iou.py:24-39``)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if preds.ndim != 2 or preds.shape[-1] != 4:
+        raise ValueError(f"Expected preds to be of shape (N, 4) but got {tuple(preds.shape)}")
+    if target.ndim != 2 or target.shape[-1] != 4:
+        raise ValueError(f"Expected target to be of shape (N, 4) but got {tuple(target.shape)}")
+    mat = kernel(preds, target)
+    if iou_threshold is not None:
+        mat = jnp.where(mat < iou_threshold, replacement_val, mat)
+    return mat
+
+
+def _iou_family_compute(mat: Array, aggregate: bool = True) -> Array:
+    """Mean of the diagonal, or the raw matrix (reference ``iou.py:41-44``)."""
+    if not aggregate:
+        return mat
+    return jnp.diagonal(mat).mean() if mat.size > 0 else jnp.asarray(0.0)
+
+
+def _make_public(kernel: Callable[[Array, Array], Array], name: str) -> Callable:
+    def fn(
+        preds: Array,
+        target: Array,
+        iou_threshold: Optional[float] = None,
+        replacement_val: float = 0,
+        aggregate: bool = True,
+    ) -> Array:
+        mat = _iou_family_update(kernel, preds, target, iou_threshold, replacement_val)
+        return _iou_family_compute(mat, aggregate)
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    fn.__doc__ = (
+        f"Compute {name.replace('_', ' ')} between two sets of ``xyxy`` boxes.\n\n"
+        "With ``aggregate=True`` (default) returns the mean of the matched\n"
+        "(diagonal) pairs; otherwise the full pairwise matrix. ``iou_threshold``\n"
+        f"replaces sub-threshold entries with ``replacement_val`` (reference\n"
+        f"``functional/detection/{name.split('_')[0] if name != 'intersection_over_union' else 'iou'}.py``)."
+    )
+    return fn
+
+
+intersection_over_union = _make_public(box_iou, "intersection_over_union")
+generalized_intersection_over_union = _make_public(generalized_box_iou, "generalized_intersection_over_union")
+distance_intersection_over_union = _make_public(distance_box_iou, "distance_intersection_over_union")
+complete_intersection_over_union = _make_public(complete_box_iou, "complete_intersection_over_union")
