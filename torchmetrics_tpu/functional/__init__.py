@@ -10,6 +10,8 @@ from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
 from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.detection import __all__ as _detection_all
+from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
 from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
@@ -25,6 +27,7 @@ __all__ = (
     list(_classification_all)
     + list(_clustering_all)
     + list(_detection_all)
+    + list(_image_all)
     + list(_nominal_all)
     + list(_pairwise_all)
     + list(_regression_all)

@@ -1,0 +1,141 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Pan-sharpening distortion indices: D_lambda, D_s, QNR (reference
+``functional/image/{d_lambda,d_s,qnr}.py``)."""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helpers import _check_image_pair, _uniform_filter, reduce
+from torchmetrics_tpu.functional.image.metrics import universal_image_quality_index
+
+Array = jax.Array
+
+
+def _spectral_distortion_index_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate inputs — batch/channel must match but spatial sizes may differ
+    (reference ``d_lambda.py:25-46``; QNR passes a low-res ``ms`` here)."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            f"Expected `ms` and `fused` to have the same data type. Got ms: {preds.dtype} and fused: {target.dtype}."
+        )
+    if preds.ndim != 4 or target.ndim != 4:
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[:2] != target.shape[:2]:
+        raise ValueError(
+            "Expected `preds` and `target` to have same batch and channel sizes."
+            f"Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds, target = preds.astype(jnp.float32), target.astype(jnp.float32)
+    return preds, target
+
+
+def _spectral_distortion_index_compute(
+    preds: Array, target: Array, p: int = 1, reduction: str = "elementwise_mean"
+) -> Array:
+    """Band-pair UQI difference matrix (reference ``d_lambda.py:49-107``)."""
+    length = preds.shape[1]
+    m1 = jnp.zeros((length, length))
+    m2 = jnp.zeros((length, length))
+    for k in range(length):
+        for r in range(k + 1, length):
+            m1 = m1.at[k, r].set(universal_image_quality_index(target[:, k : k + 1], target[:, r : r + 1]))
+            m2 = m2.at[k, r].set(universal_image_quality_index(preds[:, k : k + 1], preds[:, r : r + 1]))
+    m1 = m1 + m1.T
+    m2 = m2 + m2.T
+    diff = jnp.abs(m1 - m2) ** p
+    if length == 1:
+        output = diff ** (1.0 / p)
+    else:
+        output = (1.0 / (length * (length - 1)) * jnp.sum(diff)) ** (1.0 / p)
+    return reduce(output, reduction)
+
+
+def spectral_distortion_index(
+    preds: Array, target: Array, p: int = 1, reduction: str = "elementwise_mean"
+) -> Array:
+    """D_lambda (reference ``d_lambda.py:110-153``)."""
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    preds, target = _spectral_distortion_index_update(preds, target)
+    return _spectral_distortion_index_compute(preds, target, p, reduction)
+
+
+def _resize_bilinear(x: Array, size: Tuple[int, int]) -> Array:
+    """Half-pixel bilinear resize of NCHW images (torchvision ``resize`` with
+    ``antialias=False`` as used by reference ``d_s.py:188-190``)."""
+    return jax.image.resize(x, (*x.shape[:2], *size), method="bilinear")
+
+
+def spatial_distortion_index(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """D_s (reference ``d_s.py:130-260``)."""
+    preds, pan = _check_image_pair(jnp.asarray(preds), jnp.asarray(pan))
+    ms = jnp.asarray(ms, preds.dtype)
+    if ms.ndim != 4:
+        raise ValueError(f"Expected `ms` to have BxCxHxW shape. Got ms: {ms.shape}.")
+    if preds.shape[:2] != ms.shape[:2]:
+        raise ValueError(
+            f"Expected `preds` and `ms` to have the same batch and channel sizes."
+            f" Got preds: {preds.shape} and ms: {ms.shape}."
+        )
+    if not isinstance(norm_order, int) or norm_order <= 0:
+        raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+    ms_h, ms_w = ms.shape[-2:]
+    if preds.shape[-2] % ms_h != 0 or preds.shape[-1] % ms_w != 0:
+        raise ValueError(
+            f"Expected height and width of `preds` to be multiple of height and width of `ms`."
+            f" Got preds: {preds.shape} and ms: {ms.shape}."
+        )
+    if window_size >= ms_h or window_size >= ms_w:
+        raise ValueError(
+            f"Expected `window_size` to be smaller than dimension of `ms`. Got window_size: {window_size}."
+        )
+    if pan_lr is None:
+        pan_degraded = _uniform_filter(pan, window_size=window_size)
+        pan_degraded = _resize_bilinear(pan_degraded, (ms_h, ms_w))
+    else:
+        pan_degraded = jnp.asarray(pan_lr, preds.dtype)
+
+    length = preds.shape[1]
+    m1 = jnp.stack(
+        [universal_image_quality_index(ms[:, i : i + 1], pan_degraded[:, i : i + 1]) for i in range(length)]
+    )
+    m2 = jnp.stack([universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1]) for i in range(length)])
+    diff = jnp.abs(m1 - m2) ** norm_order
+    return reduce(diff, reduction) ** (1 / norm_order)
+
+
+def quality_with_no_reference(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """QNR = (1 - D_lambda)^alpha * (1 - D_s)^beta (reference ``qnr.py:9-62``)."""
+    if not isinstance(alpha, (int, float)) or alpha < 0:
+        raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+    if not isinstance(beta, (int, float)) or beta < 0:
+        raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+    d_lambda = spectral_distortion_index(preds, ms, norm_order, reduction)
+    d_s = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction)
+    return (1 - d_lambda) ** alpha * (1 - d_s) ** beta

@@ -1,0 +1,135 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Shared image-kernel helpers (reference ``functional/image/utils.py``).
+
+All filters are expressed as depthwise ``lax.conv_general_dilated`` calls —
+grouped convolutions map straight onto the TPU's convolution units, and the
+5-way stacked-input trick used by SSIM/UQI keeps everything in one fused conv.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def reduce(x: Array, reduction: str | None) -> Array:
+    """``elementwise_mean``/``sum``/``none`` reduction (reference
+    ``utilities/distributed.py:22-42``)."""
+    if reduction == "elementwise_mean" or reduction == "mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction in (None, "none"):
+        return x
+    raise ValueError("`reduction` must be 'elementwise_mean'/'mean', 'sum', 'none' or None")
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1D gaussian kernel (reference ``utils.py:_gaussian``)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.power(dist / sigma, 2) / 2)
+    return (gauss / gauss.sum())[None, :]
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """``(C, 1, kh, kw)`` depthwise gaussian kernel (reference ``utils.py:_gaussian_kernel_2d``)."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kx.T @ ky
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """``(C, 1, kh, kw, kd)`` depthwise gaussian kernel (reference ``utils.py:_gaussian_kernel_3d``)."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kz = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = kx.T @ ky
+    kernel = kernel_xy[:, :, None] * kz[0][None, None, :]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel_size))
+
+
+def conv2d(x: Array, kernel: Array, groups: int = 1) -> Array:
+    """NCHW cross-correlation, VALID padding (torch ``F.conv2d`` semantics)."""
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+
+
+def conv3d(x: Array, kernel: Array, groups: int = 1) -> Array:
+    """NCDHW cross-correlation, VALID padding (torch ``F.conv3d`` semantics)."""
+    return lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+
+
+def avg_pool2d(x: Array, window: int = 2) -> Array:
+    """Non-overlapping average pool (torch ``F.avg_pool2d`` with stride=kernel)."""
+    return lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, window, window), (1, 1, window, window), "VALID"
+    ) / (window * window)
+
+
+def avg_pool3d(x: Array, window: int = 2) -> Array:
+    return lax.reduce_window(
+        x, 0.0, lax.add, (1, 1, window, window, window), (1, 1, window, window, window), "VALID"
+    ) / (window**3)
+
+
+def reflect_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    """Edge-exclusive reflection pad on H/W (torch ``F.pad(mode='reflect')``)."""
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def reflect_pad_3d(x: Array, pad_d: int, pad_w: int, pad_h: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_d, pad_d), (pad_w, pad_w), (pad_h, pad_h)), mode="reflect")
+
+
+def _uniform_filter(x: Array, window_size: int) -> Array:
+    """Scipy-compatible local mean (reference ``utils.py:_uniform_filter``):
+    edge-inclusive (symmetric) padding of ``window//2`` left and
+    ``window//2 + window%2 - 1`` right, then a depthwise uniform conv."""
+    pad = window_size // 2
+    outer = window_size % 2
+    x = jnp.pad(x, ((0, 0), (0, 0), (pad, pad + outer - 1), (pad, pad + outer - 1)), mode="symmetric")
+    channels = x.shape[1]
+    kernel = jnp.ones((channels, 1, window_size, window_size), x.dtype) / (window_size**2)
+    return conv2d(x, kernel, groups=channels)
+
+
+def _check_image_pair(preds: Array, target: Array, ndim: Tuple[int, ...] = (4,)) -> Tuple[Array, Array]:
+    """Common dtype/shape validation for full-reference image metrics."""
+    preds, target = jnp.asarray(preds), jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    if preds.shape != target.shape:
+        raise ValueError(
+            "Expected `preds` and `target` to have the same shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.ndim not in ndim:
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+    return preds, target

@@ -1,0 +1,708 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Image module metrics over the pure-math kernels (reference
+``src/torchmetrics/image/{psnr,psnrb,ssim,uqi,ergas,sam,scc,rase,rmse_sw,tv,
+d_lambda,d_s,qnr,vif}.py``).
+
+State conventions follow the reference: streaming scalar sums where the metric
+decomposes (PSNR/SSIM/SAM/...), ``cat`` list states where it needs the full
+stream (ERGAS/RASE/D_s/...)."""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.distortion import (
+    _spectral_distortion_index_compute,
+    _spectral_distortion_index_update,
+    quality_with_no_reference,
+    spatial_distortion_index,
+)
+from torchmetrics_tpu.functional.image.metrics import (
+    _compute_bef,
+    _ergas_compute,
+    _psnr_compute,
+    _psnr_update,
+    _psnrb_compute,
+    _sam_compute,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spatial_correlation_coefficient,
+    universal_image_quality_index,
+    visual_information_fidelity,
+)
+from torchmetrics_tpu.functional.image.helpers import _check_image_pair
+from torchmetrics_tpu.functional.image.ssim import (
+    _multiscale_ssim_update,
+    _ssim_check_inputs,
+    _ssim_update,
+)
+from torchmetrics_tpu.functional.image.metrics import _total_variation_compute, _total_variation_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PeakSignalNoiseRatio(Metric):
+    """PSNR (reference ``image/psnr.py:29-146``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        base: float = 10.0,
+        reduction: Optional[str] = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        if dim is None:
+            self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
+            self.add_state("total", default=[], dist_reduce_fx="cat")
+        self.clamping_fn = None
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", default=jnp.asarray(0.0), dist_reduce_fx=jnp.min)
+            self.add_state("max_target", default=jnp.asarray(0.0), dist_reduce_fx=jnp.max)
+        elif isinstance(data_range, tuple):
+            self.add_state("data_range", default=jnp.asarray(data_range[1] - data_range[0]), dist_reduce_fx="mean")
+            self.clamping_fn = lambda x: jnp.clip(x, data_range[0], data_range[1])
+        else:
+            self.add_state("data_range", default=jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, Sequence) else dim
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold SSE of a batch into the state (reference ``psnr.py:126-143``)."""
+        preds, target = jnp.asarray(preds), jnp.asarray(target)
+        if self.clamping_fn is not None:
+            preds = self.clamping_fn(preds)
+            target = self.clamping_fn(target)
+        sum_squared_error, num_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                # keep track of min and max target values
+                self.min_target = jnp.minimum(target.min(), self.min_target)
+                self.max_target = jnp.maximum(target.max(), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + num_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error)
+            self.total.append(num_obs)
+
+    def compute(self) -> Array:
+        """Final PSNR (reference ``psnr.py:145-156``)."""
+        data_range = self.data_range if self.data_range is not None else self.max_target - self.min_target
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = dim_zero_cat(self.sum_squared_error)
+            total = dim_zero_cat(self.total)
+        return _psnr_compute(sum_squared_error, total, data_range, base=self.base, reduction=self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class PeakSignalNoiseRatioWithBlockedEffect(Metric):
+    """PSNRB, grayscale only (reference ``image/psnrb.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, block_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(block_size, int) or block_size < 1:
+            raise ValueError("Argument `block_size` should be a positive integer")
+        self.block_size = block_size
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("bef", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("data_range", default=jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _check_image_pair(jnp.asarray(preds), jnp.asarray(target))
+        self.sum_squared_error = self.sum_squared_error + jnp.sum((preds - target) ** 2)
+        self.bef = self.bef + _compute_bef(preds, block_size=self.block_size)
+        self.total = self.total + target.size
+        self.data_range = jnp.maximum(self.data_range, target.max() - target.min())
+
+    def compute(self) -> Array:
+        return _psnrb_compute(self.sum_squared_error, self.bef, self.total, self.data_range)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class _MeanReducedImageMetric(Metric):
+    """Shared shell: per-image scores summed + counted, ``sum`` reduce."""
+
+    is_differentiable = True
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("elementwise_mean", "sum", "none", None):
+            raise ValueError(
+                f"Argument `reduction` must be one of ['elementwise_mean', 'sum', 'none', None], got {reduction}"
+            )
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def _fold(self, per_image: Array) -> None:
+        if self.reduction in ("none", None):
+            self.similarity.append(per_image)
+        else:
+            self.similarity = self.similarity + (
+                per_image.sum() if self.reduction == "elementwise_mean" else per_image.sum()
+            )
+        self.total = self.total + per_image.shape[0]
+
+    def _finalize(self) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.similarity)
+        if self.reduction == "sum":
+            return self.similarity
+        return self.similarity / self.total
+
+
+class StructuralSimilarityIndexMeasure(_MeanReducedImageMetric):
+    """SSIM (reference ``image/ssim.py:33``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(reduction=reduction, **kwargs)
+        if return_full_image or return_contrast_sensitivity:
+            self.add_state("image_return", default=[], dist_reduce_fx="cat")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold batch SSIM into the state (reference ``ssim.py:128-156``)."""
+        preds, target = _ssim_check_inputs(preds, target)
+        out = _ssim_update(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.return_full_image,
+            self.return_contrast_sensitivity,
+        )
+        if isinstance(out, tuple):
+            similarity, image = out
+            self.image_return.append(image)
+        else:
+            similarity = out
+        self._fold(similarity)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        similarity = self._finalize()
+        if self.return_full_image or self.return_contrast_sensitivity:
+            return similarity, dim_zero_cat(self.image_return)
+        return similarity
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(_MeanReducedImageMetric):
+    """MS-SSIM (reference ``image/ssim.py:224``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(reduction=reduction, **kwargs)
+        if not (isinstance(kernel_size, (Sequence, int))):
+            raise ValueError(
+                f"Argument `kernel_size` expected to be an sequence or an int, or a single int. Got {kernel_size}"
+            )
+        if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+            raise ValueError("Argument `betas` is expected to be of a type tuple of floats")
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity = _multiscale_ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size,
+            self.data_range, self.k1, self.k2, self.betas, self.normalize,
+        )
+        self._fold(similarity)
+
+    def compute(self) -> Array:
+        return self._finalize()
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class UniversalImageQualityIndex(Metric):
+    """UQI (reference ``image/uqi.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _check_image_pair(jnp.asarray(preds), jnp.asarray(target))
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return universal_image_quality_index(preds, target, self.kernel_size, self.sigma, self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ERGAS (reference ``image/ergas.py:27``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ratio: float = 4, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _check_image_pair(jnp.asarray(preds), jnp.asarray(target))
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _ergas_compute(preds, target, self.ratio, self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class SpectralAngleMapper(Metric):
+    """SAM (reference ``image/sam.py:27``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 3.1416
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _check_image_pair(jnp.asarray(preds), jnp.asarray(target))
+        if preds.shape[1] <= 1:
+            raise ValueError(
+                f"Expected channel dimension of `preds` and `target` to be larger than 1. Got {preds.shape[1]}."
+            )
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _sam_compute(preds, target, self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class SpatialCorrelationCoefficient(Metric):
+    """SCC (reference ``image/scc.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, hp_filter: Optional[Array] = None, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if hp_filter is None:
+            hp_filter = jnp.asarray([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+        self.hp_filter = hp_filter
+        self.window_size = window_size
+        self.add_state("scc_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        per_sample = spatial_correlation_coefficient(
+            preds, target, self.hp_filter, self.window_size, reduction="none"
+        )
+        self.scc_score = self.scc_score + per_sample.sum()
+        self.total = self.total + per_sample.shape[0]
+
+    def compute(self) -> Array:
+        return self.scc_score / self.total
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class RelativeAverageSpectralError(Metric):
+    """RASE (reference ``image/rase.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _check_image_pair(jnp.asarray(preds), jnp.asarray(target))
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return relative_average_spectral_error(preds, target, self.window_size)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """RMSE-SW (reference ``image/rmse_sw.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self.add_state("rmse_val_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_images", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _check_image_pair(jnp.asarray(preds), jnp.asarray(target))
+        from torchmetrics_tpu.functional.image.helpers import _uniform_filter
+
+        error = _uniform_filter((preds - target) ** 2, self.window_size)
+        rmse_map = jnp.sqrt(error)
+        crop = round(self.window_size / 2)
+        self.rmse_val_sum = self.rmse_val_sum + jnp.sum(
+            jnp.mean(rmse_map[:, :, crop:-crop, crop:-crop], axis=(1, 2, 3))
+        )
+        self.total_images = self.total_images + preds.shape[0]
+
+    def compute(self) -> Array:
+        return self.rmse_val_sum / self.total_images
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class TotalVariation(Metric):
+    """TV (reference ``image/tv.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        self.add_state("score_list", default=[], dist_reduce_fx="cat")
+        self.add_state("score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_elements", default=jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        score, num_elements = _total_variation_update(img)
+        if self.reduction is None or self.reduction == "none":
+            self.score_list.append(score)
+        else:
+            self.score = self.score + score.sum()
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Array:
+        if self.reduction is None or self.reduction == "none":
+            return dim_zero_cat(self.score_list)
+        return _total_variation_compute(self.score, self.num_elements, self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class SpectralDistortionIndex(Metric):
+    """D_lambda (reference ``image/d_lambda.py:26``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        if reduction not in ("elementwise_mean", "sum", "none"):
+            raise ValueError(f"Expected argument `reduction` be one of ['elementwise_mean', 'sum', 'none'], got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _spectral_distortion_index_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spectral_distortion_index_compute(preds, target, self.p, self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class SpatialDistortionIndex(Metric):
+    """D_s (reference ``image/d_s.py:28``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, norm_order: int = 1, window_size: int = 7, reduction: str = "elementwise_mean", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(norm_order, int) or norm_order <= 0:
+            raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+        self.norm_order = norm_order
+        if not isinstance(window_size, int) or window_size <= 0:
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("ms", default=[], dist_reduce_fx="cat")
+        self.add_state("pan", default=[], dist_reduce_fx="cat")
+        self.add_state("pan_lr", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: dict) -> None:
+        """``target`` is a dict with ``ms``/``pan`` (+ optional ``pan_lr``)
+        (reference ``d_s.py:122-146``)."""
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to contain keys ms and pan. Got target: {list(target)}.")
+        self.preds.append(jnp.asarray(preds))
+        self.ms.append(jnp.asarray(target["ms"]))
+        self.pan.append(jnp.asarray(target["pan"]))
+        if "pan_lr" in target:
+            self.pan_lr.append(jnp.asarray(target["pan_lr"]))
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        ms = dim_zero_cat(self.ms)
+        pan = dim_zero_cat(self.pan)
+        pan_lr = dim_zero_cat(self.pan_lr) if self.pan_lr else None
+        return spatial_distortion_index(preds, ms, pan, pan_lr, self.norm_order, self.window_size, self.reduction)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class QualityWithNoReference(Metric):
+    """QNR (reference ``image/qnr.py:28``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        alpha: float = 1.0,
+        beta: float = 1.0,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(alpha, (int, float)) or alpha < 0:
+            raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+        self.alpha = alpha
+        if not isinstance(beta, (int, float)) or beta < 0:
+            raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+        self.beta = beta
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("ms", default=[], dist_reduce_fx="cat")
+        self.add_state("pan", default=[], dist_reduce_fx="cat")
+        self.add_state("pan_lr", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: dict) -> None:
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to contain keys ms and pan. Got target: {list(target)}.")
+        self.preds.append(jnp.asarray(preds))
+        self.ms.append(jnp.asarray(target["ms"]))
+        self.pan.append(jnp.asarray(target["pan"]))
+        if "pan_lr" in target:
+            self.pan_lr.append(jnp.asarray(target["pan_lr"]))
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        ms = dim_zero_cat(self.ms)
+        pan = dim_zero_cat(self.pan)
+        pan_lr = dim_zero_cat(self.pan_lr) if self.pan_lr else None
+        return quality_with_no_reference(
+            preds, ms, pan, pan_lr, self.alpha, self.beta, self.norm_order, self.window_size, self.reduction
+        )
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
+
+
+class VisualInformationFidelity(Metric):
+    """VIF-p (reference ``image/vif.py:25``)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(sigma_n_sq, (float, int)) or sigma_n_sq < 0:
+            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+        self.sigma_n_sq = sigma_n_sq
+        self.add_state("vif_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        from torchmetrics_tpu.functional.image.metrics import _vif_per_channel
+
+        preds, target = _check_image_pair(jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+        channels = preds.shape[1]
+        vif_per_channel = [
+            _vif_per_channel(preds[:, i], target[:, i], self.sigma_n_sq) for i in range(channels)
+        ]
+        vif_per_channel = jnp.mean(jnp.stack(vif_per_channel), axis=0) if channels > 1 else jnp.concatenate(vif_per_channel)
+        self.vif_score = self.vif_score + jnp.sum(vif_per_channel)
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        return self.vif_score / self.total
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
