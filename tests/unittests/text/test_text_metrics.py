@@ -1,0 +1,329 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Text metric parity tests (analogue of reference
+``tests/unittests/text/test_{bleu,sacre_bleu,chrf,rouge,ter,eed,wer,...}.py``).
+
+Oracles: sacrebleu (BLEU/CHRF/TER), rouge-score (ROUGE), hand-rolled
+Levenshtein for the error-rate family, reference documented values for
+EED/SQuAD."""
+import numpy as np
+import pytest
+import sacrebleu
+
+import torchmetrics_tpu.functional.text as FT
+from torchmetrics_tpu.text import (
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+PREDS = [
+    "the cat sat on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "hello there general kenobi",
+    "the fast brown fox jumped over the sleeping dog",
+]
+REFS = [
+    ["the cat is on the mat", "a cat sat on a mat"],
+    ["the quick brown fox jumps over the lazy dog", "a fast brown fox leaps over a lazy dog"],
+    ["hello there general kenobi", "hi there general kenobi"],
+    ["the quick brown fox jumps over the lazy dog", "a fast brown fox leaps over the sleeping dog"],
+]
+# sacrebleu wants one stream per reference position
+REF_STREAMS = [[r[i] for r in REFS] for i in range(2)]
+
+
+def _levenshtein(a, b):
+    n, m = len(a), len(b)
+    dp = np.zeros((n + 1, m + 1), dtype=int)
+    dp[:, 0] = np.arange(n + 1)
+    dp[0, :] = np.arange(m + 1)
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return dp[n, m]
+
+
+# ------------------------------------------------------------------- BLEU
+
+
+def test_bleu_vs_sacrebleu():
+    # sacrebleu with the simple whitespace tokenizer + no smoothing matches
+    # the classic BLEU the `bleu_score` kernel implements
+    oracle = sacrebleu.corpus_bleu(
+        PREDS, REF_STREAMS, tokenize="none", smooth_method="none", force=True
+    ).score / 100
+    got = float(FT.bleu_score(PREDS, REFS))
+    np.testing.assert_allclose(got, oracle, rtol=1e-5)
+
+
+def test_bleu_module_streaming():
+    metric = BLEUScore()
+    for p, t in zip(PREDS, REFS):
+        metric.update([p], [t])
+    expected = float(FT.bleu_score(PREDS, REFS))
+    np.testing.assert_allclose(float(metric.compute()), expected, rtol=1e-6)
+    metric.reset()
+    assert float(metric.preds_len) == 0.0
+
+
+def test_sacre_bleu_vs_sacrebleu_13a():
+    oracle = sacrebleu.corpus_bleu(PREDS, REF_STREAMS, tokenize="13a", smooth_method="none", force=False).score / 100
+    got = float(FT.sacre_bleu_score(PREDS, REFS, tokenize="13a"))
+    np.testing.assert_allclose(got, oracle, rtol=1e-5)
+    metric = SacreBLEUScore()
+    metric.update(PREDS, REFS)
+    np.testing.assert_allclose(float(metric.compute()), oracle, rtol=1e-5)
+
+
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_intl_and_lowercase(lowercase):
+    preds = ["Hello, World! How are you?"]
+    refs = [["Hello, world! How are you?"]]
+    streams = [[r[0] for r in refs]]
+    oracle = sacrebleu.corpus_bleu(
+        preds, streams, tokenize="intl", smooth_method="none", lowercase=lowercase, force=False
+    ).score / 100
+    got = float(FT.sacre_bleu_score(preds, refs, tokenize="intl", lowercase=lowercase))
+    np.testing.assert_allclose(got, oracle, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- CHRF
+
+
+@pytest.mark.parametrize("word_order", [0, 2])
+def test_chrf_vs_sacrebleu(word_order):
+    oracle = sacrebleu.corpus_chrf(PREDS, REF_STREAMS, word_order=word_order).score / 100
+    got = float(FT.chrf_score(PREDS, REFS, n_word_order=word_order))
+    np.testing.assert_allclose(got, oracle, rtol=1e-4)
+
+
+def test_chrf_module_streaming():
+    metric = CHRFScore()
+    for p, t in zip(PREDS, REFS):
+        metric.update([p], [t])
+    expected = float(FT.chrf_score(PREDS, REFS))
+    np.testing.assert_allclose(float(metric.compute()), expected, rtol=1e-5)
+
+
+# ------------------------------------------------------------------ ROUGE
+
+
+def test_rouge_vs_rouge_score_package():
+    from rouge_score.rouge_scorer import RougeScorer
+
+    scorer = RougeScorer(["rouge1", "rouge2", "rougeL"], use_stemmer=False)
+    preds = ["the cat sat on the mat", "hello general kenobi you are bold"]
+    targets = ["a cat sat on the mat", "hello there general kenobi you are a bold one"]
+    got = FT.rouge_score(preds, targets, rouge_keys=("rouge1", "rouge2", "rougeL"))
+    for key in ("rouge1", "rouge2", "rougeL"):
+        expected = np.mean([getattr(scorer.score(t, p)[key], f) for p, t in zip(preds, targets) for f in ["fmeasure"]])
+        np.testing.assert_allclose(float(got[f"{key}_fmeasure"]), expected, rtol=1e-5, err_msg=key)
+        expected_p = np.mean([scorer.score(t, p)[key].precision for p, t in zip(preds, targets)])
+        np.testing.assert_allclose(float(got[f"{key}_precision"]), expected_p, rtol=1e-5, err_msg=key)
+
+
+def test_rouge_with_stemmer_vs_rouge_score_package():
+    from rouge_score.rouge_scorer import RougeScorer
+
+    scorer = RougeScorer(["rouge1", "rougeLsum"], use_stemmer=True)
+    preds = ["the cats are sitting on the mats"]
+    targets = ["the cat sits on the mat"]
+    got = FT.rouge_score(preds, targets, rouge_keys=("rouge1", "rougeLsum"), use_stemmer=True)
+    np.testing.assert_allclose(
+        float(got["rouge1_fmeasure"]), scorer.score(targets[0], preds[0])["rouge1"].fmeasure, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(got["rougeLsum_fmeasure"]), scorer.score(targets[0], preds[0])["rougeLsum"].fmeasure, rtol=1e-5
+    )
+
+
+def test_rouge_module_matches_functional():
+    metric = ROUGEScore(rouge_keys=("rouge1", "rouge2", "rougeL"))
+    preds = ["the cat sat on the mat", "hello general kenobi"]
+    targets = ["a cat sat on the mat", "hello there general kenobi"]
+    for p, t in zip(preds, targets):
+        metric.update([p], [t])
+    expected = FT.rouge_score(preds, targets, rouge_keys=("rouge1", "rouge2", "rougeL"))
+    got = metric.compute()
+    for key, val in expected.items():
+        np.testing.assert_allclose(float(got[key]), float(val), rtol=1e-5, err_msg=key)
+
+
+# -------------------------------------------------------------------- TER
+
+
+def test_ter_vs_sacrebleu():
+    oracle = sacrebleu.metrics.TER().corpus_score(PREDS, REF_STREAMS).score / 100
+    got = float(FT.translation_edit_rate(PREDS, REFS))
+    np.testing.assert_allclose(got, oracle, rtol=1e-5)
+
+
+@pytest.mark.parametrize("kwargs", [{"normalize": True}, {"no_punctuation": True}, {"lowercase": False}])
+def test_ter_options_vs_sacrebleu(kwargs):
+    mapping = {"normalize": "normalized", "no_punctuation": "no_punct", "lowercase": "case_sensitive"}
+    sb_kwargs = {}
+    for k, v in kwargs.items():
+        sb_kwargs[mapping[k]] = (not v) if k == "lowercase" else v
+    preds = ["The CAT, sat on: the mat!", "A tale of two cities."]
+    refs = [["The cat sat on the mat."], ["A tale of two towns."]]
+    streams = [[r[0] for r in refs]]
+    oracle = sacrebleu.metrics.TER(**sb_kwargs).corpus_score(preds, streams).score / 100
+    got = float(FT.translation_edit_rate(preds, refs, **kwargs))
+    np.testing.assert_allclose(got, oracle, rtol=1e-5)
+
+
+def test_ter_module_streaming_and_sentence_scores():
+    metric = TranslationEditRate(return_sentence_level_score=True)
+    for p, t in zip(PREDS, REFS):
+        metric.update([p], [t])
+    corpus, sentences = metric.compute()
+    oracle = sacrebleu.metrics.TER().corpus_score(PREDS, REF_STREAMS).score / 100
+    np.testing.assert_allclose(float(corpus), oracle, rtol=1e-5)
+    assert sentences.shape == (4,)
+
+
+# -------------------------------------------------------------------- EED
+
+
+def test_eed_documented_value():
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    np.testing.assert_allclose(float(FT.extended_edit_distance(preds, target)), 0.3078, atol=1e-4)
+    metric = ExtendedEditDistance()
+    metric.update(preds, target)
+    np.testing.assert_allclose(float(metric.compute()), 0.3078, atol=1e-4)
+
+
+def test_eed_identical_near_zero_and_bounds():
+    # identical strings still pay the coverage term: rho / (len + rho)
+    # (published EED behavior: unvisited grid column counts toward coverage)
+    same = ["identical sentence"]
+    expected = 0.3 / (len(" identical sentence ") + 0.3)
+    np.testing.assert_allclose(float(FT.extended_edit_distance(same, same)), expected, atol=1e-6)
+    far = float(FT.extended_edit_distance(["xyz"], ["completely different words entirely"]))
+    assert 0 < far <= 1.0
+
+
+def test_eed_sentence_scores_and_multi_reference():
+    avg, scores = FT.extended_edit_distance(
+        ["the cat"], [["the cat", "a dog"]], return_sentence_level_score=True
+    )
+    # best reference is the exact match: only the coverage term remains
+    np.testing.assert_allclose(float(avg), 0.3 / (len(" the cat ") + 0.3), atol=1e-6)
+    assert scores.shape == (1,)
+
+
+# ------------------------------------------------- WER / CER / MER / WIL/WIP
+
+
+def test_wer_cer_mer_oracles():
+    preds = ["the cat sat", "hello world again"]
+    targets = ["the cat sat down", "goodbye world"]
+    # WER = sum(word edits) / sum(target words)
+    edits = sum(_levenshtein(p.split(), t.split()) for p, t in zip(preds, targets))
+    total = sum(len(t.split()) for t in targets)
+    np.testing.assert_allclose(float(FT.word_error_rate(preds, targets)), edits / total, rtol=1e-6)
+    # CER over characters
+    cedits = sum(_levenshtein(list(p), list(t)) for p, t in zip(preds, targets))
+    ctotal = sum(len(t) for t in targets)
+    np.testing.assert_allclose(float(FT.char_error_rate(preds, targets)), cedits / ctotal, rtol=1e-6)
+    for metric_cls, fn in ((WordErrorRate, FT.word_error_rate), (CharErrorRate, FT.char_error_rate),
+                           (MatchErrorRate, FT.match_error_rate)):
+        m = metric_cls()
+        for p, t in zip(preds, targets):
+            m.update([p], [t])
+        np.testing.assert_allclose(float(m.compute()), float(fn(preds, targets)), rtol=1e-6)
+
+
+def test_wil_wip_complementary():
+    preds = ["the cat sat on mat", "hello big world"]
+    targets = ["the cat sat on the mat", "hello world"]
+    wil = float(FT.word_information_lost(preds, targets))
+    wip = float(FT.word_information_preserved(preds, targets))
+    np.testing.assert_allclose(wil, 1 - wip, rtol=1e-6)
+    m1, m2 = WordInfoLost(), WordInfoPreserved()
+    m1.update(preds, targets)
+    m2.update(preds, targets)
+    np.testing.assert_allclose(float(m1.compute()), wil, rtol=1e-6)
+    np.testing.assert_allclose(float(m2.compute()), wip, rtol=1e-6)
+
+
+def test_edit_distance_module():
+    preds = ["rain", "lnaguaeg"]
+    targets = ["shine", "language"]
+    d1, d2 = _levenshtein(list(preds[0]), list(targets[0])), _levenshtein(list(preds[1]), list(targets[1]))
+    np.testing.assert_allclose(float(FT.edit_distance(preds, targets)), (d1 + d2) / 2, rtol=1e-6)
+    m = EditDistance(reduction="sum")
+    for p, t in zip(preds, targets):
+        m.update([p], [t])
+    np.testing.assert_allclose(float(m.compute()), d1 + d2, rtol=1e-6)
+    m_none = EditDistance(reduction="none")
+    m_none.update(preds, targets)
+    np.testing.assert_allclose(np.asarray(m_none.compute()), [d1, d2])
+
+
+# --------------------------------------------------------------- perplexity
+
+
+def test_perplexity_vs_formula():
+    # input is logits; the kernel softmaxes like the reference (perplexity.py:65-96)
+    rng = np.random.RandomState(17)
+    logits = rng.randn(2, 8, 5).astype(np.float32)
+    target = rng.randint(0, 5, (2, 8))
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    picked = np.take_along_axis(probs, target[..., None], axis=-1)[..., 0]
+    expected = np.exp(-np.log(picked).mean())
+    np.testing.assert_allclose(float(FT.perplexity(logits, target)), expected, rtol=1e-4)
+    m = Perplexity()
+    m.update(logits[:1], target[:1])
+    m.update(logits[1:], target[1:])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_perplexity_ignore_index():
+    rng = np.random.RandomState(18)
+    logits = rng.randn(2, 6, 5).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.randint(0, 5, (2, 6))
+    target[0, 0] = -100
+    mask = target != -100
+    picked = np.take_along_axis(probs, np.where(mask, target, 0)[..., None], axis=-1)[..., 0]
+    expected = np.exp(-(np.log(picked) * mask).sum() / mask.sum())
+    np.testing.assert_allclose(float(FT.perplexity(logits, target, ignore_index=-100)), expected, rtol=1e-4)
+
+
+# ------------------------------------------------------------------- SQuAD
+
+
+def test_squad_reference_example():
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+    res = FT.squad(preds, target)
+    np.testing.assert_allclose(float(res["exact_match"]), 100.0)
+    np.testing.assert_allclose(float(res["f1"]), 100.0)
+    m = SQuAD()
+    m.update(preds, target)
+    res = m.compute()
+    np.testing.assert_allclose(float(res["exact_match"]), 100.0)
+
+
+def test_squad_partial_match():
+    preds = [{"prediction_text": "the quick brown fox", "id": "1"}]
+    target = [{"answers": {"answer_start": [0], "text": ["quick brown fox jumps"]}, "id": "1"}]
+    res = FT.squad(preds, target)
+    assert float(res["exact_match"]) == 0.0
+    # SQuAD normalization drops articles: pred tokens {quick, brown, fox},
+    # target {quick, brown, fox, jumps}; p = 1, r = 3/4 -> F1 = 6/7
+    np.testing.assert_allclose(float(res["f1"]), 100 * 6 / 7, rtol=1e-5)

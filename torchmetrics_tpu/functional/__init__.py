@@ -22,6 +22,8 @@ from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import __all__ as _segmentation_all
+from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = (
     list(_classification_all)
@@ -33,4 +35,5 @@ __all__ = (
     + list(_regression_all)
     + list(_retrieval_all)
     + list(_segmentation_all)
+    + list(_text_all)
 )
