@@ -1,0 +1,218 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Audio metric tests (analogue of reference
+``tests/unittests/audio/test_{sdr,si_sdr,snr,pit,...}.py``).
+
+Oracles: independent numpy implementations of the published formulas; the SDR
+distortion-filter solve is checked against a float64 numpy implementation.
+"""
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.functional.audio as FA
+from torchmetrics_tpu.audio import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+
+
+def _rng(seed=21):
+    return np.random.RandomState(seed)
+
+
+def _si_sdr_oracle(preds, target, zero_mean=False):
+    preds = preds.astype(np.float64)
+    target = target.astype(np.float64)
+    eps = np.finfo(np.float32).eps
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = ((preds * target).sum(-1, keepdims=True) + eps) / ((target**2).sum(-1, keepdims=True) + eps)
+    t = alpha * target
+    noise = t - preds
+    return 10 * np.log10(((t**2).sum(-1) + eps) / ((noise**2).sum(-1) + eps))
+
+
+def _sdr_oracle(preds, target, filter_length=512):
+    """Direct float64 implementation of the BSS-eval SDR distortion filter."""
+    preds = np.atleast_2d(preds).astype(np.float64)
+    target = np.atleast_2d(target).astype(np.float64)
+    out = []
+    for p, t in zip(preds, target):
+        t = t / max(np.linalg.norm(t), 1e-6)
+        p = p / max(np.linalg.norm(p), 1e-6)
+        n_fft = 2 ** int(np.ceil(np.log2(len(p) + len(t) - 1)))
+        t_fft = np.fft.rfft(t, n_fft)
+        r_full = np.fft.irfft(np.abs(t_fft) ** 2, n_fft)[:filter_length]
+        b = np.fft.irfft(np.conj(t_fft) * np.fft.rfft(p, n_fft), n_fft)[:filter_length]
+        from scipy.linalg import solve_toeplitz
+
+        sol = solve_toeplitz(r_full, b)
+        coh = b @ sol
+        out.append(10 * np.log10(coh / (1 - coh)))
+    return np.asarray(out)
+
+
+def test_snr_documented_value():
+    target = np.array([3.0, -0.5, 2.0, 7.0], np.float32)
+    preds = np.array([2.5, 0.0, 2.0, 8.0], np.float32)
+    # reference snr.py doctest: 16.1805
+    np.testing.assert_allclose(float(FA.signal_noise_ratio(preds, target)), 16.1805, atol=1e-3)
+    # si_sdr doctest value: 18.4030
+    np.testing.assert_allclose(
+        float(FA.scale_invariant_signal_distortion_ratio(preds, target)), 18.4030, atol=1e-3
+    )
+
+
+def test_si_sdr_vs_oracle_batch():
+    rng = _rng()
+    preds = rng.randn(6, 1000).astype(np.float32)
+    target = (preds * 0.8 + 0.2 * rng.randn(6, 1000)).astype(np.float32)
+    got = np.asarray(FA.scale_invariant_signal_distortion_ratio(preds, target))
+    np.testing.assert_allclose(got, _si_sdr_oracle(preds, target), rtol=1e-3)
+    m = ScaleInvariantSignalDistortionRatio()
+    m.update(preds[:3], target[:3])
+    m.update(preds[3:], target[3:])
+    np.testing.assert_allclose(float(m.compute()), _si_sdr_oracle(preds, target).mean(), rtol=1e-3)
+
+
+def test_si_snr_is_zero_mean_si_sdr():
+    rng = _rng(3)
+    preds = rng.randn(4, 500).astype(np.float32)
+    target = rng.randn(4, 500).astype(np.float32)
+    got = np.asarray(FA.scale_invariant_signal_noise_ratio(preds, target))
+    np.testing.assert_allclose(got, _si_sdr_oracle(preds, target, zero_mean=True), rtol=1e-3)
+    m = ScaleInvariantSignalNoiseRatio()
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), got.mean(), rtol=1e-4)
+
+
+def test_snr_vs_formula_and_module():
+    rng = _rng(4)
+    preds = rng.randn(5, 400).astype(np.float32)
+    target = (preds + 0.1 * rng.randn(5, 400)).astype(np.float32)
+    eps = np.finfo(np.float32).eps
+    expected = 10 * np.log10(
+        ((target.astype(np.float64) ** 2).sum(-1) + eps)
+        / (((target - preds).astype(np.float64) ** 2).sum(-1) + eps)
+    )
+    np.testing.assert_allclose(np.asarray(FA.signal_noise_ratio(preds, target)), expected, rtol=1e-3)
+    m = SignalNoiseRatio()
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), expected.mean(), rtol=1e-3)
+
+
+def test_sdr_vs_toeplitz_oracle():
+    rng = _rng(5)
+    target = rng.randn(3, 2000).astype(np.float32)
+    preds = (0.9 * target + 0.1 * rng.randn(3, 2000)).astype(np.float32)
+    got = np.asarray(FA.signal_distortion_ratio(preds, target, filter_length=64))
+    expected = _sdr_oracle(preds, target, filter_length=64)
+    np.testing.assert_allclose(got, expected, rtol=2e-2, atol=0.1)
+    m = SignalDistortionRatio(filter_length=64)
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), got.mean(), rtol=1e-5)
+
+
+def test_sa_sdr_matches_pooled_formula():
+    rng = _rng(6)
+    preds = rng.randn(2, 3, 600).astype(np.float32)
+    target = (preds + 0.3 * rng.randn(2, 3, 600)).astype(np.float32)
+    got = np.asarray(FA.source_aggregated_signal_distortion_ratio(preds, target))
+    # oracle: pooled over speakers with a shared scale
+    eps = np.finfo(np.float32).eps
+    p, t = preds.astype(np.float64), target.astype(np.float64)
+    alpha = ((p * t).sum((-1, -2), keepdims=True) + eps) / ((t**2).sum((-1, -2), keepdims=True) + eps)
+    ts = alpha * t
+    expected = 10 * np.log10(((ts**2).sum((-1, -2)) + eps) / (((ts - p) ** 2).sum((-1, -2)) + eps))
+    np.testing.assert_allclose(got, expected, rtol=1e-3)
+    m = SourceAggregatedSignalDistortionRatio()
+    m.update(preds, target)
+    np.testing.assert_allclose(float(m.compute()), expected.mean(), rtol=1e-3)
+
+
+def test_complex_si_snr():
+    rng = _rng(7)
+    spec = (rng.randn(2, 16, 30) + 1j * rng.randn(2, 16, 30)).astype(np.complex64)
+    got_complex = np.asarray(FA.complex_scale_invariant_signal_noise_ratio(spec, spec))
+    assert np.all(got_complex > 50)  # identical signals -> very high ratio
+    real_form = np.stack([spec.real, spec.imag], axis=-1)
+    got_real = np.asarray(FA.complex_scale_invariant_signal_noise_ratio(real_form, real_form))
+    np.testing.assert_allclose(got_complex, got_real, rtol=1e-4)
+    m = ComplexScaleInvariantSignalNoiseRatio()
+    m.update(real_form, real_form)
+    assert float(m.compute()) > 50
+    with pytest.raises(RuntimeError, match="frequency"):
+        FA.complex_scale_invariant_signal_noise_ratio(np.zeros((2, 4)), np.zeros((2, 4)))
+
+
+def test_pit_speaker_wise_finds_swapped_permutation():
+    rng = _rng(8)
+    target = rng.randn(4, 2, 300).astype(np.float32)
+    preds = target[:, ::-1, :].copy()  # swapped speakers
+    best_metric, best_perm = FA.permutation_invariant_training(
+        preds, target, FA.scale_invariant_signal_distortion_ratio, eval_func="max"
+    )
+    assert np.all(np.asarray(best_metric) > 50)
+    np.testing.assert_array_equal(np.asarray(best_perm), np.tile([1, 0], (4, 1)))
+    restored = FA.pit_permutate(preds, best_perm)
+    np.testing.assert_allclose(np.asarray(restored), target, rtol=1e-6)
+
+
+def test_pit_three_speakers_and_permutation_wise():
+    rng = _rng(9)
+    target = rng.randn(2, 3, 200).astype(np.float32)
+    perm = [2, 0, 1]
+    preds = target[:, perm, :].copy()
+    best_metric, best_perm = FA.permutation_invariant_training(
+        preds, target, FA.scale_invariant_signal_distortion_ratio, eval_func="max"
+    )
+    restored = FA.pit_permutate(preds, best_perm)
+    np.testing.assert_allclose(np.asarray(restored), target, rtol=1e-6)
+    # permutation-wise mode with an aggregated metric
+    best_metric2, best_perm2 = FA.permutation_invariant_training(
+        preds, target, FA.source_aggregated_signal_distortion_ratio,
+        mode="permutation-wise", eval_func="max",
+    )
+    restored2 = FA.pit_permutate(preds, best_perm2)
+    np.testing.assert_allclose(np.asarray(restored2), target, rtol=1e-6)
+
+
+def test_pit_module_streaming():
+    rng = _rng(10)
+    target = rng.randn(6, 2, 100).astype(np.float32)
+    preds = (target[:, ::-1, :] + 0.05 * rng.randn(6, 2, 100)).astype(np.float32)
+    metric = PermutationInvariantTraining(FA.scale_invariant_signal_distortion_ratio, eval_func="max")
+    for i in range(0, 6, 2):
+        metric.update(preds[i : i + 2], target[i : i + 2])
+    expected = np.asarray(
+        FA.permutation_invariant_training(preds, target, FA.scale_invariant_signal_distortion_ratio)[0]
+    ).mean()
+    np.testing.assert_allclose(float(metric.compute()), expected, rtol=1e-4)
+
+
+def test_pit_validation_errors():
+    with pytest.raises(ValueError, match="eval_func"):
+        FA.permutation_invariant_training(
+            np.zeros((2, 2, 10)), np.zeros((2, 2, 10)), FA.signal_noise_ratio, eval_func="bad"
+        )
+    with pytest.raises(ValueError, match="mode"):
+        FA.permutation_invariant_training(
+            np.zeros((2, 2, 10)), np.zeros((2, 2, 10)), FA.signal_noise_ratio, mode="bad"
+        )
+
+
+def test_callback_metrics_gated_when_backend_missing():
+    from torchmetrics_tpu.functional.audio.callbacks import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+    if not _PESQ_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            FA.perceptual_evaluation_speech_quality(np.zeros(8000), np.zeros(8000), 8000, "nb")
+    if not _PYSTOI_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="pystoi"):
+            FA.short_time_objective_intelligibility(np.zeros(8000), np.zeros(8000), 8000)

@@ -1,0 +1,30 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Audio module metrics (reference ``src/torchmetrics/audio/__init__.py``)."""
+from torchmetrics_tpu.audio.metrics import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    DeepNoiseSuppressionMeanOpinionScore,
+    PerceptualEvaluationSpeechQuality,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+    SpeechReverberationModulationEnergyRatio,
+)
+
+__all__ = [
+    "ComplexScaleInvariantSignalNoiseRatio",
+    "DeepNoiseSuppressionMeanOpinionScore",
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+    "SourceAggregatedSignalDistortionRatio",
+    "SpeechReverberationModulationEnergyRatio",
+]

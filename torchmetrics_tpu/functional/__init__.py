@@ -4,6 +4,8 @@
 
 Flat namespace mirroring reference ``src/torchmetrics/functional/__init__.py``.
 """
+from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.audio import __all__ as _audio_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
@@ -26,7 +28,8 @@ from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = (
-    list(_classification_all)
+    list(_audio_all)
+    + list(_classification_all)
     + list(_clustering_all)
     + list(_detection_all)
     + list(_image_all)

