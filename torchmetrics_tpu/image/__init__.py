@@ -1,6 +1,11 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
 """Image module metrics (reference ``src/torchmetrics/image/__init__.py``)."""
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance
+from torchmetrics_tpu.image.inception_score import InceptionScore
+from torchmetrics_tpu.image.kid import KernelInceptionDistance
+from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
 from torchmetrics_tpu.image.metrics import (
     ErrorRelativeGlobalDimensionlessSynthesis,
     MultiScaleStructuralSimilarityIndexMeasure,
@@ -21,6 +26,11 @@ from torchmetrics_tpu.image.metrics import (
 
 __all__ = [
     "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
     "PeakSignalNoiseRatioWithBlockedEffect",

@@ -1,0 +1,140 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Fréchet inception distance (reference ``image/fid.py:182``).
+
+TPU-split design: feature extraction and the streaming sum / covariance-sum
+states run on device (all ``"sum"``-reduced, so FID streams and shards like
+any counter metric); the final d×d trace-sqrt term runs on host in float64
+(``np.linalg.eigvals``) exactly because TPUs are float32-native and the
+spectrum of Σ₁Σ₂ needs the precision (reference ``fid.py:159-179``,
+SURVEY §7 hard-part 3).
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+_ALLOWED_FEATURE_DIMS = (64, 192, 768, 2048)
+
+
+def _compute_fid(mu1: np.ndarray, sigma1: np.ndarray, mu2: np.ndarray, sigma2: np.ndarray) -> float:
+    """||μ1-μ2||² + Tr(Σ1 + Σ2 - 2√(Σ1Σ2)) via the eigenvalue form
+    (reference ``fid.py:159-179``), in float64 on host."""
+    a = float(np.square(mu1 - mu2).sum())
+    b = float(np.trace(sigma1) + np.trace(sigma2))
+    eigvals = np.linalg.eigvals(sigma1 @ sigma2)
+    c = float(np.sqrt(eigvals.astype(np.complex128)).real.sum())
+    return a + b - 2 * c
+
+
+class FrechetInceptionDistance(Metric):
+    """FID (reference ``image/fid.py:182-475``).
+
+    ``feature`` is a tap dimension of the built-in Flax InceptionV3 or any
+    callable mapping an image batch to ``(N, d)`` features (the reference
+    accepts an ``nn.Module`` the same way).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        input_img_size: Any = None,
+        feature_extractor_params: Optional[dict] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.used_custom_model = False
+        if isinstance(feature, int):
+            if feature not in _ALLOWED_FEATURE_DIMS:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {_ALLOWED_FEATURE_DIMS}, but got {feature}."
+                )
+            num_features = feature
+            self.inception = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+        elif callable(feature):
+            self.inception = feature
+            self.used_custom_model = True
+            dummy = jnp.zeros((1, 3, 64, 64), jnp.uint8 if not normalize else jnp.float32)
+            num_features = int(np.asarray(feature(dummy)).shape[-1])
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        self.add_state("real_features_sum", jnp.zeros(num_features, dtype), dist_reduce_fx="sum")
+        self.add_state("real_features_cov_sum", jnp.zeros((num_features, num_features), dtype), dist_reduce_fx="sum")
+        self.add_state("real_features_num_samples", jnp.asarray(0, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32), dist_reduce_fx="sum")
+        self.add_state("fake_features_sum", jnp.zeros(num_features, dtype), dist_reduce_fx="sum")
+        self.add_state("fake_features_cov_sum", jnp.zeros((num_features, num_features), dtype), dist_reduce_fx="sum")
+        self.add_state("fake_features_num_samples", jnp.asarray(0, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract features and fold sum/cov-sum (reference ``fid.py:354-377``)."""
+        imgs = jnp.asarray(imgs)
+        if self.normalize and not self.used_custom_model:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        features = jnp.asarray(self.inception(imgs))
+        if features.ndim == 1:
+            features = features[None, :]
+        features = features.astype(self.real_features_sum.dtype)
+        if real:
+            self.real_features_sum = self.real_features_sum + features.sum(axis=0)
+            self.real_features_cov_sum = self.real_features_cov_sum + features.T @ features
+            self.real_features_num_samples = self.real_features_num_samples + imgs.shape[0]
+        else:
+            self.fake_features_sum = self.fake_features_sum + features.sum(axis=0)
+            self.fake_features_cov_sum = self.fake_features_cov_sum + features.T @ features
+            self.fake_features_num_samples = self.fake_features_num_samples + imgs.shape[0]
+
+    def compute(self) -> Array:
+        """Mean/cov from streaming sums, host f64 trace-sqrt (reference ``fid.py:379-389``)."""
+        if int(self.real_features_num_samples) < 2 or int(self.fake_features_num_samples) < 2:
+            raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
+        n_real = np.float64(int(self.real_features_num_samples))
+        n_fake = np.float64(int(self.fake_features_num_samples))
+        mean_real = np.asarray(self.real_features_sum, np.float64) / n_real
+        mean_fake = np.asarray(self.fake_features_sum, np.float64) / n_fake
+        cov_real = (np.asarray(self.real_features_cov_sum, np.float64) - n_real * np.outer(mean_real, mean_real)) / (
+            n_real - 1
+        )
+        cov_fake = (np.asarray(self.fake_features_cov_sum, np.float64) - n_fake * np.outer(mean_fake, mean_fake)) / (
+            n_fake - 1
+        )
+        return jnp.asarray(_compute_fid(mean_real, cov_real, mean_fake, cov_fake), jnp.float32)
+
+    def reset(self) -> None:
+        """Optionally keep real-distribution statistics (reference ``fid.py:391-402``)."""
+        if not self.reset_real_features:
+            real_features_sum = self.real_features_sum
+            real_features_cov_sum = self.real_features_cov_sum
+            real_features_num_samples = self.real_features_num_samples
+            super().reset()
+            self.real_features_sum = real_features_sum
+            self.real_features_cov_sum = real_features_cov_sum
+            self.real_features_num_samples = real_features_num_samples
+        else:
+            super().reset()
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,90 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Inception score (reference ``image/inception.py:36``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    """IS over random splits (reference ``image/inception.py:36-203``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        feature_extractor_params: Optional[dict] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        rank_zero_warn(
+            "Metric `InceptionScore` will save all extracted features in buffer."
+            " For large datasets this may lead to large memory footprint.",
+            UserWarning,
+        )
+        self.used_custom_model = False
+        if isinstance(feature, (str, int)):
+            valid_int_input = ("logits_unbiased", 64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+        elif callable(feature):
+            self.inception = feature
+            self.used_custom_model = True
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError("Expected argument `splits` to be an integer larger than 0")
+        self.splits = splits
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self.add_state("features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:
+        """Append logits (reference ``inception.py:147-151``)."""
+        imgs = jnp.asarray(imgs)
+        if self.normalize and not self.used_custom_model:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        features = jnp.asarray(self.inception(imgs))
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Mean/std of exp(KL) over splits (reference ``inception.py:153-175``)."""
+        features = dim_zero_cat(self.features)
+        # random permutation with a fixed host seed (reference uses torch.randperm)
+        idx = np.random.RandomState(42).permutation(features.shape[0])
+        features = features[idx]
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+        prob_chunks = jnp.array_split(prob, self.splits, axis=0)
+        log_prob_chunks = jnp.array_split(log_prob, self.splits, axis=0)
+        mean_prob = [p.mean(axis=0, keepdims=True) for p in prob_chunks]
+        kl_ = [
+            (p * (log_p - jnp.log(m_p))).sum(axis=1).mean()
+            for p, log_p, m_p in zip(prob_chunks, log_prob_chunks, mean_prob)
+        ]
+        kl = jnp.exp(jnp.stack(kl_))
+        return kl.mean(), kl.std(ddof=1)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,109 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Memorization-informed FID (reference ``image/mifid.py:69``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+from torchmetrics_tpu.image.fid import _ALLOWED_FEATURE_DIMS, _compute_fid
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _compute_cosine_distance(features1: np.ndarray, features2: np.ndarray, cosine_distance_eps: float = 0.1) -> float:
+    """Thresholded mean minimum cosine distance (reference ``mifid.py:36-47``)."""
+    f1 = features1 / np.linalg.norm(features1, axis=1, keepdims=True)
+    f2 = features2 / np.linalg.norm(features2, axis=1, keepdims=True)
+    d = 1.0 - np.abs(f1 @ f2.T)
+    mean_min_d = float(np.mean(d.min(axis=1)))
+    return mean_min_d if mean_min_d < cosine_distance_eps else 1.0
+
+
+def _mifid_compute(
+    real: np.ndarray, fake: np.ndarray, cosine_distance_eps: float = 0.1
+) -> float:
+    """FID / thresholded memorization distance (reference ``mifid.py:50-62``)."""
+    mu1, sigma1 = real.mean(axis=0), np.cov(real, rowvar=False)
+    mu2, sigma2 = fake.mean(axis=0), np.cov(fake, rowvar=False)
+    fid_value = _compute_fid(mu1, sigma1, mu2, sigma2)
+    distance = _compute_cosine_distance(fake, real, cosine_distance_eps)
+    return fid_value / (distance + 1e-15)
+
+
+class MemorizationInformedFrechetInceptionDistance(Metric):
+    """MiFID (reference ``mifid.py:69-264``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        feature_extractor_params: Optional[dict] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.used_custom_model = False
+        if isinstance(feature, int):
+            if feature not in _ALLOWED_FEATURE_DIMS:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {_ALLOWED_FEATURE_DIMS}, but got {feature}."
+                )
+            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+        elif callable(feature):
+            self.inception = feature
+            self.used_custom_model = True
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        if not (isinstance(cosine_distance_eps, float) and 1 >= cosine_distance_eps > 0):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
+        self.cosine_distance_eps = cosine_distance_eps
+
+        self.add_state("real_features", [], dist_reduce_fx=None)
+        self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        imgs = jnp.asarray(imgs)
+        if self.normalize and not self.used_custom_model:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        features = jnp.asarray(self.inception(imgs))
+        if features.ndim == 1:
+            features = features[None, :]
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        real = np.asarray(dim_zero_cat(self.real_features), np.float64)
+        fake = np.asarray(dim_zero_cat(self.fake_features), np.float64)
+        return jnp.asarray(_mifid_compute(real, fake, self.cosine_distance_eps), jnp.float32)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            real_features = self.real_features
+            super().reset()
+            self.real_features = real_features
+        else:
+            super().reset()
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
