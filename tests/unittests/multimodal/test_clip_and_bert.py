@@ -1,0 +1,176 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CLIPScore / CLIP-IQA / BERTScore tests with tiny offline Flax models
+(analogue of reference ``tests/unittests/multimodal/test_clip_score.py``,
+``test_clip_iqa.py``, ``tests/unittests/text/test_bertscore.py``; the real
+checkpoints need network access, so tiny randomly-initialized towers +
+metric-math oracles stand in)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+pytest.importorskip("transformers")
+
+from transformers import BertConfig, CLIPConfig, FlaxBertModel, FlaxCLIPModel  # noqa: E402
+
+from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment, clip_score  # noqa: E402
+from torchmetrics_tpu.functional.text.bert import bert_score  # noqa: E402
+from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore  # noqa: E402
+from torchmetrics_tpu.text.bert import BERTScore  # noqa: E402
+
+
+class _WordHashTokenizer:
+    """Deterministic offline tokenizer: hash words into a small id space."""
+
+    def __init__(self, vocab_size=64, max_len=16):
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+
+    def __call__(self, text=None, padding=True, truncation=True, max_length=None, return_tensors="np", **kw):
+        max_length = min(max_length or self.max_len, self.max_len)
+        rows = []
+        for sentence in text:
+            ids = [1]  # [CLS]
+            ids += [3 + (hash(w) % (self.vocab_size - 4)) for w in sentence.lower().split()]
+            ids = ids[: max_length - 1] + [2]  # [SEP]
+            rows.append(ids)
+        if padding == "max_length":
+            width = max_length
+        else:
+            width = max(len(r) for r in rows)
+        input_ids = np.zeros((len(rows), width), np.int32)
+        attention_mask = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            input_ids[i, : len(r)] = r
+            attention_mask[i, : len(r)] = 1
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+class _TinyCLIPProcessor(_WordHashTokenizer):
+    """Adds trivial image preprocessing (resize-free passthrough to 32x32)."""
+
+    def __call__(self, text=None, images=None, return_tensors="np", padding=True, **kw):
+        out = {}
+        if text is not None:
+            out.update(super().__call__(text=text, padding=padding))
+        if images is not None:
+            pixel = np.stack([np.asarray(i, np.float32).reshape(3, 32, 32) for i in images])
+            out["pixel_values"] = pixel
+        return out
+
+
+def _tiny_clip():
+    cfg = CLIPConfig(
+        text_config={
+            "hidden_size": 32, "intermediate_size": 64, "num_attention_heads": 2,
+            "num_hidden_layers": 2, "vocab_size": 64, "max_position_embeddings": 32,
+        },
+        vision_config={
+            "hidden_size": 32, "intermediate_size": 64, "num_attention_heads": 2,
+            "num_hidden_layers": 2, "image_size": 32, "patch_size": 8,
+        },
+        projection_dim=16,
+    )
+    return FlaxCLIPModel(cfg, seed=0), _TinyCLIPProcessor()
+
+
+def _tiny_bert():
+    cfg = BertConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, vocab_size=64, max_position_embeddings=64,
+    )
+    return FlaxBertModel(cfg, seed=0), _WordHashTokenizer()
+
+
+@pytest.fixture(scope="module")
+def clip_pair():
+    return _tiny_clip()
+
+
+@pytest.fixture(scope="module")
+def bert_pair():
+    return _tiny_bert()
+
+
+def test_clip_score_functional_and_module(clip_pair):
+    model, processor = clip_pair
+    rng = np.random.RandomState(0)
+    images = rng.rand(2, 3, 32, 32).astype(np.float32)
+    captions = ["a photo of a cat", "a photo of a dog"]
+    val = clip_score(list(jnp.asarray(images)), captions, model=model, processor=processor)
+    assert 0 <= float(val) <= 100
+    metric = CLIPScore(model=model, processor=processor)
+    metric.update(jnp.asarray(images), captions)
+    np.testing.assert_allclose(float(metric.compute()), float(val), rtol=1e-4)
+    # streaming two batches equals one concatenated batch
+    metric2 = CLIPScore(model=model, processor=processor)
+    metric2.update(jnp.asarray(images[:1]), captions[:1])
+    metric2.update(jnp.asarray(images[1:]), captions[1:])
+    np.testing.assert_allclose(float(metric2.compute()), float(val), rtol=1e-4)
+
+
+def test_clip_score_mismatched_lengths_raise(clip_pair):
+    model, processor = clip_pair
+    with pytest.raises(ValueError, match="same"):
+        clip_score([jnp.zeros((3, 32, 32))], ["a", "b"], model=model, processor=processor)
+
+
+def test_clip_iqa_functional_and_module(clip_pair):
+    model, processor = clip_pair
+    rng = np.random.RandomState(1)
+    images = rng.rand(3, 3, 32, 32).astype(np.float32)
+    probs = clip_image_quality_assessment(images, prompts=("quality",), model=model, processor=processor)
+    probs = np.asarray(probs)
+    assert probs.shape == (3,)
+    assert np.all((0 <= probs) & (probs <= 1))
+    multi = clip_image_quality_assessment(
+        images, prompts=("quality", ("Nice photo.", "Terrible photo.")), model=model, processor=processor
+    )
+    assert set(multi.keys()) == {"quality", "user_defined_0"}
+    metric = CLIPImageQualityAssessment(prompts=("quality",), model=model, processor=processor)
+    metric.update(images)
+    np.testing.assert_allclose(np.asarray(metric.compute()), probs, rtol=1e-4)
+
+
+def test_clip_iqa_prompt_validation(clip_pair):
+    model, processor = clip_pair
+    with pytest.raises(ValueError, match="must be one of"):
+        clip_image_quality_assessment(np.zeros((1, 3, 32, 32)), prompts=("bogus",), model=model, processor=processor)
+    with pytest.raises(ValueError, match="length 2"):
+        clip_image_quality_assessment(
+            np.zeros((1, 3, 32, 32)), prompts=(("a", "b", "c"),), model=model, processor=processor
+        )
+
+
+def test_bert_score_identical_sentences_score_highest(bert_pair):
+    model, tokenizer = bert_pair
+    preds = ["the cat sat on the mat", "a completely different sentence"]
+    target = ["the cat sat on the mat", "the cat sat on the mat"]
+    res = bert_score(preds, target, model=model, user_tokenizer=tokenizer)
+    f1 = np.asarray(res["f1"])
+    assert f1.shape == (2,)
+    assert f1[0] > f1[1]  # identical pair scores higher
+    np.testing.assert_allclose(f1[0], 1.0, atol=1e-4)  # self-match is exactly 1
+
+
+def test_bert_score_module_matches_functional(bert_pair):
+    model, tokenizer = bert_pair
+    preds = ["hello there world", "general kenobi strikes"]
+    target = ["hello world", "general kenobi"]
+    expected = bert_score(preds, target, model=model, user_tokenizer=tokenizer, max_length=16)
+    metric = BERTScore(model=model, user_tokenizer=tokenizer, max_length=16)
+    for p, t in zip(preds, target):
+        metric.update([p], [t])
+    got = metric.compute()
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(np.asarray(got[key]), np.asarray(expected[key]), rtol=1e-4, err_msg=key)
+
+
+def test_bert_score_idf_changes_scores(bert_pair):
+    model, tokenizer = bert_pair
+    preds = ["the the the unusual word", "another sample here"]
+    target = ["the the the common words", "another sample there"]
+    plain = np.asarray(bert_score(preds, target, model=model, user_tokenizer=tokenizer)["f1"])
+    with_idf = np.asarray(bert_score(preds, target, model=model, user_tokenizer=tokenizer, idf=True)["f1"])
+    assert not np.allclose(plain, with_idf)

@@ -24,8 +24,11 @@ from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import __all__ as _segmentation_all
+from torchmetrics_tpu.functional.multimodal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.multimodal import __all__ as _multimodal_all
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
+from torchmetrics_tpu.functional.text.bert import bert_score  # noqa: F401
 
 __all__ = (
     list(_audio_all)
@@ -38,5 +41,7 @@ __all__ = (
     + list(_regression_all)
     + list(_retrieval_all)
     + list(_segmentation_all)
+    + list(_multimodal_all)
     + list(_text_all)
+    + ["bert_score"]
 )

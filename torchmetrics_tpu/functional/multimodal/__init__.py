@@ -1,0 +1,7 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Multimodal functional metrics (reference ``src/torchmetrics/functional/multimodal/__init__.py``)."""
+from torchmetrics_tpu.functional.multimodal.clip_iqa import clip_image_quality_assessment
+from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
+
+__all__ = ["clip_image_quality_assessment", "clip_score"]

@@ -1,0 +1,118 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CLIP image quality assessment (reference ``functional/multimodal/clip_iqa.py``).
+
+Prompt-pair softmax over CLIP similarities on a Flax CLIP. The ``piq``
+``clip_iqa`` checkpoint path of the reference is not replicated — any HF CLIP
+checkpoint (or an injected model/processor pair) plays that role.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.multimodal.clip_score import _get_clip_model_and_processor
+
+Array = jax.Array
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _clip_iqa_format_prompts(prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",)) -> Tuple[List[str], List[str]]:
+    """Expand prompt keywords / custom pairs (reference ``clip_iqa.py:92-142``)."""
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+    prompts_names: List[str] = []
+    prompts_list: List[str] = []
+    count = 0
+    for p in prompts:
+        if not isinstance(p, (str, tuple)):
+            raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {_PROMPTS.keys()} if not custom tuple prompts, got {p}."
+                )
+            prompts_names.append(p)
+            prompts_list.extend(_PROMPTS[p])
+        else:
+            if len(p) != 2:
+                raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+            prompts_names.append(f"user_defined_{count}")
+            prompts_list.extend(p)
+            count += 1
+    return prompts_list, prompts_names
+
+
+def _clip_iqa_get_anchor_vectors(model: Any, processor: Callable, prompts_list: List[str]) -> Array:
+    """Unit-norm text anchors (reference ``clip_iqa.py:145-176``)."""
+    processed = processor(text=prompts_list, return_tensors="np", padding=True)
+    anchors = jnp.asarray(
+        model.get_text_features(jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"]))
+    )
+    return anchors / jnp.linalg.norm(anchors, axis=-1, keepdims=True)
+
+
+def _clip_iqa_update(
+    images: Array, model: Any, processor: Callable, data_range: float
+) -> Array:
+    """Unit-norm image features (reference ``clip_iqa.py:179-204``)."""
+    images = jnp.asarray(images) / float(data_range)
+    processed = processor(images=[np.asarray(i) for i in images], return_tensors="np", padding=True)
+    img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
+    return img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+
+
+def _clip_iqa_compute(
+    img_features: Array,
+    anchors: Array,
+    prompts_names: List[str],
+    format_as_dict: bool = True,
+) -> Union[Array, Dict[str, Array]]:
+    """Positive-prompt probability per pair (reference ``clip_iqa.py:207-219``)."""
+    logits_per_image = 100 * img_features @ anchors.T
+    probs = jax.nn.softmax(logits_per_image.reshape(logits_per_image.shape[0], -1, 2), axis=-1)[:, :, 0]
+    if len(prompts_names) == 1:
+        return probs.squeeze()
+    if format_as_dict:
+        return {p: probs[:, i] for i, p in enumerate(prompts_names)}
+    return probs
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    model_name_or_path: str = "openai/clip-vit-base-patch16",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+    model: Optional[Any] = None,
+    processor: Optional[Callable] = None,
+) -> Union[Array, Dict[str, Array]]:
+    """CLIP-IQA (reference ``clip_iqa.py:222-330``)."""
+    prompts_list, prompts_names = _clip_iqa_format_prompts(prompts)
+    model, processor = _get_clip_model_and_processor(model_name_or_path, model, processor)
+    images = jnp.asarray(images)
+    if images.ndim != 4 or images.shape[1] != 3:
+        raise ValueError(f"Expected 4d image batch in NCHW format, got shape {images.shape}")
+    anchors = _clip_iqa_get_anchor_vectors(model, processor, prompts_list)
+    img_features = _clip_iqa_update(images, model, processor, data_range)
+    return _clip_iqa_compute(img_features, anchors, prompts_names)

@@ -1,0 +1,102 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CLIPScore (reference ``functional/multimodal/clip_score.py:44-164``).
+
+Runs a **Flax** CLIP (``transformers.FlaxCLIPModel``) so the image/text
+towers execute as jitted XLA programs on the accelerator — the reference uses
+the torch ``CLIPModel``. ``model``/``processor`` are injectable for offline
+or custom checkpoints.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+_TRANSFORMERS_AVAILABLE = ModuleAvailableCache("transformers")
+
+
+def _get_clip_model_and_processor(
+    model_name_or_path: str = "openai/clip-vit-large-patch14",
+    model: Optional[Any] = None,
+    processor: Optional[Callable] = None,
+) -> Tuple[Any, Callable]:
+    """Load a Flax CLIP + processor, or pass through injected ones
+    (reference ``clip_score.py:94-110``)."""
+    if model is not None and processor is not None:
+        return model, processor
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`clip_score` metric requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.10.0` or `pip install torchmetrics[multimodal]`."
+        )
+    from transformers import CLIPProcessor, FlaxCLIPModel
+
+    model = FlaxCLIPModel.from_pretrained(model_name_or_path)
+    processor = CLIPProcessor.from_pretrained(model_name_or_path)
+    return model, processor
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model: Any,
+    processor: Callable,
+) -> Tuple[Array, int]:
+    """Per-pair 100·cosine similarity (reference ``clip_score.py:44-91``)."""
+    if not isinstance(images, list):
+        images = [images] if jnp.asarray(images).ndim == 3 else list(jnp.asarray(images))
+    else:
+        images = [jnp.asarray(i) for i in images]
+    if not all(jnp.asarray(i).ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+    processed = processor(text=text, images=[np.asarray(i) for i in images], return_tensors="np", padding=True)
+
+    img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+
+    max_position_embeddings = model.config.text_config.max_position_embeddings
+    input_ids = jnp.asarray(processed["input_ids"])
+    attention_mask = jnp.asarray(processed["attention_mask"])
+    if attention_mask.shape[-1] > max_position_embeddings:
+        rank_zero_warn(
+            f"Encountered caption longer than max_position_embeddings={max_position_embeddings}."
+            " Will truncate captions to this length."
+            " If longer captions are needed, initialize argument `model_name_or_path` with a model that supports"
+            " longer sequences",
+            UserWarning,
+        )
+        attention_mask = attention_mask[..., :max_position_embeddings]
+        input_ids = input_ids[..., :max_position_embeddings]
+
+    txt_features = jnp.asarray(model.get_text_features(input_ids, attention_mask))
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+
+    score = 100 * (img_features * txt_features).sum(axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: str = "openai/clip-vit-large-patch14",
+    model: Optional[Any] = None,
+    processor: Optional[Callable] = None,
+) -> Array:
+    """CLIPScore = max(100·cos(E_I, E_C), 0) (reference ``clip_score.py:117-164``)."""
+    model, processor = _get_clip_model_and_processor(model_name_or_path, model, processor)
+    score, _ = _clip_score_update(images, text, model, processor)
+    return jnp.maximum(score.mean(), 0.0)

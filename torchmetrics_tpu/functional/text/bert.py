@@ -1,0 +1,215 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""BERTScore (reference ``functional/text/bert.py:69-257``).
+
+The embedding model is a **Flax** transformer (``transformers.FlaxAutoModel``)
+so the forward passes are jitted XLA programs; pairwise token cosine and the
+greedy max-matching are one batched einsum + max-reduce. ``model``/
+``user_tokenizer``/``user_forward_fn`` are injectable exactly like the
+reference's user-model path (``bert.py:259-…``), which keeps the metric
+usable offline and with custom towers.
+"""
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
+
+Array = jax.Array
+
+_TRANSFORMERS_AVAILABLE = ModuleAvailableCache("transformers")
+
+_DEFAULT_MODEL = "roberta-large"
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: np.ndarray) -> np.ndarray:
+    """Zero out [CLS]/[SEP] positions (reference ``helper_embedding_metric.py:33-49``)."""
+    attention_mask = attention_mask.copy()
+    attention_mask[:, 0] = 0
+    sep_pos = np.cumsum(attention_mask - 0.1, axis=-1).argmax(-1)
+    attention_mask[np.arange(attention_mask.shape[0]), sep_pos] = 0
+    return attention_mask
+
+
+def _get_tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """Plus-one-smoothed log inverse document frequencies (reference
+    ``helper_embedding_metric.py:240-259``)."""
+    num_sentences = input_ids.shape[0]
+    counter: Counter = Counter()
+    for row, mask in zip(input_ids, attention_mask):
+        counter.update(set(row[mask > 0].tolist()))
+    idf: Dict[int, float] = defaultdict(lambda: math.log((num_sentences + 1) / 1))
+    idf.update({idx: math.log((num_sentences + 1) / (count + 1)) for idx, count in counter.items()})
+    return idf
+
+
+def _embed(
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    model: Any,
+    num_layers: Optional[int],
+    user_forward_fn: Optional[Callable],
+    idf: bool,
+    tokens_idf: Optional[Dict[int, float]],
+    batch_size: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Unit-norm token embeddings masked for special tokens + per-sentence
+    normalized idf scales (reference ``bert.py:69-149``)."""
+    # trim to the longest real sequence (reference _input_data_collator)
+    real_len = int(attention_mask.sum(1).max())
+    input_ids = input_ids[:, :real_len]
+    attention_mask = attention_mask[:, :real_len]
+    embeddings_list = []
+    for start in range(0, input_ids.shape[0], batch_size):
+        ids = jnp.asarray(input_ids[start : start + batch_size])
+        mask = jnp.asarray(attention_mask[start : start + batch_size])
+        if user_forward_fn is not None:
+            out = user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})
+            out = jnp.asarray(out)
+        else:
+            result = model(ids, mask, output_hidden_states=True)
+            hidden = result.hidden_states
+            out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])
+        out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
+        embeddings_list.append(np.asarray(out))
+    embeddings = np.concatenate(embeddings_list)
+
+    processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
+    embeddings = embeddings * processed_mask[:, :, None]
+
+    if idf:
+        assert tokens_idf is not None
+        idf_weights = np.vectorize(lambda t: tokens_idf[int(t)])(input_ids).astype(np.float64)
+        idf_weights = idf_weights * processed_mask
+    else:
+        idf_weights = processed_mask.astype(np.float64)
+    idf_scale = idf_weights / idf_weights.sum(-1, keepdims=True)
+    return embeddings, idf_scale
+
+
+def _get_precision_recall_f1(
+    preds_embeddings: Array,
+    target_embeddings: Array,
+    preds_idf_scale: Array,
+    target_idf_scale: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matching P/R/F1 (reference ``bert.py:150-184``)."""
+    cos_sim = jnp.einsum("bpd, brd -> bpr", preds_embeddings, target_embeddings)
+    precision = (cos_sim.max(axis=2) * preds_idf_scale).sum(-1)
+    recall = (cos_sim.max(axis=1) * target_idf_scale).sum(-1)
+    f1 = 2 * precision * recall / (precision + recall)
+    f1 = jnp.nan_to_num(f1)
+    return precision, recall, f1
+
+
+def _load_default_model(model_name_or_path: str):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.4` or `pip install torchmetrics[text]`."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    return model, tokenizer
+
+
+def bert_score(
+    preds: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    target: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Dict[str, Union[Array, List[float], str]]:
+    """BERTScore (reference ``bert.py:259-…``).
+
+    ``preds``/``target`` are raw strings or pre-tokenized dicts with
+    ``input_ids``/``attention_mask``. ``all_layers``/baseline rescaling of the
+    reference are supported except for downloading baselines (no egress);
+    pass ``baseline_path`` with a local CSV for rescaling.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if not isinstance(preds, dict) and not isinstance(target, dict) and len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+    if all_layers and user_forward_fn is not None:
+        raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
+    if rescale_with_baseline and baseline_path is None and baseline_url is None:
+        raise ValueError(
+            "Baseline rescaling requires a local `baseline_path` (downloading baselines needs network egress)."
+        )
+
+    tokenizer = user_tokenizer
+    if model is None:
+        model, tokenizer = _load_default_model(model_name_or_path or _DEFAULT_MODEL)
+
+    def tokenize(texts):
+        if isinstance(texts, dict):
+            return np.asarray(texts["input_ids"]), np.asarray(texts["attention_mask"])
+        enc = tokenizer(list(texts), padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        return np.asarray(enc["input_ids"]), np.asarray(enc["attention_mask"])
+
+    preds_ids, preds_mask = tokenize(preds)
+    target_ids, target_mask = tokenize(target)
+
+    tokens_idf = _get_tokens_idf(target_ids, target_mask) if idf else None
+    preds_emb, preds_scale = _embed(preds_ids, preds_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size)
+    target_emb, target_scale = _embed(
+        target_ids, target_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size
+    )
+
+    # pad both sides to a common sequence length for one batched einsum
+    max_len = max(preds_emb.shape[1], target_emb.shape[1])
+
+    def pad_to(x, scale):
+        pad = max_len - x.shape[1]
+        if pad:
+            x = np.pad(x, ((0, 0), (0, pad), (0, 0)))
+            scale = np.pad(scale, ((0, 0), (0, pad)))
+        return x, scale
+
+    preds_emb, preds_scale = pad_to(preds_emb, preds_scale)
+    target_emb, target_scale = pad_to(target_emb, target_scale)
+
+    precision, recall, f1 = _get_precision_recall_f1(
+        jnp.asarray(preds_emb), jnp.asarray(target_emb), jnp.asarray(preds_scale), jnp.asarray(target_scale)
+    )
+
+    if rescale_with_baseline and baseline_path is not None:
+        import csv
+
+        with open(baseline_path) as fname:
+            rows = [[float(v) for v in row] for i, row in enumerate(csv.reader(fname)) if i > 0]
+        baseline = np.asarray(rows)[:, 1:]
+        scale = jnp.asarray(baseline[num_layers if num_layers is not None else -1])
+        precision = (precision - scale[0]) / (1 - scale[0])
+        recall = (recall - scale[1]) / (1 - scale[1])
+        f1 = (f1 - scale[2]) / (1 - scale[2])
+
+    output = {"precision": precision, "recall": recall, "f1": f1}
+    if return_hash:
+        output["hash"] = f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+    return output

@@ -1,0 +1,7 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Multimodal module metrics (reference ``src/torchmetrics/multimodal/__init__.py``)."""
+from torchmetrics_tpu.multimodal.clip_iqa import CLIPImageQualityAssessment
+from torchmetrics_tpu.multimodal.clip_score import CLIPScore
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

@@ -1,0 +1,69 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CLIP-IQA module metric (reference ``multimodal/clip_iqa.py:56``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal.clip_iqa import (
+    _clip_iqa_compute,
+    _clip_iqa_format_prompts,
+    _clip_iqa_get_anchor_vectors,
+    _clip_iqa_update,
+)
+from torchmetrics_tpu.functional.multimodal.clip_score import _get_clip_model_and_processor
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA (reference ``multimodal/clip_iqa.py:56-262``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = "openai/clip-vit-base-patch16",
+        data_range: float = 1.0,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        model: Optional[Any] = None,
+        processor: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.prompts_list, self.prompts_names = _clip_iqa_format_prompts(prompts)
+        self.model, self.processor = _get_clip_model_and_processor(model_name_or_path, model, processor)
+        if not (isinstance(data_range, (int, float)) and data_range > 0):
+            raise ValueError("Argument `data_range` should be a positive number.")
+        self.data_range = data_range
+        self._anchors = None  # computed lazily, cached
+        self.add_state("img_features", [], dist_reduce_fx=None)
+
+    @property
+    def anchors(self) -> Array:
+        if self._anchors is None:
+            self._anchors = _clip_iqa_get_anchor_vectors(self.model, self.processor, self.prompts_list)
+        return self._anchors
+
+    def update(self, images: Array) -> None:
+        """Append unit-norm image features (reference ``clip_iqa.py:236-243``)."""
+        images = jnp.asarray(images)
+        if images.ndim != 4 or images.shape[1] != 3:
+            raise ValueError(f"Expected 4d image batch in NCHW format, got shape {images.shape}")
+        self.img_features.append(_clip_iqa_update(images, self.model, self.processor, self.data_range))
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        img_features = dim_zero_cat(self.img_features)
+        return _clip_iqa_compute(img_features, self.anchors, self.prompts_names)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

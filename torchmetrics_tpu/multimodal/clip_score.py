@@ -1,0 +1,57 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""CLIPScore module metric (reference ``multimodal/clip_score.py:43``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal.clip_score import (
+    _clip_score_update,
+    _get_clip_model_and_processor,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CLIPScore(Metric):
+    """CLIPScore (reference ``multimodal/clip_score.py:43-178``).
+
+    ``model``/``processor`` kwargs allow injecting any Flax CLIP-compatible
+    pair (offline checkpoints, custom towers); otherwise
+    ``model_name_or_path`` loads from the HF hub.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = "openai/clip-vit-large-patch14",
+        model: Optional[Any] = None,
+        processor: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model, self.processor = _get_clip_model_and_processor(model_name_or_path, model, processor)
+        self.add_state("score", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
+        """Fold batch similarity sums (reference ``clip_score.py:156-166``)."""
+        score, n_samples = _clip_score_update(images, text, self.model, self.processor)
+        self.score = self.score + score.sum()
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        """Mean score clamped at 0 (reference ``clip_score.py:168-170``)."""
+        return jnp.maximum(self.score / self.n_samples, 0.0)
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)

@@ -1,0 +1,127 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""BERTScore module metric (reference ``text/bert.py:54``)."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.text.bert import _DEFAULT_MODEL, _load_default_model, bert_score
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """BERTScore (reference ``text/bert.py:54-266``).
+
+    States are the tokenized ``input_ids``/``attention_mask`` streams
+    (``dist_reduce_fx="cat"``, reference ``bert.py:193-196``); the transformer
+    forward runs once at ``compute``.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path or _DEFAULT_MODEL
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
+        if model is None:
+            self.model, self.tokenizer = _load_default_model(self.model_name_or_path)
+        else:
+            self.model = model
+            self.tokenizer = user_tokenizer
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        """Tokenize and store (reference ``bert.py:222-244``)."""
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sententes must be the same!")
+        enc_p = self.tokenizer(
+            list(preds), padding="max_length", truncation=True, max_length=self.max_length, return_tensors="np"
+        )
+        enc_t = self.tokenizer(
+            list(target), padding="max_length", truncation=True, max_length=self.max_length, return_tensors="np"
+        )
+        self.preds_input_ids.append(jnp.asarray(enc_p["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(enc_p["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(enc_t["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(enc_t["attention_mask"]))
+
+    def compute(self) -> Dict[str, Array]:
+        """Run the transformer over the stored stream (reference ``bert.py:246-266``)."""
+        preds = {
+            "input_ids": np.concatenate([np.asarray(x) for x in self.preds_input_ids]),
+            "attention_mask": np.concatenate([np.asarray(x) for x in self.preds_attention_mask]),
+        }
+        target = {
+            "input_ids": np.concatenate([np.asarray(x) for x in self.target_input_ids]),
+            "attention_mask": np.concatenate([np.asarray(x) for x in self.target_attention_mask]),
+        }
+        return bert_score(
+            preds,
+            target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_tokenizer=self.tokenizer,
+            user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
+        )
+
+    def plot(self, val=None, ax=None):
+        return self._plot(val, ax)
