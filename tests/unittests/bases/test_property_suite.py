@@ -1,0 +1,104 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Shared property suite over representative metrics from every domain
+(the uniform ``MetricTester`` pass of the reference test strategy,
+``tests/unittests/_helpers/testers.py:84-249``)."""
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional.audio as FA
+from torchmetrics_tpu.classification.accuracy import MulticlassAccuracy
+from torchmetrics_tpu.classification.auroc import BinaryAUROC
+from torchmetrics_tpu.classification.confusion_matrix import MulticlassConfusionMatrix
+
+from tests.unittests._helpers.tester import MetricPropertyTester
+
+_RNG = np.random.RandomState(77)
+N = 32  # per batch; divisible by the 8-device mesh
+BATCHES = 3
+
+
+def _cls_batches(classes=5):
+    return [
+        (_RNG.randint(0, classes, N), _RNG.randint(0, classes, N))
+        for _ in range(BATCHES)
+    ]
+
+
+def _prob_batches():
+    return [(_RNG.rand(N).astype(np.float32), _RNG.randint(0, 2, N)) for _ in range(BATCHES)]
+
+
+def _reg_batches():
+    return [
+        (_RNG.randn(N).astype(np.float32), _RNG.randn(N).astype(np.float32))
+        for _ in range(BATCHES)
+    ]
+
+
+def _img_batches(c=1, h=16, w=16):
+    return [
+        (_RNG.rand(8, c, h, w).astype(np.float32), _RNG.rand(8, c, h, w).astype(np.float32))
+        for _ in range(BATCHES)
+    ]
+
+
+_SUITE = [
+    # (id, metric_class, args, batches, test_sharded)
+    ("multiclass_accuracy", MulticlassAccuracy, {"num_classes": 5}, _cls_batches(), True),
+    ("multiclass_confmat", MulticlassConfusionMatrix, {"num_classes": 5}, _cls_batches(), True),
+    ("binary_auroc_binned", BinaryAUROC, {"thresholds": 11}, _prob_batches(), True),
+    ("mse", tm.MeanSquaredError, {}, _reg_batches(), True),
+    ("pearson", tm.PearsonCorrCoef, {}, _reg_batches(), False),
+    ("r2", tm.R2Score, {}, _reg_batches(), False),
+    ("mean_metric", tm.MeanMetric, {}, [(_RNG.randn(N).astype(np.float32),) for _ in range(BATCHES)], False),
+    ("max_metric", tm.MaxMetric, {}, [(_RNG.randn(N).astype(np.float32),) for _ in range(BATCHES)], True),
+    ("psnr", tm.PeakSignalNoiseRatio, {"data_range": 1.0}, _img_batches(), True),
+    ("ssim", tm.StructuralSimilarityIndexMeasure, {"data_range": 1.0, "kernel_size": 5, "sigma": 0.8}, _img_batches(), False),
+    ("total_variation", tm.TotalVariation, {}, [(_RNG.rand(8, 2, 8, 8).astype(np.float32),) for _ in range(BATCHES)], False),
+    ("uqi", tm.UniversalImageQualityIndex, {}, _img_batches(), False),
+    ("snr", tm.SignalNoiseRatio, {}, [
+        (_RNG.randn(8, 128).astype(np.float32), _RNG.randn(8, 128).astype(np.float32)) for _ in range(BATCHES)
+    ], True),
+    ("si_sdr", tm.ScaleInvariantSignalDistortionRatio, {}, [
+        (_RNG.randn(8, 128).astype(np.float32), _RNG.randn(8, 128).astype(np.float32)) for _ in range(BATCHES)
+    ], True),
+    ("mean_iou", tm.MeanIoU, {"num_classes": 3, "input_format": "index"}, [
+        (_RNG.randint(0, 3, (8, 8, 8)), _RNG.randint(0, 3, (8, 8, 8))) for _ in range(BATCHES)
+    ], False),
+    ("mutual_info", tm.MutualInfoScore, {}, _cls_batches(4), False),
+    ("cramers_v", tm.CramersV, {"num_classes": 4}, _cls_batches(4), False),
+    ("wer", tm.WordErrorRate, {}, [
+        (["the cat sat here", "hello world"], ["the cat sat", "hello there world"]) for _ in range(BATCHES)
+    ], False),
+    ("bleu", tm.BLEUScore, {}, [
+        (["the cat is on the mat"], [["the cat sat on the mat", "a cat on the mat"]]) for _ in range(BATCHES)
+    ], False),
+    ("perplexity", tm.Perplexity, {}, [
+        (_RNG.randn(8, 6, 5).astype(np.float32), _RNG.randint(0, 5, (8, 6))) for _ in range(BATCHES)
+    ], False),
+    ("panoptic_quality", tm.PanopticQuality, {"things": {0, 1}, "stuffs": {2}, "allow_unknown_preds_category": True}, [
+        (_RNG.randint(0, 3, (2, 8, 8, 2)), _RNG.randint(0, 3, (2, 8, 8, 2))) for _ in range(BATCHES)
+    ], False),
+    ("retrieval_map", tm.RetrievalMAP, {}, [
+        (
+            _RNG.rand(N).astype(np.float32),
+            _RNG.randint(0, 2, N),
+            np.repeat(np.arange(4), 8),
+        )
+        for _ in range(BATCHES)
+    ], False),
+]
+
+
+@pytest.mark.parametrize("name,metric_class,args,batches,sharded", _SUITE, ids=[s[0] for s in _SUITE])
+def test_metric_property_suite(name, metric_class, args, batches, sharded):
+    MetricPropertyTester.run(
+        metric_class,
+        args,
+        batches,
+        test_sharded=sharded,
+        rtol=1e-4,
+        atol=1e-5,
+    )
