@@ -1,0 +1,143 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Extra benchmark workloads used by ``bench.py``: SSIM, retrieval NDCG, COCO mAP.
+
+Each returns (ours_throughput, baseline_throughput_or_None, unit). Baselines
+run the reference TorchMetrics on torch — the CPU build shipped in this image
+(labelled as such in the output; swap in CUDA numbers by re-running the same
+functions on a GPU host)."""
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+SSIM_BATCH = 16
+SSIM_SHAPE = (3, 192, 192)
+NDCG_QUERIES = 4096
+NDCG_DOCS = 64
+MAP_IMAGES = 64
+MAP_DETS = 64
+MAP_GTS = 32
+
+
+def bench_ssim(n_batches: int) -> Tuple[float, Optional[float], str]:
+    """Images/sec of streaming SSIM accumulation."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.image.ssim import _ssim_update
+
+    # stream the batches inside ONE compiled program (lax.scan): measures
+    # device throughput of the accumulation loop, not host dispatch latency
+    @jax.jit
+    def run(preds_stream, target_stream):
+        def step(total, batch):
+            p, t = batch
+            return total + _ssim_update(p, t, data_range=1.0).sum(), None
+
+        total, _ = jax.lax.scan(step, jnp.asarray(0.0), (preds_stream, target_stream))
+        return total
+
+    key = jax.random.key(0)
+    kp, kt = jax.random.split(key)
+    preds = jax.random.uniform(kp, (n_batches, SSIM_BATCH, *SSIM_SHAPE), jnp.float32)
+    target = jax.random.uniform(kt, (n_batches, SSIM_BATCH, *SSIM_SHAPE), jnp.float32)
+    float(run(preds, target))  # compile + warm
+    t0 = time.perf_counter()
+    float(run(preds, target))  # forced materialization bounds the timing
+    ours = n_batches * SSIM_BATCH / (time.perf_counter() - t0)
+
+    baseline = None
+    try:
+        import torch
+        from torchmetrics.functional.image import structural_similarity_index_measure as ref_ssim
+
+        p = torch.rand(SSIM_BATCH, *SSIM_SHAPE)
+        t = torch.rand(SSIM_BATCH, *SSIM_SHAPE)
+        ref_ssim(p, t, data_range=1.0)
+        t0 = time.perf_counter()
+        iters = max(2, n_batches // 4)
+        for _ in range(iters):
+            ref_ssim(p, t, data_range=1.0)
+        baseline = iters * SSIM_BATCH / (time.perf_counter() - t0)
+    except Exception:
+        pass
+    return ours, baseline, "images/s"
+
+
+def bench_retrieval_ndcg(n_repeats: int) -> Tuple[float, Optional[float], str]:
+    """Queries/sec of corpus NDCG evaluation."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.retrieval import retrieval_normalized_dcg
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random((NDCG_QUERIES, NDCG_DOCS), dtype=np.float32))
+    target = jnp.asarray((rng.random((NDCG_QUERIES, NDCG_DOCS)) < 0.2).astype(np.float32))
+
+    @jax.jit
+    def eval_repeated(p, t):
+        def step(total, offset):
+            # fold the repeat index in so XLA can't hoist the body out
+            return total + jax.vmap(retrieval_normalized_dcg)(p + offset * 0.0, t).mean(), None
+
+        total, _ = jax.lax.scan(step, jnp.asarray(0.0), jnp.arange(n_repeats, dtype=jnp.float32))
+        return total
+
+    float(eval_repeated(preds, target))  # compile + warm
+    t0 = time.perf_counter()
+    float(eval_repeated(preds, target))
+    ours = n_repeats * NDCG_QUERIES / (time.perf_counter() - t0)
+
+    baseline = None
+    try:
+        import torch
+        from torchmetrics.functional.retrieval import retrieval_normalized_dcg as ref_ndcg
+
+        p = torch.rand(NDCG_QUERIES, NDCG_DOCS)
+        t = (torch.rand(NDCG_QUERIES, NDCG_DOCS) < 0.2).long()
+        # the reference evaluates per query in a Python loop (retrieval/base.py)
+        n_q = min(256, NDCG_QUERIES)
+        t0 = time.perf_counter()
+        for i in range(n_q):
+            ref_ndcg(p[i], t[i])
+        baseline = n_q / (time.perf_counter() - t0)
+    except Exception:
+        pass
+    return ours, baseline, "queries/s"
+
+
+def bench_coco_map() -> Tuple[float, Optional[float], str]:
+    """Images/sec of full COCO-style mAP evaluation (vectorized JAX matching).
+
+    The reference backend (pycocotools C/CPU) is not installed in this image,
+    so no live baseline — the number stands alone until measured on a host
+    with pycocotools.
+    """
+    from torchmetrics_tpu.functional.detection.map import coco_mean_average_precision
+
+    rng = np.random.default_rng(0)
+    preds, target = [], []
+    for _ in range(MAP_IMAGES):
+        xy = rng.random((MAP_DETS, 2)) * 400
+        wh = rng.random((MAP_DETS, 2)) * 100 + 2
+        preds.append(
+            {
+                "boxes": np.concatenate([xy, xy + wh], 1),
+                "scores": rng.random(MAP_DETS),
+                "labels": rng.integers(0, 40, MAP_DETS),
+            }
+        )
+        xy = rng.random((MAP_GTS, 2)) * 400
+        wh = rng.random((MAP_GTS, 2)) * 100 + 2
+        target.append(
+            {"boxes": np.concatenate([xy, xy + wh], 1), "labels": rng.integers(0, 40, MAP_GTS)}
+        )
+    coco_mean_average_precision(preds[:4], target[:4])  # compile
+    t0 = time.perf_counter()
+    coco_mean_average_precision(preds, target)
+    ours = MAP_IMAGES / (time.perf_counter() - t0)
+    return ours, None, "images/s"
