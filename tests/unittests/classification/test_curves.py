@@ -115,3 +115,37 @@ def test_ignore_index_auroc():
     t2[:40] = -1
     sk_val = skm.roc_auc_score(T_B[40:], P_B[40:])
     assert abs(float(binary_auroc(P_B, t2, ignore_index=-1)) - sk_val) < 1e-6
+
+
+def test_binary_auroc_exact_device_matches_sklearn_with_ties():
+    # exact (thresholds=None) path runs fully on device via the rank statistic
+    import sklearn.metrics as skm
+
+    from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+    rng = np.random.RandomState(3)
+    preds = np.round(rng.rand(800), 1).astype(np.float32)  # heavy ties
+    target = (rng.rand(800) < preds).astype(np.int32)
+    np.testing.assert_allclose(
+        float(binary_auroc(preds, target)), skm.roc_auc_score(target, preds), rtol=1e-6
+    )
+    # ignore_index excluded
+    t2 = target.copy()
+    t2[:80] = -100
+    np.testing.assert_allclose(
+        float(binary_auroc(preds, t2, ignore_index=-100)),
+        skm.roc_auc_score(target[80:], preds[80:]),
+        rtol=1e-6,
+    )
+
+
+def test_binary_auroc_binned_agrees_with_exact_at_scale():
+    # VERDICT weak-item 6: binned-vs-exact agreement at large N
+    from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+    rng = np.random.RandomState(4)
+    preds = rng.rand(100_000).astype(np.float32)
+    target = (rng.rand(100_000) < preds).astype(np.int32)
+    exact = float(binary_auroc(preds, target))
+    binned = float(binary_auroc(preds, target, thresholds=1000))
+    assert abs(exact - binned) < 1e-4

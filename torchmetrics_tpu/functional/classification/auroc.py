@@ -98,6 +98,38 @@ def _binary_auroc_compute(
     return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
 
 
+def _binary_auroc_exact_device(preds: Array, target: Array) -> Array:
+    """Exact (unbinned) AUROC on device via the rank statistic.
+
+    AUROC equals the Mann-Whitney U statistic ``(Σ ranks⁺ - P(P+1)/2)/(P·N)``
+    with midranks for ties — a sort + two cumsums with static shapes, so the
+    exact mode runs at device speed for any N instead of the host-NumPy
+    unique-threshold path (the curve itself still needs dynamic compaction).
+    Targets masked negative (ignore_index sentinel) are excluded.
+    """
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    valid = target >= 0
+    # push invalid entries to the end of the sort and zero their weight
+    order = jnp.argsort(jnp.where(valid, preds, jnp.inf))
+    p_sorted = preds[order]
+    t_sorted = jnp.where(valid[order], target[order], 0).astype(jnp.float32)
+    w_sorted = valid[order].astype(jnp.float32)
+    n = preds.shape[0]
+    # midranks: for each tie group, the average of its 1-based positions
+    pos = jnp.arange(1, n + 1, dtype=jnp.float32)
+    is_new = jnp.concatenate([jnp.ones(1, bool), p_sorted[1:] != p_sorted[:-1]])
+    group_id = jnp.cumsum(is_new) - 1
+    group_start = jax.ops.segment_max(jnp.where(is_new, pos, 0.0), group_id, num_segments=n)
+    group_end = jax.ops.segment_max(pos, group_id, num_segments=n)
+    midrank = ((group_start + group_end) / 2)[group_id]
+    n_pos = (t_sorted * w_sorted).sum()
+    n_neg = w_sorted.sum() - n_pos
+    rank_sum_pos = (midrank * t_sorted * w_sorted).sum()
+    u_stat = rank_sum_pos - n_pos * (n_pos + 1) / 2
+    return jnp.where((n_pos > 0) & (n_neg > 0), u_stat / jnp.maximum(n_pos * n_neg, 1.0), 0.0)
+
+
 def binary_auroc(
     preds: Array,
     target: Array,
@@ -112,6 +144,9 @@ def binary_auroc(
         _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
     preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and max_fpr is None:
+        # fully on-device exact path (rank statistic) — jit/shard-safe
+        return _binary_auroc_exact_device(preds, target)
     state = _binary_precision_recall_curve_update(preds, target, thresholds)
     return _binary_auroc_compute(state, thresholds, max_fpr)
 
