@@ -173,3 +173,59 @@ def test_map_input_validation_errors():
         MeanAveragePrecision(box_format="bad")
     with pytest.raises(ValueError, match="max detection"):
         MeanAveragePrecision(max_detection_thresholds=[1, 10])
+
+
+def _boxes_to_masks(boxes, h=120, w=120):
+    masks = np.zeros((len(boxes), h, w), np.uint8)
+    for i, (x1, y1, x2, y2) in enumerate(np.asarray(boxes, int)):
+        masks[i, max(y1, 0) : max(y2, 0), max(x1, 0) : max(x2, 0)] = 1
+    return masks
+
+
+def test_segm_map_matches_bbox_map_on_rectangular_masks():
+    # for axis-aligned rectangular masks, mask IoU == box IoU, so the segm
+    # evaluation (native RLE codec path) must reproduce the bbox result
+    rng = np.random.RandomState(5)
+    preds_b, target_b, preds_m, target_m = [], [], [], []
+    for _ in range(4):
+        n_gt, n_dt = rng.randint(1, 6), rng.randint(1, 8)
+        gt_xy = rng.randint(0, 60, (n_gt, 2))
+        gt_wh = rng.randint(5, 50, (n_gt, 2))
+        gt_boxes = np.concatenate([gt_xy, gt_xy + gt_wh], 1).astype(np.float64)
+        dt_xy = rng.randint(0, 60, (n_dt, 2))
+        dt_wh = rng.randint(5, 50, (n_dt, 2))
+        dt_boxes = np.concatenate([dt_xy, dt_xy + dt_wh], 1).astype(np.float64)
+        for j in range(min(n_dt, n_gt)):
+            if rng.rand() < 0.6:
+                dt_boxes[j] = gt_boxes[j] + rng.randint(-4, 5, 4)
+                dt_boxes[j, 2:] = np.maximum(dt_boxes[j, 2:], dt_boxes[j, :2] + 1)
+        dt_boxes = np.clip(dt_boxes, 0, 119)
+        gt_boxes = np.clip(gt_boxes, 0, 119)
+        scores = np.round(rng.rand(n_dt), 3)
+        dt_labels = rng.randint(0, 3, n_dt)
+        gt_labels = rng.randint(0, 3, n_gt)
+        crowd = (rng.rand(n_gt) < 0.2).astype(np.int64)
+        preds_b.append({"boxes": dt_boxes, "scores": scores, "labels": dt_labels})
+        target_b.append({"boxes": gt_boxes, "labels": gt_labels, "iscrowd": crowd})
+        preds_m.append({"masks": _boxes_to_masks(dt_boxes), "scores": scores, "labels": dt_labels})
+        target_m.append({"masks": _boxes_to_masks(gt_boxes), "labels": gt_labels, "iscrowd": crowd})
+    res_bbox = coco_mean_average_precision(preds_b, target_b)
+    res_segm = coco_mean_average_precision(preds_m, target_m, iou_type="segm")
+    for key in ("map", "map_50", "map_75", "mar_100"):
+        np.testing.assert_allclose(float(res_segm[key]), float(res_bbox[key]), atol=1e-6, err_msg=key)
+
+
+def test_segm_map_module_streaming():
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    boxes = np.array([[10, 10, 50, 50], [60, 60, 110, 110]], np.float64)
+    labels = np.array([0, 1])
+    masks = _boxes_to_masks(boxes)
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(
+        [{"masks": masks, "scores": np.array([0.9, 0.8]), "labels": labels}],
+        [{"masks": masks, "labels": labels}],
+    )
+    res = metric.compute()
+    np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)

@@ -31,13 +31,17 @@ class MeanAveragePrecision(Metric):
     """COCO-style mean average precision / recall for object detection.
 
     API-compatible with reference ``detection/mean_ap.py:372-475``: per-image
-    dict inputs (``boxes``/``scores``/``labels``; targets may add
-    ``iscrowd``/``area``), result keys ``map``, ``map_50``, ``map_75``,
-    ``map_small/medium/large``, ``mar_{k}``, ``mar_small/medium/large``,
-    ``map_per_class``, ``mar_{k}_per_class``, ``classes``.
+    dict inputs (``boxes``/``scores``/``labels`` for ``iou_type="bbox"``,
+    ``masks`` for ``"segm"``; targets may add ``iscrowd``/``area``), result
+    keys ``map``, ``map_50``, ``map_75``, ``map_small/medium/large``,
+    ``mar_{k}``, ``mar_small/medium/large``, ``map_per_class``,
+    ``mar_{k}_per_class``, ``classes``.
 
-    Only ``iou_type="bbox"`` is supported (``"segm"`` requires the RLE mask
-    codec, tracked separately).
+    ``iou_type="segm"`` encodes masks through the native C++ RLE codec
+    (:mod:`torchmetrics_tpu.native`) at update time — the pycocotools-C
+    replacement of SURVEY §2.6 — and runs the same device matching kernel on
+    the RLE IoU matrices. Mixed ``("bbox", "segm")`` tuples are not supported;
+    evaluate with two metric instances.
     """
 
     is_differentiable: bool = False
@@ -65,9 +69,10 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
         self.iou_type = _validate_iou_type_arg(iou_type)
-        if any(tp == "segm" for tp in self.iou_type):
-            raise NotImplementedError(
-                "iou_type='segm' requires the RLE mask codec which is not yet available; use iou_type='bbox'."
+        if len(self.iou_type) != 1:
+            raise ValueError(
+                "This implementation evaluates one iou_type per instance; create two instances for"
+                " ('bbox', 'segm')."
             )
         if iou_thresholds is not None and not isinstance(iou_thresholds, list):
             raise ValueError(
@@ -102,6 +107,8 @@ class MeanAveragePrecision(Metric):
         self.backend = backend
 
         self.add_state("detection_box", default=[], dist_reduce_fx=None)
+        self.add_state("detection_mask", default=[], dist_reduce_fx=None)
+        self.add_state("groundtruth_mask", default=[], dist_reduce_fx=None)
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
         self.add_state("detection_labels", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_box", default=[], dist_reduce_fx=None)
@@ -109,16 +116,35 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
 
+    @property
+    def _is_segm(self) -> bool:
+        return self.iou_type[0] == "segm"
+
     def update(self, preds: Sequence[Dict[str, Any]], target: Sequence[Dict[str, Any]]) -> None:
-        """Append per-image detections/ground truths (reference ``mean_ap.py:477-519``)."""
+        """Append per-image detections/ground truths (reference ``mean_ap.py:477-519``).
+
+        For ``segm``, masks are RLE-encoded immediately through the native
+        codec (reference ``mean_ap.py:824-857`` does the same via pycocotools)
+        so the stored state is compact run-length bytes, not dense masks.
+        """
         _input_validator(preds, target, iou_type=self.iou_type)
+        segm = self._is_segm
+        if segm:
+            from torchmetrics_tpu.functional.detection import mask_utils
+
         for item in preds:
-            self.detection_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
+            if segm:
+                self.detection_mask.append([mask_utils.encode(np.asarray(m)) for m in np.asarray(item["masks"])])
+            else:
+                self.detection_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
             self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32).reshape(-1))
             self.detection_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
         for item in target:
             n = np.asarray(item["labels"]).size
-            self.groundtruth_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
+            if segm:
+                self.groundtruth_mask.append([mask_utils.encode(np.asarray(m)) for m in np.asarray(item["masks"])])
+            else:
+                self.groundtruth_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
             self.groundtruth_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
             crowds = item.get("iscrowd")
             self.groundtruth_crowds.append(
@@ -131,15 +157,17 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> Dict[str, Array]:
         """Run the pure-JAX COCO evaluation over the accumulated stream."""
+        segm = self._is_segm
+        geom_key = "masks" if segm else "boxes"
+        det_geom = self.detection_mask if segm else self.detection_box
+        gt_geom = self.groundtruth_mask if segm else self.groundtruth_box
         preds = [
-            {"boxes": b, "scores": s, "labels": l}
-            for b, s, l in zip(self.detection_box, self.detection_scores, self.detection_labels)
+            {geom_key: g, "scores": s, "labels": l}
+            for g, s, l in zip(det_geom, self.detection_scores, self.detection_labels)
         ]
         target = [
-            {"boxes": b, "labels": l, "iscrowd": c, "area": (a if np.asarray(a).size else None)}
-            for b, l, c, a in zip(
-                self.groundtruth_box, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area
-            )
+            {geom_key: g, "labels": l, "iscrowd": c, "area": (a if np.asarray(a).size else None)}
+            for g, l, c, a in zip(gt_geom, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area)
         ]
         return coco_mean_average_precision(
             preds,
@@ -151,6 +179,7 @@ class MeanAveragePrecision(Metric):
             class_metrics=self.class_metrics,
             extended_summary=self.extended_summary,
             average=self.average,
+            iou_type=self.iou_type[0],
         )
 
     def plot(self, val=None, ax=None):

@@ -82,10 +82,10 @@ def _crowd_box_iou(det: Array, gt: Array, crowd: Array) -> Array:
 
 
 def _match_one_image(
-    det_boxes: Array,  # (D, 4) xyxy, score-sorted desc
+    iou: Array,  # (D, G) pairwise IoU (crowd-aware), any iou_type
+    det_area: Array,  # (D,)
     det_labels: Array,  # (D,)
     det_valid: Array,  # (D,)
-    gt_boxes: Array,  # (G, 4)
     gt_labels: Array,  # (G,)
     gt_valid: Array,  # (G,)
     gt_crowd: Array,  # (G,)
@@ -95,14 +95,15 @@ def _match_one_image(
 ) -> Tuple[Array, Array, Array]:
     """Greedy COCO matching for one image, all thresholds/areas at once.
 
-    Returns ``det_matched (A,T,D)``, ``det_ignored (A,T,D)``,
-    ``gt_ignored (A,G)`` (pycocotools ``evaluateImg`` semantics).
+    IoU-type agnostic: the pairwise IoU matrix and per-detection areas come
+    precomputed (boxes on device, masks via the native RLE codec). Returns
+    ``det_matched (A,T,D)``, ``det_ignored (A,T,D)``, ``gt_ignored (A,G)``
+    (pycocotools ``evaluateImg`` semantics).
     """
     num_t = iou_thrs.shape[0]
     num_a = area_rngs.shape[0]
-    num_g = gt_boxes.shape[0]
+    num_g = gt_labels.shape[0]
 
-    iou = _crowd_box_iou(det_boxes, gt_boxes, gt_crowd)  # (D, G)
     pair_ok = det_valid[:, None] & gt_valid[None, :] & (det_labels[:, None] == gt_labels[None, :])
 
     # per-area ignore: crowd or area outside range (pycocotools gt['_ignore'])
@@ -139,13 +140,20 @@ def _match_one_image(
     det_ig = jnp.moveaxis(det_ig, 0, -1)
 
     # unmatched detections outside the area range are ignored too
-    det_area = box_area(det_boxes)
     det_out = (det_area[None, :] < area_rngs[:, 0:1]) | (det_area[None, :] > area_rngs[:, 1:2])  # (A, D)
     det_ig = det_ig | (~det_matched & det_out[:, None, :])
     return det_matched, det_ig, gt_ig
 
 
 _match_images = jax.jit(jax.vmap(_match_one_image, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None)))
+
+
+@jax.jit
+def _bbox_iou_and_area(det_boxes: Array, gt_boxes: Array, gt_crowd: Array) -> Tuple[Array, Array]:
+    """Batched (N, D, G) box IoU with crowd columns + (N, D) det areas."""
+    iou = jax.vmap(_crowd_box_iou)(det_boxes, gt_boxes, gt_crowd)
+    det_area = jax.vmap(box_area)(det_boxes)
+    return iou, det_area
 
 
 class COCOEvaluationResult(dict):
@@ -176,13 +184,19 @@ def coco_mean_average_precision(
     class_metrics: bool = False,
     extended_summary: bool = False,
     average: str = "macro",
+    iou_type: str = "bbox",
 ) -> Dict[str, Any]:
     """Full COCO-style evaluation over a dataset of per-image dicts.
 
-    Matches pycocotools ``COCOeval(iouType='bbox')`` output (reference
-    ``mean_ap.py:520-647``). ``preds[i]``: ``boxes``/``scores``/``labels``;
-    ``target[i]``: ``boxes``/``labels`` and optional ``iscrowd``/``area``.
+    Matches pycocotools ``COCOeval`` output (reference ``mean_ap.py:520-647``).
+    ``preds[i]``: ``scores``/``labels`` plus ``boxes`` (``iou_type="bbox"``) or
+    ``masks`` (``iou_type="segm"``: ``(n, H, W)`` binary arrays or RLE dicts);
+    ``target[i]``: same geometry key, ``labels``, optional ``iscrowd``/``area``.
+    Mask IoU/areas run through the native C++ RLE codec
+    (:mod:`torchmetrics_tpu.functional.detection.mask_utils`).
     """
+    if iou_type not in ("bbox", "segm"):
+        raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {iou_type}")
     iou_thrs = np.asarray(iou_thresholds if iou_thresholds is not None else DEFAULT_IOU_THRESHOLDS, np.float64)
     rec_thrs = np.asarray(rec_thresholds if rec_thresholds is not None else DEFAULT_REC_THRESHOLDS, np.float64)
     max_dets = sorted(max_detection_thresholds if max_detection_thresholds is not None else DEFAULT_MAX_DETECTIONS)
@@ -190,32 +204,56 @@ def coco_mean_average_precision(
     n_imgs = len(preds)
     maxdet_last = max_dets[-1]
 
-    det_boxes_l, det_scores_l, det_labels_l = [], [], []
-    gt_boxes_l, gt_labels_l, gt_crowd_l, gt_area_l = [], [], [], []
+    if iou_type == "segm":
+        from torchmetrics_tpu.functional.detection import mask_utils
+
+        def _to_rles(items):
+            masks = items.get("masks", [])
+            if isinstance(masks, dict):
+                masks = [masks]
+            rles = []
+            for m in masks:
+                rles.append(m if isinstance(m, dict) else mask_utils.encode(np.asarray(m)))
+            return rles
+
+    det_boxes_l, det_scores_l, det_labels_l, det_rles_l, det_marea_l = [], [], [], [], []
+    gt_boxes_l, gt_labels_l, gt_crowd_l, gt_area_l, gt_rles_l = [], [], [], [], []
     for p, t in zip(preds, target):
-        boxes = np.asarray(p["boxes"], np.float64).reshape(-1, 4)
         scores = np.asarray(p["scores"], np.float64).reshape(-1)
         labels = np.asarray(p["labels"]).reshape(-1)
         order = np.argsort(-scores, kind="mergesort")[:maxdet_last]
-        boxes, scores, labels = boxes[order], scores[order], labels[order]
-        if box_format != "xyxy":
-            boxes = np.asarray(box_convert(boxes, box_format, "xyxy")) if boxes.size else boxes
-        det_boxes_l.append(boxes)
+        scores, labels = scores[order], labels[order]
+        if iou_type == "bbox":
+            boxes = np.asarray(p["boxes"], np.float64).reshape(-1, 4)[order]
+            if box_format != "xyxy":
+                boxes = np.asarray(box_convert(boxes, box_format, "xyxy")) if boxes.size else boxes
+            det_boxes_l.append(boxes)
+        else:
+            rles = _to_rles(p)
+            rles = [rles[i] for i in order]
+            det_rles_l.append(rles)
+            det_marea_l.append(np.asarray(mask_utils.area(rles)).reshape(-1) if rles else np.zeros(0))
         det_scores_l.append(scores)
         det_labels_l.append(labels)
 
-        gboxes = np.asarray(t["boxes"], np.float64).reshape(-1, 4)
-        if box_format != "xyxy":
-            gboxes = np.asarray(box_convert(gboxes, box_format, "xyxy")) if gboxes.size else gboxes
         glabels = np.asarray(t["labels"]).reshape(-1)
         crowd = np.asarray(t.get("iscrowd", np.zeros(len(glabels)))).reshape(-1).astype(bool)
         area = t.get("area")
+        if iou_type == "bbox":
+            gboxes = np.asarray(t["boxes"], np.float64).reshape(-1, 4)
+            if box_format != "xyxy":
+                gboxes = np.asarray(box_convert(gboxes, box_format, "xyxy")) if gboxes.size else gboxes
+            gt_boxes_l.append(gboxes)
+            default_area = (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+        else:
+            grles = _to_rles(t)
+            gt_rles_l.append(grles)
+            default_area = np.asarray(mask_utils.area(grles)).reshape(-1) if grles else np.zeros(0)
         area = (
             np.asarray(area, np.float64).reshape(-1)
             if area is not None and np.asarray(area).size
-            else (gboxes[:, 2] - gboxes[:, 0]) * (gboxes[:, 3] - gboxes[:, 1])
+            else default_area
         )
-        gt_boxes_l.append(gboxes)
         gt_labels_l.append(glabels)
         gt_crowd_l.append(crowd)
         gt_area_l.append(area)
@@ -237,24 +275,43 @@ def coco_mean_average_precision(
     if n_imgs and num_k:
         pad_d = _round_up(max(1, max(len(s) for s in det_scores_l)))
         pad_g = _round_up(max(1, max(len(x) for x in gt_labels_l)))
-        det_boxes, det_valid = _pack_ragged(det_boxes_l, pad_d, 4)
-        det_scores, _ = _pack_ragged(det_scores_l, pad_d)
+        det_scores, det_valid = _pack_ragged(det_scores_l, pad_d)
         det_labels, _ = _pack_ragged(det_labels_l, pad_d, dtype=np.int64)
-        gt_boxes, gt_valid = _pack_ragged(gt_boxes_l, pad_g, 4)
-        gt_labels, _ = _pack_ragged(gt_labels_l, pad_g, dtype=np.int64)
+        gt_labels, gt_valid = _pack_ragged(gt_labels_l, pad_g, dtype=np.int64)
         gt_crowd, _ = _pack_ragged(gt_crowd_l, pad_g, dtype=bool)
         gt_area, _ = _pack_ragged(gt_area_l, pad_g)
         # pad labels with a sentinel no real class uses so padded rows never match
         det_labels = np.where(det_valid, det_labels, -1)
         gt_labels_pad = np.where(gt_valid, gt_labels, -2)
 
+        if iou_type == "bbox":
+            det_boxes, _ = _pack_ragged(det_boxes_l, pad_d, 4)
+            gt_boxes, _ = _pack_ragged(gt_boxes_l, pad_g, 4)
+            iou_all, det_area = _bbox_iou_and_area(
+                jnp.asarray(det_boxes), jnp.asarray(gt_boxes), jnp.asarray(gt_crowd)
+            )
+        else:
+            # per-image crowd-aware mask IoU via the native RLE codec (host)
+            iou_np = np.zeros((n_imgs, pad_d, pad_g), np.float32)
+            from torchmetrics_tpu.functional.detection import mask_utils
+
+            for i in range(n_imgs):
+                d_rles, g_rles = det_rles_l[i], gt_rles_l[i]
+                if d_rles and g_rles:
+                    iou_np[i, : len(d_rles), : len(g_rles)] = mask_utils.iou(
+                        d_rles, g_rles, iscrowd=gt_crowd_l[i].astype(np.uint8)
+                    )
+            iou_all = jnp.asarray(iou_np)
+            det_area_np, _ = _pack_ragged(det_marea_l, pad_d)
+            det_area = jnp.asarray(det_area_np)
+
         det_matched, det_ignored, gt_ignored = (
             np.asarray(x)
             for x in _match_images(
-                jnp.asarray(det_boxes),
+                iou_all,
+                det_area,
                 jnp.asarray(det_labels),
                 jnp.asarray(det_valid),
-                jnp.asarray(gt_boxes),
                 jnp.asarray(gt_labels_pad),
                 jnp.asarray(gt_valid),
                 jnp.asarray(gt_crowd),

@@ -1,0 +1,125 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""COCO RLE mask utilities over the native codec.
+
+Python API mirroring the pycocotools ``mask`` module the reference calls
+(``detection/mean_ap.py:824-857``, SURVEY §2.6): ``encode``/``decode``/
+``area``/``iou`` on dicts ``{"size": [h, w], "counts": np.uint32 runs}``.
+Runs through the C++ codec when available, else vectorized numpy.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from torchmetrics_tpu.native import get_rle_library
+
+RLE = Dict[str, object]
+
+
+def _encode_numpy(flat: np.ndarray) -> np.ndarray:
+    """Run lengths of a flat binary array, zeros first (vectorized numpy)."""
+    flat = flat.astype(bool)
+    change = np.nonzero(np.diff(flat))[0] + 1
+    boundaries = np.concatenate([[0], change, [flat.size]])
+    runs = np.diff(boundaries)
+    if flat.size and flat[0]:
+        runs = np.concatenate([[0], runs])
+    return runs.astype(np.uint32)
+
+
+def encode(mask: np.ndarray) -> RLE:
+    """Encode an ``(H, W)`` binary mask (column-major runs, COCO convention)."""
+    mask = np.asarray(mask)
+    if mask.ndim != 2:
+        raise ValueError(f"Expected a single (H, W) mask, got shape {mask.shape}")
+    h, w = mask.shape
+    flat = np.asfortranarray(mask.astype(np.uint8)).flatten(order="F")
+    lib = get_rle_library()
+    if lib is not None:
+        buf = np.zeros(flat.size + 1, np.uint32)
+        n = lib.rle_encode(
+            flat.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint64(flat.size), buf.ctypes.data_as(ctypes.c_void_p)
+        )
+        counts = buf[:n].copy()
+    else:
+        counts = _encode_numpy(flat)
+    return {"size": [h, w], "counts": counts}
+
+
+def decode(rle: RLE) -> np.ndarray:
+    """Decode an RLE back into an ``(H, W)`` uint8 mask."""
+    h, w = rle["size"]
+    counts = np.asarray(rle["counts"], np.uint32)
+    size = int(h) * int(w)
+    lib = get_rle_library()
+    if lib is not None:
+        out = np.zeros(size, np.uint8)
+        lib.rle_decode(
+            counts.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(counts.size),
+            out.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(size),
+        )
+    else:
+        out = np.repeat(np.arange(counts.size) % 2, counts).astype(np.uint8)
+        out = np.pad(out, (0, size - out.size)) if out.size < size else out[:size]
+    return out.reshape((h, w), order="F")
+
+
+def area(rles: Union[RLE, Sequence[RLE]]) -> np.ndarray:
+    """Foreground areas of one or many RLEs."""
+    single = isinstance(rles, dict)
+    rle_list: List[RLE] = [rles] if single else list(rles)
+    lib = get_rle_library()
+    out = np.zeros(len(rle_list), np.float64)
+    for i, r in enumerate(rle_list):
+        counts = np.asarray(r["counts"], np.uint32)
+        if lib is not None:
+            out[i] = lib.rle_area(counts.ctypes.data_as(ctypes.c_void_p), ctypes.c_uint64(counts.size))
+        else:
+            out[i] = counts[1::2].sum()
+    return out[0] if single else out
+
+
+def iou(dt: Sequence[RLE], gt: Sequence[RLE], iscrowd: Optional[Sequence[int]] = None) -> np.ndarray:
+    """Crowd-aware IoU matrix ``(len(dt), len(gt))`` between RLE sets."""
+    dt, gt = list(dt), list(gt)
+    n_dt, n_gt = len(dt), len(gt)
+    crowd = np.asarray(iscrowd if iscrowd is not None else np.zeros(n_gt), np.uint8)
+    if crowd.size != n_gt:
+        raise ValueError(f"iscrowd must have one entry per gt, got {crowd.size} for {n_gt}")
+    out = np.zeros((n_dt, n_gt), np.float64)
+    if n_dt == 0 or n_gt == 0:
+        return out
+    lib = get_rle_library()
+    if lib is not None:
+        dt_runs = np.concatenate([np.asarray(r["counts"], np.uint32) for r in dt])
+        dt_lengths = np.asarray([len(r["counts"]) for r in dt], np.uint64)
+        dt_offsets = np.concatenate([[0], np.cumsum(dt_lengths)[:-1]]).astype(np.uint64)
+        gt_runs = np.concatenate([np.asarray(r["counts"], np.uint32) for r in gt])
+        gt_lengths = np.asarray([len(r["counts"]) for r in gt], np.uint64)
+        gt_offsets = np.concatenate([[0], np.cumsum(gt_lengths)[:-1]]).astype(np.uint64)
+        lib.rle_iou_matrix(
+            dt_runs.ctypes.data_as(ctypes.c_void_p),
+            dt_offsets.ctypes.data_as(ctypes.c_void_p),
+            dt_lengths.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(n_dt),
+            gt_runs.ctypes.data_as(ctypes.c_void_p),
+            gt_offsets.ctypes.data_as(ctypes.c_void_p),
+            gt_lengths.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(n_gt),
+            crowd.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+    # numpy fallback: decode and compare densely
+    dt_masks = np.stack([decode(r).ravel() for r in dt]).astype(bool)
+    gt_masks = np.stack([decode(r).ravel() for r in gt]).astype(bool)
+    inter = dt_masks.astype(np.float64) @ gt_masks.T.astype(np.float64)
+    area_d = dt_masks.sum(1)[:, None].astype(np.float64)
+    area_g = gt_masks.sum(1)[None, :].astype(np.float64)
+    union = np.where(crowd[None, :].astype(bool), area_d, area_d + area_g - inter)
+    return np.where(union > 0, inter / np.maximum(union, 1), 0.0)

@@ -1,0 +1,65 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Native (C++) host extensions.
+
+Currently: the COCO RLE mask codec (``rle_codec.cpp``), compiled on first use
+with the system ``g++`` into a cached shared object and bound via ``ctypes``.
+A pure-numpy fallback keeps everything working where no compiler exists.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_SRC = Path(__file__).parent / "rle_codec.cpp"
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build_library() -> Optional[ctypes.CDLL]:
+    """Compile the codec with g++ (cached by source hash)."""
+    src_hash = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+    cache_dir = Path(os.environ.get("TM_TPU_NATIVE_CACHE", Path(tempfile.gettempdir()) / "tm_tpu_native"))
+    cache_dir.mkdir(parents=True, exist_ok=True)
+    so_path = cache_dir / f"rle_codec_{src_hash}.so"
+    if not so_path.exists():
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(so_path)]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError):
+            return None
+    try:
+        lib = ctypes.CDLL(str(so_path))
+    except OSError:
+        return None
+    lib.rle_encode.restype = ctypes.c_uint64
+    lib.rle_encode.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
+    lib.rle_decode.restype = None
+    lib.rle_decode.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
+    lib.rle_area.restype = ctypes.c_uint64
+    lib.rle_area.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.rle_iou_pair.restype = ctypes.c_double
+    lib.rle_iou_pair.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.rle_iou_matrix.restype = None
+    lib.rle_iou_matrix.argtypes = [ctypes.c_void_p] * 3 + [ctypes.c_uint64] + [ctypes.c_void_p] * 3 + [ctypes.c_uint64] + [ctypes.c_void_p] * 2
+    return lib
+
+
+def get_rle_library() -> Optional[ctypes.CDLL]:
+    """The compiled codec, or ``None`` if compilation isn't possible."""
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib = _build_library()
+        _lib_tried = True
+    return _lib
+
+
+def native_available() -> bool:
+    return get_rle_library() is not None

@@ -1,0 +1,104 @@
+// Copyright The TorchMetrics-TPU contributors.
+// Licensed under the Apache License, Version 2.0.
+//
+// COCO run-length-encoding mask codec.
+//
+// Native replacement for the pycocotools C extension (`mask.pyx` /
+// `maskApi.c`) that the reference delegates RLE work to
+// (reference detection/mean_ap.py:824-857): encode/decode of Fortran-order
+// binary masks, run areas, and crowd-aware IoU between RLE pairs. RLE is
+// byte-string/run work — branchy, sequential, host-native — which is why it
+// lives in C++ rather than XLA (SURVEY.md §2.6).
+//
+// Format: counts[] holds alternating run lengths over the column-major
+// flattened mask, starting with the number of leading zeros.
+
+#include <cstdint>
+#include <cstddef>
+#include <algorithm>
+
+extern "C" {
+
+// Encode a column-major binary mask into run lengths.
+// counts_out must have room for size+1 entries; returns the run count.
+uint64_t rle_encode(const uint8_t* mask, uint64_t size, uint32_t* counts_out) {
+    uint64_t n = 0;
+    uint8_t current = 0;  // runs start with zeros
+    uint64_t run = 0;
+    for (uint64_t i = 0; i < size; ++i) {
+        uint8_t v = mask[i] ? 1 : 0;
+        if (v != current) {
+            counts_out[n++] = static_cast<uint32_t>(run);
+            run = 0;
+            current = v;
+        }
+        ++run;
+    }
+    counts_out[n++] = static_cast<uint32_t>(run);
+    return n;
+}
+
+// Decode run lengths back into a column-major binary mask of `size` bytes.
+void rle_decode(const uint32_t* counts, uint64_t n, uint8_t* mask_out, uint64_t size) {
+    uint64_t pos = 0;
+    uint8_t value = 0;
+    for (uint64_t i = 0; i < n && pos < size; ++i) {
+        uint64_t run = counts[i];
+        if (run > size - pos) run = size - pos;
+        for (uint64_t j = 0; j < run; ++j) mask_out[pos + j] = value;
+        pos += run;
+        value = 1 - value;
+    }
+}
+
+// Total foreground area (sum of odd-indexed runs).
+uint64_t rle_area(const uint32_t* counts, uint64_t n) {
+    uint64_t area = 0;
+    for (uint64_t i = 1; i < n; i += 2) area += counts[i];
+    return area;
+}
+
+// Intersection area of two RLEs via a two-pointer run walk.
+static uint64_t rle_intersection(const uint32_t* a, uint64_t na, const uint32_t* b, uint64_t nb) {
+    uint64_t ia = 0, ib = 0;          // run indices
+    uint64_t ea = a[0], eb = b[0];    // absolute end positions of current runs
+    uint64_t pos = 0;                 // current absolute position
+    uint64_t inter = 0;
+    while (ia < na && ib < nb) {
+        uint64_t next = std::min(ea, eb);
+        if ((ia & 1) && (ib & 1)) inter += next - pos;  // both in a 1-run
+        pos = next;
+        if (ea == next) { ++ia; if (ia < na) ea += a[ia]; }
+        if (eb == next) { ++ib; if (ib < nb) eb += b[ib]; }
+    }
+    return inter;
+}
+
+// Crowd-aware IoU between one detection RLE and one ground-truth RLE
+// (pycocotools semantics: iscrowd => union = area(dt)).
+double rle_iou_pair(const uint32_t* dt, uint64_t ndt, const uint32_t* gt, uint64_t ngt, int iscrowd) {
+    uint64_t inter = rle_intersection(dt, ndt, gt, ngt);
+    uint64_t area_dt = rle_area(dt, ndt);
+    uint64_t area_gt = rle_area(gt, ngt);
+    uint64_t uni = iscrowd ? area_dt : area_dt + area_gt - inter;
+    if (uni == 0) return 0.0;
+    return static_cast<double>(inter) / static_cast<double>(uni);
+}
+
+// Full IoU matrix between D detection and G ground-truth RLEs.
+// Flattened run buffers with per-mask offsets/lengths; out is row-major (D, G).
+void rle_iou_matrix(
+    const uint32_t* dt_runs, const uint64_t* dt_offsets, const uint64_t* dt_lengths, uint64_t n_dt,
+    const uint32_t* gt_runs, const uint64_t* gt_offsets, const uint64_t* gt_lengths, uint64_t n_gt,
+    const uint8_t* gt_iscrowd, double* out) {
+    for (uint64_t d = 0; d < n_dt; ++d) {
+        for (uint64_t g = 0; g < n_gt; ++g) {
+            out[d * n_gt + g] = rle_iou_pair(
+                dt_runs + dt_offsets[d], dt_lengths[d],
+                gt_runs + gt_offsets[g], gt_lengths[g],
+                gt_iscrowd ? gt_iscrowd[g] : 0);
+        }
+    }
+}
+
+}  // extern "C"
