@@ -174,3 +174,98 @@ def test_bert_score_idf_changes_scores(bert_pair):
     plain = np.asarray(bert_score(preds, target, model=model, user_tokenizer=tokenizer)["f1"])
     with_idf = np.asarray(bert_score(preds, target, model=model, user_tokenizer=tokenizer, idf=True)["f1"])
     assert not np.allclose(plain, with_idf)
+
+
+class _MLMTokenizer(_WordHashTokenizer):
+    pad_token_id = 0
+    cls_token_id = 1
+    sep_token_id = 2
+    mask_token_id = 3
+
+    def __call__(self, text=None, padding=True, truncation=True, max_length=None, return_tensors="np", **kw):
+        max_length = min(max_length or self.max_len, self.max_len)
+        rows = []
+        for sentence in text:
+            ids = [self.cls_token_id]
+            ids += [4 + (hash(w) % (self.vocab_size - 5)) for w in sentence.lower().split()]
+            ids = ids[: max_length - 1] + [self.sep_token_id]
+            rows.append(ids)
+        width = max_length if padding == "max_length" else max(len(r) for r in rows)
+        input_ids = np.zeros((len(rows), width), np.int32)
+        attention_mask = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            input_ids[i, : len(r)] = r
+            attention_mask[i, : len(r)] = 1
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+@pytest.fixture(scope="module")
+def mlm_pair():
+    from transformers import FlaxBertForMaskedLM
+
+    cfg = BertConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, vocab_size=64, max_position_embeddings=32,
+    )
+    return FlaxBertForMaskedLM(cfg, seed=0), _MLMTokenizer(max_len=12)
+
+
+@pytest.mark.parametrize(
+    "measure,kwargs",
+    [
+        ("kl_divergence", {}),
+        ("l2_distance", {}),
+        ("fisher_rao_distance", {}),
+        ("alpha_divergence", {"alpha": 0.5}),
+        ("ab_divergence", {"alpha": 0.5, "beta": 0.5}),
+    ],
+)
+def test_infolm_measures_run_and_self_distance_smaller(mlm_pair, measure, kwargs):
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    model, tokenizer = mlm_pair
+    preds = ["the cat sat on the mat", "a long sentence appears"]
+    same = preds
+    diff = ["entirely unrelated words spoken", "short one"]
+    d_same = np.asarray(infolm(preds, same, model=model, user_tokenizer=tokenizer, idf=False,
+                               information_measure=measure, **kwargs))
+    d_diff = np.asarray(infolm(preds, diff, model=model, user_tokenizer=tokenizer, idf=False,
+                               information_measure=measure, **kwargs))
+    assert np.isfinite(d_same) and np.isfinite(d_diff)
+    if measure in ("l2_distance", "fisher_rao_distance"):
+        # true distances: identical corpora score 0 and differ from same < diff
+        np.testing.assert_allclose(float(d_same), 0.0, atol=1e-5)
+        assert float(d_diff) > float(d_same)
+    else:
+        # divergences score 0 on identical distributions (sign depends on
+        # alpha/beta normalization, so only the zero point is asserted)
+        np.testing.assert_allclose(float(d_same), 0.0, atol=1e-5)
+
+
+def test_infolm_module_matches_functional(mlm_pair):
+    from torchmetrics_tpu.functional.text.infolm import infolm
+    from torchmetrics_tpu.text.infolm import InfoLM
+
+    model, tokenizer = mlm_pair
+    preds = ["hello there world", "general kenobi"]
+    target = ["hello world", "general grievous"]
+    expected = float(
+        infolm(preds, target, model=model, user_tokenizer=tokenizer, idf=False,
+               information_measure="l2_distance", max_length=12)
+    )
+    metric = InfoLM(model=model, user_tokenizer=tokenizer, idf=False,
+                    information_measure="l2_distance", max_length=12)
+    for p, t in zip(preds, target):
+        metric.update([p], [t])
+    np.testing.assert_allclose(float(metric.compute()), expected, rtol=1e-4)
+
+
+def test_infolm_validation():
+    from torchmetrics_tpu.functional.text.infolm import _InformationMeasure
+
+    with pytest.raises(ValueError, match="information_measure"):
+        _InformationMeasure("bogus")
+    with pytest.raises(ValueError, match="alpha"):
+        _InformationMeasure("alpha_divergence", alpha=1.0)
+    with pytest.raises(ValueError, match="beta"):
+        _InformationMeasure("beta_divergence", beta=0.0)

@@ -5,6 +5,7 @@ from torchmetrics_tpu.functional.text.bleu import bleu_score
 from torchmetrics_tpu.functional.text.chrf import chrf_score
 from torchmetrics_tpu.functional.text.edit import edit_distance
 from torchmetrics_tpu.functional.text.eed import extended_edit_distance
+from torchmetrics_tpu.functional.text.infolm import infolm
 from torchmetrics_tpu.functional.text.perplexity import perplexity
 from torchmetrics_tpu.functional.text.rouge import rouge_score
 from torchmetrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
@@ -24,6 +25,7 @@ __all__ = [
     "chrf_score",
     "edit_distance",
     "extended_edit_distance",
+    "infolm",
     "match_error_rate",
     "perplexity",
     "rouge_score",

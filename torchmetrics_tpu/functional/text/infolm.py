@@ -1,0 +1,235 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""InfoLM (reference ``functional/text/infolm.py:545``).
+
+Information measures between masked-language-model token distributions of
+candidate and reference sentences (Staerman et al., 2021). TPU-first detail:
+the reference masks one position at a time and runs ``seq_len`` separate
+forward passes (``infolm.py:367-421``); here all masked variants are stacked
+into one ``(L·B, S)`` batch so the MLM forward is a single large XLA program.
+The model is a **Flax** masked LM; ``model``/``user_tokenizer`` are
+injectable for offline use.
+"""
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
+
+Array = jax.Array
+
+_TRANSFORMERS_AVAILABLE = ModuleAvailableCache("transformers")
+
+ALLOWED_INFORMATION_MEASURES = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+
+class _InformationMeasure:
+    """Dispatch + validation for the nine measures (reference ``infolm.py:72-295``)."""
+
+    def __init__(self, information_measure: str, alpha: Optional[float] = None, beta: Optional[float] = None) -> None:
+        if information_measure not in ALLOWED_INFORMATION_MEASURES:
+            raise ValueError(
+                f"Argument `information_measure` expected to be one of {ALLOWED_INFORMATION_MEASURES},"
+                f" but got {information_measure}."
+            )
+        self.information_measure = information_measure
+        if information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence"):
+            if not isinstance(alpha, float) or alpha in (0, 1):
+                raise ValueError(f"Parameter `alpha` is expected to be a float differing from 0 and 1, got {alpha}.")
+        if information_measure in ("beta_divergence", "ab_divergence"):
+            if not isinstance(beta, float) or beta in (0, -1):
+                raise ValueError(f"Parameter `beta` is expected to be a float differing from 0 and -1, got {beta}.")
+        if information_measure == "ab_divergence" and (alpha is not None and beta is not None and alpha + beta == 0):
+            raise ValueError(f"Parameters `alpha` and `beta` cannot sum to 0, got {alpha} and {beta}.")
+        self.alpha = alpha
+        self.beta = beta
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        fn = getattr(self, f"_calculate_{self.information_measure}")
+        return jnp.nan_to_num(fn(preds_distribution, target_distribution))
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.sum(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.sqrt(jnp.sum((t - p) ** 2, axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.max(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sqrt(p * t).sum(-1), 0, 1))
+
+
+def _get_special_tokens_map(tokenizer: Any) -> Dict[str, int]:
+    """Special token ids needed for masking (reference ``infolm.py:323-339``)."""
+    return {
+        "mask_token_id": tokenizer.mask_token_id,
+        "pad_token_id": tokenizer.pad_token_id,
+        "sep_token_id": tokenizer.sep_token_id,
+        "cls_token_id": tokenizer.cls_token_id,
+    }
+
+
+def _get_token_mask(input_ids: np.ndarray, special_tokens_map: Dict[str, int]) -> np.ndarray:
+    """True for real (non-special) tokens (reference ``infolm.py:342-364``)."""
+    mask = np.ones_like(input_ids, dtype=bool)
+    for key in ("pad_token_id", "sep_token_id", "cls_token_id"):
+        mask &= input_ids != special_tokens_map[key]
+    return mask
+
+
+def _get_tokens_idf(input_ids: np.ndarray, token_mask: np.ndarray) -> np.ndarray:
+    """Per-position plus-one-smoothed idf weights."""
+    num_sentences = input_ids.shape[0]
+    counter: Counter = Counter()
+    for row, mask in zip(input_ids, token_mask):
+        counter.update(set(row[mask].tolist()))
+    idf: Dict[int, float] = defaultdict(lambda: math.log((num_sentences + 1) / 1))
+    idf.update({idx: math.log((num_sentences + 1) / (count + 1)) for idx, count in counter.items()})
+    return np.vectorize(lambda t: idf[int(t)])(input_ids).astype(np.float64)
+
+
+def _get_data_distribution(
+    model: Any,
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    temperature: float,
+    idf: bool,
+    special_tokens_map: Dict[str, int],
+    batch_size: int = 8,
+) -> Array:
+    """Per-sentence vocab distribution: average the MLM distribution at each
+    masked position over real tokens (reference ``infolm.py:367-462``), with
+    all masked variants batched into one forward per input batch."""
+    token_mask = _get_token_mask(input_ids, special_tokens_map)
+    idf_weights = _get_tokens_idf(input_ids, token_mask) if idf else None
+    out = []
+    for start in range(0, input_ids.shape[0], batch_size):
+        ids = input_ids[start : start + batch_size]
+        att = attention_mask[start : start + batch_size]
+        tmask = token_mask[start : start + batch_size]
+        b, s = ids.shape
+        # (L, B, S): variant l has position l replaced with [MASK]
+        ids_rep = np.broadcast_to(ids, (s, b, s)).copy()
+        ids_rep[np.arange(s), :, np.arange(s)] = special_tokens_map["mask_token_id"]
+        logits = model(
+            jnp.asarray(ids_rep.reshape(s * b, s)), jnp.asarray(np.broadcast_to(att, (s, b, s)).reshape(s * b, s))
+        ).logits  # (L*B, S, V)
+        logits = jnp.asarray(logits).reshape(s, b, s, -1)
+        # distribution at the masked position of each variant -> (B, S, V)
+        probs = jax.nn.softmax(logits[jnp.arange(s), :, jnp.arange(s)] / temperature, axis=-1)
+        probs = jnp.moveaxis(probs, 0, 1)
+        weights = jnp.asarray(tmask, jnp.float32)
+        if idf:
+            w_idf = jnp.asarray(idf_weights[start : start + batch_size], jnp.float32)
+            probs = probs * w_idf[:, :, None]
+            weights = weights * w_idf
+        probs = probs * jnp.asarray(tmask, jnp.float32)[:, :, None]
+        out.append(probs.sum(axis=1) / weights.sum(axis=1, keepdims=True))
+    return jnp.concatenate(out)
+
+
+def _load_default_mlm(model_name_or_path: str):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`infolm` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.4` or `pip install torchmetrics[text]`."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
+
+    tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+    model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path)
+    return tokenizer, model
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    device: Optional[Any] = None,
+    max_length: Optional[int] = None,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    verbose: bool = True,
+    return_sentence_level_score: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+):
+    """InfoLM (reference ``infolm.py:545-…``)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+    if not (isinstance(temperature, float) and temperature > 0):
+        raise ValueError(f"Argument `temperature` is expected to be a positive float, got {temperature}.")
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    tokenizer = user_tokenizer
+    if model is None:
+        tokenizer, model = _load_default_mlm(model_name_or_path)
+    max_length = max_length or getattr(getattr(model, "config", None), "max_position_embeddings", 512)
+    special_tokens_map = _get_special_tokens_map(tokenizer)
+
+    enc_p = tokenizer(list(preds), padding=True, truncation=True, max_length=max_length, return_tensors="np")
+    enc_t = tokenizer(list(target), padding=True, truncation=True, max_length=max_length, return_tensors="np")
+    preds_distribution = _get_data_distribution(
+        model, np.asarray(enc_p["input_ids"]), np.asarray(enc_p["attention_mask"]), temperature, idf,
+        special_tokens_map, batch_size=min(batch_size, 8),
+    )
+    target_distribution = _get_data_distribution(
+        model, np.asarray(enc_t["input_ids"]), np.asarray(enc_t["attention_mask"]), temperature, idf,
+        special_tokens_map, batch_size=min(batch_size, 8),
+    )
+    # pad to a common vocab axis is unnecessary (same model); compute measure
+    info_lm_score = measure(preds_distribution, target_distribution)
+    if return_sentence_level_score:
+        return info_lm_score.mean(), info_lm_score
+    return info_lm_score.mean()

@@ -1,6 +1,7 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
 """Text module metrics (reference ``src/torchmetrics/text/__init__.py``)."""
+from torchmetrics_tpu.text.infolm import InfoLM
 from torchmetrics_tpu.text.metrics import (
     BLEUScore,
     CharErrorRate,
@@ -24,6 +25,7 @@ __all__ = [
     "CHRFScore",
     "EditDistance",
     "ExtendedEditDistance",
+    "InfoLM",
     "MatchErrorRate",
     "Perplexity",
     "ROUGEScore",
