@@ -232,14 +232,16 @@ def test_infolm_measures_run_and_self_distance_smaller(mlm_pair, measure, kwargs
     d_diff = np.asarray(infolm(preds, diff, model=model, user_tokenizer=tokenizer, idf=False,
                                information_measure=measure, **kwargs))
     assert np.isfinite(d_same) and np.isfinite(d_diff)
+    # arccos near 1 amplifies f32 rounding, so fisher-rao gets a looser zero
+    zero_atol = 1e-3 if measure == "fisher_rao_distance" else 1e-5
     if measure in ("l2_distance", "fisher_rao_distance"):
         # true distances: identical corpora score 0 and differ from same < diff
-        np.testing.assert_allclose(float(d_same), 0.0, atol=1e-5)
+        np.testing.assert_allclose(float(d_same), 0.0, atol=zero_atol)
         assert float(d_diff) > float(d_same)
     else:
         # divergences score 0 on identical distributions (sign depends on
         # alpha/beta normalization, so only the zero point is asserted)
-        np.testing.assert_allclose(float(d_same), 0.0, atol=1e-5)
+        np.testing.assert_allclose(float(d_same), 0.0, atol=zero_atol)
 
 
 def test_infolm_module_matches_functional(mlm_pair):
