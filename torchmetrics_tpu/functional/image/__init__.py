@@ -8,6 +8,7 @@ from torchmetrics_tpu.functional.image.distortion import (
 )
 from torchmetrics_tpu.functional.image.metrics import (
     error_relative_global_dimensionless_synthesis,
+    image_gradients,
     peak_signal_noise_ratio,
     peak_signal_noise_ratio_with_blocked_effect,
     relative_average_spectral_error,
@@ -25,6 +26,7 @@ from torchmetrics_tpu.functional.image.ssim import (
 
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "peak_signal_noise_ratio_with_blocked_effect",

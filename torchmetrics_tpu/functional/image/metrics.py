@@ -473,3 +473,19 @@ def visual_information_fidelity(preds: Array, target: Array, sigma_n_sq: float =
         _vif_per_channel(preds[:, i], target[:, i], sigma_n_sq) for i in range(preds.shape[1])
     ]
     return jnp.mean(jnp.concatenate(per_channel))
+
+
+# -------------------------------------------------------------- image gradients
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Finite-difference image gradients ``(dy, dx)``, zero-padded at the far
+    edge (reference ``functional/image/gradients.py:20-76``)."""
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
