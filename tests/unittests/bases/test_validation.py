@@ -1,0 +1,95 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Cross-domain input-validation coverage: the eager (non-jit) path must
+reject malformed inputs in every domain (VERDICT weak-item 4 — validation is
+deliberately skipped under tracing, so the concrete path carries the load)."""
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+
+def test_classification_rejects_bad_labels():
+    with pytest.raises(Exception, match="[Dd]etected|[Ee]xpected|larger|range"):
+        F.multiclass_accuracy(np.array([0, 5, 1]), np.array([0, 1, 2]), num_classes=3)
+
+
+def test_regression_rejects_shape_mismatch():
+    with pytest.raises(Exception, match="shape"):
+        F.mean_squared_error(np.zeros(4), np.zeros(5))
+
+
+def test_retrieval_rejects_nonbinary_target():
+    with pytest.raises(ValueError, match="binary"):
+        F.retrieval_average_precision(np.array([0.1, 0.2]), np.array([0, 5]))
+
+
+def test_detection_rejects_missing_keys_and_bad_format():
+    with pytest.raises(ValueError, match="Expected all dicts"):
+        tm.MeanAveragePrecision().update([{"labels": np.zeros(0)}], [{"boxes": np.zeros((0, 4)), "labels": np.zeros(0)}])
+    with pytest.raises(ValueError, match="box_format"):
+        tm.MeanAveragePrecision(box_format="nope")
+
+
+def test_image_rejects_bad_shapes():
+    with pytest.raises(Exception, match="shape|BxCxHxW"):
+        F.universal_image_quality_index(np.zeros((4, 3, 8, 8)), np.zeros((4, 3, 9, 9)))
+    with pytest.raises(ValueError, match="odd"):
+        F.structural_similarity_index_measure(np.zeros((1, 1, 8, 8)), np.zeros((1, 1, 8, 8)), kernel_size=4)
+    with pytest.raises(ValueError, match="channel"):
+        F.spectral_angle_mapper(np.zeros((2, 1, 8, 8)), np.zeros((2, 1, 8, 8)))
+
+
+def test_text_rejects_mismatched_corpora():
+    with pytest.raises(ValueError, match="[Cc]orpus|same"):
+        F.translation_edit_rate(["a", "b"], [["a"]])
+    with pytest.raises(ValueError, match="same length"):
+        F.edit_distance(["a", "b"], ["a"])
+    with pytest.raises(ValueError, match="language"):
+        F.extended_edit_distance(["a"], ["a"], language="xx")
+
+
+def test_audio_rejects_bad_shapes():
+    with pytest.raises(Exception, match="shape"):
+        F.signal_noise_ratio(np.zeros(10), np.zeros(12))
+    with pytest.raises(RuntimeError, match="spk"):
+        F.source_aggregated_signal_distortion_ratio(np.zeros(10), np.zeros(10))
+
+
+def test_clustering_nominal_segmentation_reject_bad_inputs():
+    with pytest.raises(Exception):
+        F.mutual_info_score(np.array([[0, 1]]), np.array([0, 1, 2]))
+    with pytest.raises(Exception):
+        tm.MeanIoU(num_classes=0)
+    with pytest.raises(ValueError):
+        tm.PanopticQuality(things={0}, stuffs={0})
+
+
+def test_multimodal_rejects_bad_prompts_and_counts():
+    from torchmetrics_tpu.functional.multimodal.clip_iqa import _clip_iqa_format_prompts
+
+    with pytest.raises(ValueError, match="must be one of"):
+        _clip_iqa_format_prompts(("not_a_prompt",))
+
+
+def test_validation_skipped_under_jit_but_structural_still_raises():
+    """Value checks are gated on concreteness; structural (shape) errors are
+    trace-time and still raise inside jit."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def traced(p, t):
+        return F.multiclass_accuracy(p, t, num_classes=3)
+
+    # out-of-range labels pass silently under tracing (documented design)
+    out = traced(jnp.asarray([0, 5, 1]), jnp.asarray([0, 1, 2]))
+    assert np.isfinite(float(out))
+
+    @jax.jit
+    def traced_bad_shape(p, t):
+        return F.mean_squared_error(p, t)
+
+    with pytest.raises(Exception, match="shape"):
+        traced_bad_shape(jnp.zeros(4), jnp.zeros(5))
