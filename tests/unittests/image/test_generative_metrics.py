@@ -213,3 +213,44 @@ def test_lpips_zero_for_identical_and_positive_for_different():
     assert float(metric2.compute()) > 0
     with pytest.raises(ValueError, match="NCHW"):
         metric2.update(np.zeros((2, 1, 8, 8)), np.zeros((2, 1, 8, 8)))
+
+
+def test_perceptual_path_length_with_dummy_generator():
+    import jax
+
+    from torchmetrics_tpu.image.perceptual_path_length import (
+        PerceptualPathLength,
+        _interpolate,
+        perceptual_path_length,
+    )
+
+    class DummyGen:
+        z_size = 4
+
+        def sample(self, n):
+            return np.random.RandomState(0).randn(n, self.z_size).astype(np.float32)
+
+        def __call__(self, z):
+            w = np.linspace(0, 1, 3 * 32 * 32, dtype=np.float32).reshape(1, -1)
+            img = jax.nn.sigmoid(jnp.asarray(z).sum(-1, keepdims=True) * w)
+            return 255 * img.reshape(-1, 3, 32, 32)
+
+    mean, std, dists = perceptual_path_length(
+        DummyGen(), num_samples=16, batch_size=8, sim_net="alex", resize=None, epsilon=0.5
+    )
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+    assert dists.ndim == 1 and dists.shape[0] <= 16
+    metric = PerceptualPathLength(num_samples=16, batch_size=8, sim_net="alex", resize=None, epsilon=0.5)
+    metric.update(DummyGen())
+    mean2, _, _ = metric.compute()
+    np.testing.assert_allclose(float(mean2), float(mean), rtol=1e-5)
+    # slerp interpolation stays on the unit sphere
+    z1 = np.random.RandomState(1).randn(8, 6).astype(np.float32)
+    z1 /= np.linalg.norm(z1, axis=-1, keepdims=True)
+    z2 = np.random.RandomState(2).randn(8, 6).astype(np.float32)
+    z2 /= np.linalg.norm(z2, axis=-1, keepdims=True)
+    out = _interpolate(jnp.asarray(z1), jnp.asarray(z2), 0.3, "slerp_unit")
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1), 1.0, rtol=1e-5)
+    # generators without `sample` are rejected
+    with pytest.raises(NotImplementedError, match="sample"):
+        perceptual_path_length(object(), num_samples=4)

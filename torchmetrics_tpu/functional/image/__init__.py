@@ -19,6 +19,7 @@ from torchmetrics_tpu.functional.image.metrics import (
     universal_image_quality_index,
     visual_information_fidelity,
 )
+from torchmetrics_tpu.image.perceptual_path_length import perceptual_path_length
 from torchmetrics_tpu.functional.image.ssim import (
     multiscale_structural_similarity_index_measure,
     structural_similarity_index_measure,
@@ -29,6 +30,7 @@ __all__ = [
     "image_gradients",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
+    "perceptual_path_length",
     "peak_signal_noise_ratio_with_blocked_effect",
     "quality_with_no_reference",
     "relative_average_spectral_error",

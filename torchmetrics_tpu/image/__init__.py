@@ -6,6 +6,7 @@ from torchmetrics_tpu.image.inception_score import InceptionScore
 from torchmetrics_tpu.image.kid import KernelInceptionDistance
 from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
 from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
+from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength
 from torchmetrics_tpu.image.metrics import (
     ErrorRelativeGlobalDimensionlessSynthesis,
     MultiScaleStructuralSimilarityIndexMeasure,
@@ -31,6 +32,7 @@ __all__ = [
     "KernelInceptionDistance",
     "LearnedPerceptualImagePatchSimilarity",
     "MemorizationInformedFrechetInceptionDistance",
+    "PerceptualPathLength",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
     "PeakSignalNoiseRatioWithBlockedEffect",

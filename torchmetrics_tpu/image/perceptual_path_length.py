@@ -1,0 +1,234 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Perceptual path length (reference ``image/perceptual_path_length.py`` and
+``functional/image/perceptual_path_length.py:153-280``).
+
+``PPL = E[ D(G(I(z1,z2,t)), G(I(z1,z2,t+eps))) / eps² ]`` over latent
+interpolations of a user generator. The generator is duck-typed like the
+reference's ``GeneratorType``: ``sample(num_samples) -> (n, z)`` latents and
+``__call__(z[, labels]) -> (n, C, H, W)`` images in ``[0, 255]``; the
+similarity net defaults to the framework's LPIPS graph.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _validate_generator_model(generator: Any, conditional: bool = False) -> None:
+    """Duck-type checks (reference ``perceptual_path_length.py:50-68``)."""
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must have a `sample` method with signature `sample(num_samples: int) -> Tensor` where the"
+            " returned tensor has shape `(num_samples, z_size)`."
+        )
+    if not callable(generator):
+        raise NotImplementedError("The generator must be callable: `generator(z) -> images`.")
+    if conditional and not hasattr(generator, "num_classes"):
+        raise AttributeError("The generator must have a `num_classes` attribute when `conditional=True`.")
+
+
+def _perceptual_path_length_validate_arguments(
+    num_samples: int,
+    conditional: bool,
+    batch_size: int,
+    interpolation_method: str,
+    epsilon: float,
+    resize: Optional[int],
+    lower_discard: Optional[float],
+    upper_discard: Optional[float],
+) -> None:
+    """Argument validation (reference ``perceptual_path_length.py:71-105``)."""
+    if not (isinstance(num_samples, int) and num_samples > 0):
+        raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+    if not isinstance(conditional, bool):
+        raise ValueError(f"Argument `conditional` must be a boolean, but got {conditional}.")
+    if not (isinstance(batch_size, int) and batch_size > 0):
+        raise ValueError(f"Argument `batch_size` must be a positive integer, but got {batch_size}.")
+    if interpolation_method not in ("lerp", "slerp_any", "slerp_unit"):
+        raise ValueError(
+            f"Argument `interpolation_method` must be one of 'lerp', 'slerp_any', 'slerp_unit',"
+            f" got {interpolation_method}."
+        )
+    if not (isinstance(epsilon, float) and epsilon > 0):
+        raise ValueError(f"Argument `epsilon` must be a positive float, but got {epsilon}.")
+    if resize is not None and not (isinstance(resize, int) and resize > 0):
+        raise ValueError(f"Argument `resize` must be a positive integer or `None`, but got {resize}.")
+    if lower_discard is not None and not (isinstance(lower_discard, float) and 0 <= lower_discard <= 1):
+        raise ValueError(
+            f"Argument `lower_discard` must be a float between 0 and 1 or `None`, but got {lower_discard}."
+        )
+    if upper_discard is not None and not (isinstance(upper_discard, float) and 0 <= upper_discard <= 1):
+        raise ValueError(
+            f"Argument `upper_discard` must be a float between 0 and 1 or `None`, but got {upper_discard}."
+        )
+
+
+def _interpolate(latents1: Array, latents2: Array, epsilon: float = 1e-4, interpolation_method: str = "lerp") -> Array:
+    """lerp / slerp interpolation step (reference ``perceptual_path_length.py:107-150``)."""
+    eps = 1e-7
+    if latents1.shape != latents2.shape:
+        raise ValueError("Latents must have the same shape.")
+    if interpolation_method == "lerp":
+        return latents1 + (latents2 - latents1) * epsilon
+    if interpolation_method in ("slerp_any", "slerp_unit"):
+        l1n = latents1 / jnp.clip(jnp.linalg.norm(latents1, axis=-1, keepdims=True), eps)
+        l2n = latents2 / jnp.clip(jnp.linalg.norm(latents2, axis=-1, keepdims=True), eps)
+        d = (l1n * l2n).sum(axis=-1, keepdims=True)
+        mask_degenerate = (
+            (jnp.linalg.norm(l1n, axis=-1, keepdims=True) < eps)
+            | (jnp.linalg.norm(l2n, axis=-1, keepdims=True) < eps)
+            | (d > 1 - eps)
+            | (d < -1 + eps)
+        )
+        omega = jnp.arccos(jnp.clip(d, -1, 1))
+        denom = jnp.clip(jnp.sin(omega), eps)
+        coef1 = jnp.sin((1 - epsilon) * omega) / denom
+        coef2 = jnp.sin(epsilon * omega) / denom
+        out = coef1 * latents1 + coef2 * latents2
+        lerped = latents1 + (latents2 - latents1) * epsilon
+        out = jnp.where(mask_degenerate, lerped, out)
+        if interpolation_method == "slerp_unit":
+            out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), eps)
+        return out
+    raise ValueError(
+        f"Interpolation method {interpolation_method} not supported. Choose from 'lerp', 'slerp_any', 'slerp_unit'."
+    )
+
+
+def perceptual_path_length(
+    generator: Any,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Union[Callable, str] = "vgg",
+    seed: int = 42,
+) -> Tuple[Array, Array, Array]:
+    """PPL of a generator (reference ``perceptual_path_length.py:153-280``).
+
+    Returns ``(mean, std, distances)`` after quantile discarding.
+    """
+    _perceptual_path_length_validate_arguments(
+        num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+    )
+    _validate_generator_model(generator, conditional)
+
+    if callable(sim_net) and not isinstance(sim_net, str):
+        net = sim_net
+    elif sim_net in ("alex", "vgg"):
+        from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity, _LPIPSNet
+
+        lpips = LearnedPerceptualImagePatchSimilarity(net_type=sim_net)
+
+        def net(a: Array, b: Array) -> Array:
+            if resize is not None:
+                a = jax.image.resize(a, (*a.shape[:2], resize, resize), "bilinear")
+                b = jax.image.resize(b, (*b.shape[:2], resize, resize), "bilinear")
+            return lpips._apply_fn(
+                lpips.net_params, jnp.transpose(a, (0, 2, 3, 1)), jnp.transpose(b, (0, 2, 3, 1))
+            )
+    else:
+        raise ValueError(f"sim_net must be a callable or one of 'alex', 'vgg', got {sim_net}")
+
+    latent1 = jnp.asarray(generator.sample(num_samples))
+    latent2 = jnp.asarray(generator.sample(num_samples))
+    latent2 = _interpolate(latent1, latent2, epsilon, interpolation_method=interpolation_method)
+    if conditional:
+        labels = jax.random.randint(jax.random.PRNGKey(seed), (num_samples,), 0, generator.num_classes)
+
+    distances = []
+    num_batches = math.ceil(num_samples / batch_size)
+    for batch_idx in range(num_batches):
+        sl = slice(batch_idx * batch_size, (batch_idx + 1) * batch_size)
+        z = jnp.concatenate([latent1[sl], latent2[sl]])
+        if conditional:
+            lab = jnp.concatenate([labels[sl], labels[sl]])
+            outputs = jnp.asarray(generator(z, lab))
+        else:
+            outputs = jnp.asarray(generator(z))
+        out1, out2 = jnp.split(outputs, 2, axis=0)
+        # rescale to lpips expected domain: [0, 255] -> [-1, 1]
+        out1 = 2 * (out1 / 255) - 1
+        out2 = 2 * (out2 / 255) - 1
+        distances.append(jnp.asarray(net(out1, out2)) / epsilon**2)
+
+    distances = jnp.concatenate(distances)
+    lower = jnp.quantile(distances, lower_discard, method="lower") if lower_discard is not None else 0.0
+    upper = jnp.quantile(distances, upper_discard, method="lower") if upper_discard is not None else distances.max()
+    keep = (distances >= lower) & (distances <= upper)
+    kept = distances[np.asarray(keep)]
+    return kept.mean(), kept.std(ddof=1), kept
+
+
+class PerceptualPathLength(Metric):
+    """PPL module metric (reference ``image/perceptual_path_length.py:29-150``).
+
+    Unlike stream metrics, PPL evaluates a generator: ``update(generator)``
+    stores it and ``compute`` runs the sampling loop.
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_samples: int = 10_000,
+        conditional: bool = False,
+        batch_size: int = 128,
+        interpolation_method: str = "lerp",
+        epsilon: float = 1e-4,
+        resize: Optional[int] = 64,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        sim_net: Union[Callable, str] = "vgg",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _perceptual_path_length_validate_arguments(
+            num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+        )
+        self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
+        self.epsilon = epsilon
+        self.resize = resize
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.sim_net = sim_net
+        self._generator = None
+
+    def update(self, generator: Any) -> None:
+        """Store the generator to evaluate (reference ``:128-134``)."""
+        _validate_generator_model(generator, self.conditional)
+        self._generator = generator
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        if self._generator is None:
+            raise RuntimeError("Generator must be provided via `update` before calling `compute`.")
+        return perceptual_path_length(
+            self._generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            resize=self.resize,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            sim_net=self.sim_net,
+        )
