@@ -156,9 +156,9 @@ def _binned_curve_state(preds: Array, target_bin: Array, valid: Array, threshold
     masks_i = jnp.stack([(1 - y) * v, y * v], axis=-1)  # (N, C, 2) int
     total = masks_i.sum(0).astype(jnp.int32)  # (C, 2) per-class target counts
 
-    # chunk so the (chunk, C, T) compare tensor stays ~32MB bf16 (no floor:
-    # for very large C*T a small chunk is exactly what keeps it in VMEM)
-    chunk = max(1, min(n, (1 << 24) // max(1, n_inner * len_t)))
+    # chunk the (chunk, C, T) compare tensor (~128MB bf16 cap — measured best
+    # on v5e; smaller chunks only pay more scan overhead, larger ones spill)
+    chunk = max(1, min(n, (1 << 26) // max(1, n_inner * len_t)))
     pad = (-n) % chunk
     if pad:
         p = jnp.pad(p, ((0, pad), (0, 0)))
