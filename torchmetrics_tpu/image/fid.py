@@ -47,6 +47,7 @@ class FrechetInceptionDistance(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    feature_network: str = "inception"
     plot_lower_bound = 0.0
 
     def __init__(

@@ -23,6 +23,7 @@ class InceptionScore(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    feature_network: str = "inception"
     plot_lower_bound = 0.0
 
     def __init__(

@@ -43,6 +43,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    feature_network: str = "inception"
     plot_lower_bound = 0.0
 
     def __init__(
