@@ -1,0 +1,6 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Hand-written TPU kernels (Pallas) for the hottest metric ops."""
+from torchmetrics_tpu.ops.binned_confusion import binned_confusion_counts_pallas
+
+__all__ = ["binned_confusion_counts_pallas"]

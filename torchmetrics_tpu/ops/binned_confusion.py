@@ -1,0 +1,111 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Pallas kernel for the multi-threshold confusion update.
+
+The hottest op in the classification suite (SURVEY §7: "fused multi-threshold
+confusion update" is the first Pallas candidate): given per-sample positive
+probabilities ``p (N, C)``, binary targets ``y (N, C)`` and validity ``v``,
+produce ``ge_pos[t, c] = Σ_n 1[p ≥ thr_t]·y·v`` and ``ge_all[t, c] =
+Σ_n 1[p ≥ thr_t]·v`` for ``T`` thresholds.
+
+The XLA path (``_binned_curve_state``) materializes a ``(chunk, C, T)``
+compare tensor in HBM between the compare and the contraction. This kernel
+pins one sample-tile in VMEM, broadcasts the compare against the (static)
+threshold grid entirely in VMEM, and accumulates ``(T, C)`` counts across the
+sample grid by revisiting the output block — the compare tensor never exists
+outside VMEM. Thresholds are a compile-time constant (they are fixed per
+metric), sidestepping 1-D layout constraints.
+
+Used opportunistically on TPU backends (``interpret=True`` under tests on
+CPU); the XLA einsum formulation remains the portable default.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+
+def _kernel(thr_ref, p_ref, w_pos_ref, w_all_ref, out_pos_ref, out_all_ref):
+    """``thr_ref``: (T_pad, 1) thresholds; sample tile pinned in VMEM."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_pos_ref[:] = jnp.zeros_like(out_pos_ref)
+        out_all_ref[:] = jnp.zeros_like(out_all_ref)
+
+    p = p_ref[:]  # (TILE_N, C)
+    thr = thr_ref[:]  # (T_pad, 1)
+    ge = (p[None, :, :] >= thr[:, :, None]).astype(jnp.float32)  # (T_pad, TILE_N, C)
+    out_pos_ref[:] += jnp.sum(ge * w_pos_ref[:][None, :, :], axis=1)
+    out_all_ref[:] += jnp.sum(ge * w_all_ref[:][None, :, :], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("thresholds", "tile_n", "interpret"))
+def _binned_confusion_counts(
+    p: Array,
+    w_pos: Array,
+    w_all: Array,
+    thresholds: tuple,
+    tile_n: int,
+    interpret: bool,
+) -> Tuple[Array, Array]:
+    n, c = p.shape
+    num_t = len(thresholds)
+    n_tiles = n // tile_n
+    thr_col = jnp.asarray(thresholds, jnp.float32).reshape(num_t, 1)
+    out_pos, out_all = pl.pallas_call(
+        _kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((num_t, 1), lambda i: (0, 0)),
+            pl.BlockSpec((tile_n, c), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, c), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, c), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((num_t, c), lambda i: (0, 0)),
+            pl.BlockSpec((num_t, c), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((num_t, c), jnp.float32),
+            jax.ShapeDtypeStruct((num_t, c), jnp.float32),
+        ],
+        interpret=interpret,
+    )(thr_col, p.astype(jnp.float32), w_pos, w_all)
+    return out_pos, out_all
+
+
+def binned_confusion_counts_pallas(
+    p: Array,
+    y: Array,
+    valid: Array,
+    thresholds,
+    tile_n: int = 128,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """``(ge_pos, ge_all)`` of shape ``(T, C)`` via the fused Pallas kernel.
+
+    ``p``: (N, C) probabilities; ``y``: (N, C) 0/1 targets; ``valid``: (N, C)
+    0/1 mask; ``thresholds``: (T,) static values. ``N`` is padded to a tile
+    multiple internally (padded rows carry zero weight).
+    """
+    import numpy as np
+
+    thr_tuple = tuple(float(t) for t in np.asarray(thresholds).reshape(-1))
+    n, c = p.shape
+    pad = (-n) % tile_n
+    if pad:
+        p = jnp.pad(p, ((0, pad), (0, 0)), constant_values=2.0)  # > any threshold, weight 0
+        y = jnp.pad(y, ((0, pad), (0, 0)))
+        valid = jnp.pad(valid, ((0, pad), (0, 0)))
+    w_all = valid.astype(jnp.float32)
+    w_pos = w_all * y.astype(jnp.float32)
+    out_pos, out_all = _binned_confusion_counts(p, w_pos, w_all, thr_tuple, tile_n, interpret)
+    return out_pos.astype(jnp.int32), out_all.astype(jnp.int32)
