@@ -322,22 +322,33 @@ def coco_mean_average_precision(
         )  # (N,A,T,D), (N,A,T,D), (N,A,G)
 
         eps = np.spacing(np.float64(1))
+        # (A, T, N·D) flattened match/ignore views shared by every class
+        dtm_flat = det_matched.transpose(1, 2, 0, 3).reshape(num_a, num_t, -1)
+        dtig_flat = det_ignored.transpose(1, 2, 0, 3).reshape(num_a, num_t, -1)
+        gtig_flat = gt_ignored.transpose(1, 0, 2).reshape(num_a, -1)
         for ki, k in enumerate(classes):
             det_sel = [np.nonzero(det_valid[i] & (det_labels[i] == k))[0] for i in range(n_imgs)]
             gt_sel = [np.nonzero(gt_valid[i] & (gt_labels[i] == k))[0] for i in range(n_imgs)]
             if not any(len(s) for s in det_sel) and not any(len(s) for s in gt_sel):
                 continue
+            # hoist per-(maxdet) selections out of the area loop: scores and
+            # sort order are area-independent
+            per_mdet = []
+            for mdet in max_dets:
+                sel = [s[:mdet] for s in det_sel]
+                flat = np.concatenate([i * det_valid.shape[1] + sel[i] for i in range(n_imgs)]) if n_imgs else np.zeros(0, np.int64)
+                dt_scores = det_scores.reshape(-1)[flat]
+                order = np.argsort(-dt_scores, kind="mergesort")
+                per_mdet.append((flat[order], dt_scores[order]))
+            gt_flat = np.concatenate([i * gt_valid.shape[1] + gt_sel[i] for i in range(n_imgs)]) if n_imgs else np.zeros(0, np.int64)
             for ai in range(num_a):
-                npig = int(sum((~gt_ignored[i, ai, gt_sel[i]]).sum() for i in range(n_imgs)))
+                npig = int((~gtig_flat[ai, gt_flat]).sum())
                 if npig == 0:
                     continue
                 for mi, mdet in enumerate(max_dets):
-                    sel = [s[:mdet] for s in det_sel]
-                    dt_scores = np.concatenate([det_scores[i, sel[i]] for i in range(n_imgs)])
-                    order = np.argsort(-dt_scores, kind="mergesort")
-                    dt_scores_sorted = dt_scores[order]
-                    dtm = np.concatenate([det_matched[i, ai][:, sel[i]] for i in range(n_imgs)], axis=1)[:, order]
-                    dt_ig = np.concatenate([det_ignored[i, ai][:, sel[i]] for i in range(n_imgs)], axis=1)[:, order]
+                    flat_sorted, dt_scores_sorted = per_mdet[mi]
+                    dtm = dtm_flat[ai][:, flat_sorted]
+                    dt_ig = dtig_flat[ai][:, flat_sorted]
                     tps = dtm & ~dt_ig
                     fps = ~dtm & ~dt_ig
                     tp_sum = np.cumsum(tps, axis=1).astype(np.float64)
