@@ -1,0 +1,71 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Round-trip test for the Inception weight converter: export the Flax
+extractor's own parameters to the torch naming convention, convert them back
+through the tool, and verify the rebuilt extractor is numerically identical."""
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+sys.path.insert(0, "/root/repo/tools")
+
+from convert_inception_weights import convert_state_dict  # noqa: E402
+
+from torchmetrics_tpu.image.backbones.inception import (  # noqa: E402
+    InceptionFeatureExtractor,
+    load_inception_weights,
+)
+
+
+def _flax_to_torch_names(variables):
+    """Inverse of the converter mapping, for round-trip testing."""
+    state = {}
+
+    def walk(tree, path):
+        for key, val in tree.items():
+            sub = path + [key]
+            if isinstance(val, dict):
+                walk(val, sub)
+            else:
+                state["/".join(sub)] = np.asarray(val)
+
+    walk(variables["params"], [])
+    walk(variables.get("batch_stats", {}), [])
+
+    torch_state = {}
+    for flat, val in state.items():
+        parts = flat.split("/")
+        if parts[-2:] == ["conv", "kernel"]:
+            torch_state[".".join(parts[:-1]) + ".weight"] = val.transpose(3, 2, 0, 1)
+        elif parts[-2] == "bn":
+            leaf = {"scale": "weight", "bias": "bias", "mean": "running_mean", "var": "running_var"}[parts[-1]]
+            torch_state[".".join(parts[:-1]) + f".{leaf}"] = val
+        elif parts == ["fc", "kernel"]:
+            torch_state["fc.weight"] = val.T
+        elif parts == ["fc", "bias"]:
+            torch_state["fc.bias"] = val
+        else:
+            raise KeyError(flat)
+    return torch_state
+
+
+def test_inception_weight_conversion_roundtrip(tmp_path):
+    fx = InceptionFeatureExtractor(("64", "logits"))
+    torch_style = _flax_to_torch_names(fx.variables)
+    converted = convert_state_dict(torch_style)
+    npz_path = tmp_path / "weights.npz"
+    np.savez(npz_path, **converted)
+    rebuilt = load_inception_weights(str(npz_path), features_list=("64", "logits"))
+    imgs = (np.random.RandomState(0).rand(2, 3, 48, 48) * 255).astype(np.uint8)
+    out_a = fx(imgs)
+    out_b = rebuilt(imgs)
+    for a, b in zip(out_a, out_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+
+def test_converter_rejects_unknown_entries():
+    with pytest.raises(KeyError, match="Unrecognized"):
+        convert_state_dict({"bogus.layer.weight": np.zeros((3, 3))})

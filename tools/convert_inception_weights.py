@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Convert the published FID InceptionV3 checkpoint to the Flax ``.npz`` layout.
+
+Usage::
+
+    python tools/convert_inception_weights.py pt_inception-2015-12-05.pth out.npz
+    # then
+    from torchmetrics_tpu.image.backbones.inception import load_inception_weights
+    extractor = load_inception_weights("out.npz")
+    fid = FrechetInceptionDistance(feature=extractor)
+
+The input is the torch state dict used by pytorch-fid / torch-fidelity
+(``Conv2d_1a_3x3.conv.weight``, ``Mixed_5b.branch1x1.bn.running_mean``,
+``fc.weight``, ...). Mapping:
+
+- conv ``weight (O, I, H, W)`` -> flax ``kernel (H, W, I, O)``
+- batchnorm ``weight/bias/running_mean/running_var`` -> ``bn/{scale,bias,mean,var}``
+- fc ``weight (O, I)`` -> ``fc/kernel (I, O)``; ``bias`` -> ``fc/bias``
+
+Run offline wherever the checkpoint is available; this image has no network
+egress, so the tool ships untested against the real file but round-trip
+verified against the Flax layout (``tests/unittests/image/test_weight_converter.py``).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict
+
+import numpy as np
+
+
+def convert_state_dict(state: Dict[str, "np.ndarray"]) -> Dict[str, np.ndarray]:
+    """Torch FID-Inception state dict -> flat Flax-path npz dict."""
+    out: Dict[str, np.ndarray] = {}
+    for name, tensor in state.items():
+        value = np.asarray(tensor)
+        parts = name.split(".")
+        if parts[-2:] == ["conv", "weight"]:
+            path = "/".join(parts[:-2]) + "/conv/kernel"
+            out[path] = value.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+        elif parts[-2] == "bn":
+            leaf = {"weight": "scale", "bias": "bias", "running_mean": "mean", "running_var": "var"}.get(parts[-1])
+            if leaf is None:  # num_batches_tracked etc.
+                continue
+            out["/".join(parts[:-2]) + f"/bn/{leaf}"] = value
+        elif parts == ["fc", "weight"]:
+            out["fc/kernel"] = value.T  # (O, I) -> (I, O)
+        elif parts == ["fc", "bias"]:
+            out["fc/bias"] = value
+        elif parts[-1] == "num_batches_tracked":
+            continue
+        else:
+            raise KeyError(f"Unrecognized checkpoint entry {name!r} — not a FID InceptionV3 state dict?")
+    return out
+
+
+def main() -> None:
+    if len(sys.argv) != 3:
+        print(__doc__)
+        raise SystemExit(1)
+    src, dst = sys.argv[1], sys.argv[2]
+    import torch
+
+    state = torch.load(src, map_location="cpu")
+    if isinstance(state, dict) and "state_dict" in state:
+        state = state["state_dict"]
+    converted = convert_state_dict({k: v.numpy() for k, v in state.items()})
+    np.savez(dst, **converted)
+    print(f"Wrote {len(converted)} arrays to {dst}")
+
+
+if __name__ == "__main__":
+    main()
