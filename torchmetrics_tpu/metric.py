@@ -54,6 +54,19 @@ def jit_distributed_available() -> bool:
     return _dist_available()
 
 
+def _trace_annotation(name: str):
+    """``jax.profiler`` trace annotation around update/compute (SURVEY §5.1:
+    the reference has no in-repo tracing; profiler hooks are the TPU-native
+    observability analogue). Enabled with ``TM_TPU_PROFILE=1`` — free when off.
+    """
+    import contextlib
+    import os
+
+    if os.environ.get("TM_TPU_PROFILE", "0") != "1":
+        return contextlib.nullcontext()
+    return jax.profiler.TraceAnnotation(name)
+
+
 _REDUCTION_MAP: Dict[str, Optional[Callable]] = {
     "sum": dim_zero_sum,
     "mean": dim_zero_mean,
@@ -204,7 +217,8 @@ class Metric:
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            update(*args, **kwargs)
+            with _trace_annotation(f"{type(self).__name__}.update"):
+                update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -238,7 +252,7 @@ class Metric:
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ):
+            ), _trace_annotation(f"{type(self).__name__}.compute"):
                 value = _squeeze_if_scalar(compute(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
