@@ -102,3 +102,51 @@ def test_metric_property_suite(name, metric_class, args, batches, sharded):
         rtol=1e-4,
         atol=1e-5,
     )
+
+
+@pytest.mark.parametrize(
+    "name,metric_class,args,batches",
+    [s[:4] for s in _SUITE if s[0] in ("mse", "psnr", "ssim", "snr", "si_sdr")],
+    ids=[s[0] for s in _SUITE if s[0] in ("mse", "psnr", "ssim", "snr", "si_sdr")],
+)
+def test_bf16_inputs_give_close_results(name, metric_class, args, batches):
+    """Metrics accept bfloat16 inputs (the TPU-native reduced precision; the
+    analogue of the reference's half-precision pass, testers.py:484-550)."""
+    import jax.numpy as jnp
+
+    full = metric_class(**args)
+    half = metric_class(**args)
+    for batch in batches:
+        full.update(*batch)
+        half.update(*[
+            jnp.asarray(b).astype(jnp.bfloat16) if np.issubdtype(np.asarray(b).dtype, np.floating) else b
+            for b in batch
+        ])
+    a, b = np.asarray(full.compute(), np.float64), np.asarray(half.compute(), np.float64)
+    # bf16 has ~3 decimal digits; accept relative agreement at that level
+    np.testing.assert_allclose(a, b, rtol=0.05, atol=0.05)
+
+
+def test_cross_domain_metric_collection():
+    """One MetricCollection spanning classification + regression metrics
+    routes keyword inputs and dedups compute groups across domains."""
+    from torchmetrics_tpu.classification.precision_recall import MulticlassPrecision, MulticlassRecall
+
+    coll = tm.MetricCollection(
+        {
+            "precision": MulticlassPrecision(num_classes=5, average="macro"),
+            "recall": MulticlassRecall(num_classes=5, average="macro"),
+            "mse": tm.MeanSquaredError(),
+        }
+    )
+    rng = np.random.RandomState(3)
+    for _ in range(3):
+        preds = rng.randint(0, 5, 64)
+        target = rng.randint(0, 5, 64)
+        coll.update(preds=preds, target=target)
+    out = coll.compute()
+    assert set(out) == {"precision", "recall", "mse"}
+    assert all(np.isfinite(float(out[k])) for k in out)
+    # compute groups: precision + recall share the stat-scores state, mse doesn't
+    groups = [sorted(names) for names in coll.compute_groups.values()]
+    assert sorted(groups) == [["mse"], ["precision", "recall"]]

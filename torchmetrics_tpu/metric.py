@@ -54,17 +54,24 @@ def jit_distributed_available() -> bool:
     return _dist_available()
 
 
-def _trace_annotation(name: str):
+import contextlib as _contextlib
+import os as _os
+
+# read once: profiling is an operator decision made before the process starts
+_PROFILE_ENABLED = _os.environ.get("TM_TPU_PROFILE", "0") == "1"
+_NULL_CONTEXT = _contextlib.nullcontext()
+
+
+def _trace_annotation(obj: Any, phase: str):
     """``jax.profiler`` trace annotation around update/compute (SURVEY §5.1:
     the reference has no in-repo tracing; profiler hooks are the TPU-native
-    observability analogue). Enabled with ``TM_TPU_PROFILE=1`` — free when off.
+    observability analogue). Enabled with ``TM_TPU_PROFILE=1`` **set before
+    the library is imported** (read once at import; a per-call env lookup on
+    every update would tax the hot path) — free when off.
     """
-    import contextlib
-    import os
-
-    if os.environ.get("TM_TPU_PROFILE", "0") != "1":
-        return contextlib.nullcontext()
-    return jax.profiler.TraceAnnotation(name)
+    if not _PROFILE_ENABLED:
+        return _NULL_CONTEXT
+    return jax.profiler.TraceAnnotation(f"{type(obj).__name__}.{phase}")
 
 
 _REDUCTION_MAP: Dict[str, Optional[Callable]] = {
@@ -217,7 +224,7 @@ class Metric:
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            with _trace_annotation(f"{type(self).__name__}.update"):
+            with _trace_annotation(self, "update"):
                 update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
@@ -252,7 +259,7 @@ class Metric:
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
-            ), _trace_annotation(f"{type(self).__name__}.compute"):
+            ), _trace_annotation(self, "compute"):
                 value = _squeeze_if_scalar(compute(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
