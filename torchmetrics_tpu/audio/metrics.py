@@ -13,7 +13,6 @@ import jax
 import jax.numpy as jnp
 
 from torchmetrics_tpu.functional.audio.callbacks import (
-    _GAMMATONE_AVAILABLE,
     _LIBROSA_AVAILABLE,
     _ONNXRUNTIME_AVAILABLE,
     _PESQ_AVAILABLE,
@@ -21,8 +20,8 @@ from torchmetrics_tpu.functional.audio.callbacks import (
     deep_noise_suppression_mean_opinion_score,
     perceptual_evaluation_speech_quality,
     short_time_objective_intelligibility,
-    speech_reverberation_modulation_energy_ratio,
 )
+from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
 from torchmetrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,
@@ -242,21 +241,39 @@ class ShortTimeObjectiveIntelligibility(_AveragedAudioMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_AveragedAudioMetric):
-    """SRMR (reference ``audio/srmr.py:37``) — host-callback backed."""
+    """SRMR (reference ``audio/srmr.py:37``) — implemented natively in JAX
+    (no gammatone/torchaudio dependency, unlike the reference)."""
 
     is_differentiable = False
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: Any = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
-        if not _GAMMATONE_AVAILABLE:
-            raise ModuleNotFoundError(
-                "SpeechReverberationModulationEnergyRatio metric requires that gammatone is installed."
-                " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
-            )
+        from torchmetrics_tpu.functional.audio.srmr import _srmr_arg_validate
+
+        _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
         self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
 
     def update(self, preds: Array) -> None:  # type: ignore[override]
-        value = speech_reverberation_modulation_energy_ratio(preds, self.fs)
+        value = speech_reverberation_modulation_energy_ratio(
+            preds, self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf, self.max_cf, self.norm, self.fast
+        )
+        value = jnp.atleast_1d(value)
         self.sum_value = self.sum_value + value.sum()
         self.total = self.total + value.size
 

@@ -1,10 +1,10 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Host-callback audio metrics: PESQ, STOI, SRMR, DNSMOS.
+"""Host-callback audio metrics: PESQ, STOI, DNSMOS.
 
 These wrap inherently host-native DSP/inference backends (the C ``pesq``
-library, ``pystoi``, gammatone filterbanks, onnxruntime — reference
-``functional/audio/{pesq,stoi,srmr,dnsmos}.py``) behind a clean
+library, ``pystoi``, onnxruntime — reference
+``functional/audio/{pesq,stoi,dnsmos}.py``) behind a clean
 ``jax.pure_callback`` boundary so a jitted evaluation graph stays pure. Each
 raises ``ModuleNotFoundError`` when its backend isn't installed, exactly like
 the reference's import gates.
@@ -24,7 +24,6 @@ Array = jax.Array
 
 _PESQ_AVAILABLE = ModuleAvailableCache("pesq")
 _PYSTOI_AVAILABLE = ModuleAvailableCache("pystoi")
-_GAMMATONE_AVAILABLE = ModuleAvailableCache("gammatone")
 _ONNXRUNTIME_AVAILABLE = ModuleAvailableCache("onnxruntime")
 _LIBROSA_AVAILABLE = ModuleAvailableCache("librosa")
 
@@ -89,26 +88,6 @@ def short_time_objective_intelligibility(
         return np.asarray(scores, np.float32).reshape(preds_np.shape[:-1])
 
     return _batch_callback(host_fn, preds, target, preds.shape[:-1])
-
-
-def speech_reverberation_modulation_energy_ratio(
-    preds: Array,
-    fs: int,
-    n_cochlear_filters: int = 23,
-    low_freq: float = 125,
-    min_cf: float = 4,
-    max_cf: Optional[float] = None,
-    norm: bool = False,
-    fast: bool = False,
-) -> Array:
-    """SRMR via the gammatone filterbank on host (reference
-    ``functional/audio/srmr.py:37-233``)."""
-    if not (_GAMMATONE_AVAILABLE):
-        raise ModuleNotFoundError(
-            "speech_reverberation_modulation_energy_ratio requires that gammatone is installed."
-            " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
-        )
-    raise NotImplementedError  # pragma: no cover - unreachable without gammatone
 
 
 def deep_noise_suppression_mean_opinion_score(

@@ -1,0 +1,292 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Speech-to-reverberation modulation energy ratio, implemented natively.
+
+The reference (``functional/audio/srmr.py:177-305``) translates the SRMR
+toolbox into torch but still requires the ``gammatone`` package for ERB
+filter coefficients and ``torchaudio`` for IIR filtering. Here both are
+native: the Slaney ERB gammatone filter design (Apple TR #35 / Glasberg &
+Moore parameters — the same published formulas ``gammatone.filters``
+implements) runs in numpy at setup, and the biquad cascades run as a single
+``lax.scan`` over time, vectorized across batch × cochlear × modulation
+channels — so SRMR needs no optional dependencies at all.
+
+Pipeline (Falk et al., 2010): ERB gammatone filterbank → Hilbert envelope →
+8-band modulation filterbank (Q=2) → windowed modulation energy (256 ms / 64
+ms hop, Hamming) → ratio of low (bands 1-4) to high (bands 5..K*) modulation
+energy, with K* chosen from the 90%-energy ERB bandwidth.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from math import ceil, pi
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_EAR_Q = 9.26449  # Glasberg and Moore parameters
+_MIN_BW = 24.7
+
+
+def _erb_space(low_freq: float, high_freq: float, n: int) -> np.ndarray:
+    """ERB-spaced centre frequencies, descending (Slaney ERBSpace)."""
+    c = _EAR_Q * _MIN_BW
+    return -c + np.exp(
+        np.arange(1, n + 1) * (-np.log(high_freq + c) + np.log(low_freq + c)) / n
+    ) * (high_freq + c)
+
+
+@lru_cache(maxsize=100)
+def _calc_erbs(low_freq: float, fs: int, n_filters: int) -> np.ndarray:
+    """Equivalent rectangular bandwidths of the filterbank channels
+    (reference ``srmr.py:38-47``)."""
+    cfs = _erb_space(low_freq, fs / 2, n_filters)
+    return (cfs / _EAR_Q) + _MIN_BW
+
+
+@lru_cache(maxsize=100)
+def _make_erb_filters(fs: int, num_freqs: int, cutoff: float) -> np.ndarray:
+    """Slaney gammatone filter coefficients ``(N, 10)``:
+    ``A0, A11, A12, A13, A14, A2, B0, B1, B2, gain`` (the published design
+    ``gammatone.filters.make_erb_filters`` evaluates)."""
+    cf = _erb_space(cutoff, fs / 2, num_freqs)
+    t = 1.0 / fs
+    erb = ((cf / _EAR_Q) ** 1 + _MIN_BW**1) ** 1
+    b = 1.019 * 2 * np.pi * erb
+
+    arg = 2 * cf * np.pi * t
+    vec = np.exp(2j * arg)
+
+    a0 = t
+    a2 = 0.0
+    b0 = 1.0
+    b1 = -2 * np.cos(arg) / np.exp(b * t)
+    b2 = np.exp(-2 * b * t)
+
+    rt_pos = np.sqrt(3 + 2**1.5)
+    rt_neg = np.sqrt(3 - 2**1.5)
+
+    common = -t * np.exp(-(b * t))
+    k11 = np.cos(arg) + rt_pos * np.sin(arg)
+    k12 = np.cos(arg) - rt_pos * np.sin(arg)
+    k13 = np.cos(arg) + rt_neg * np.sin(arg)
+    k14 = np.cos(arg) - rt_neg * np.sin(arg)
+    a11 = common * k11
+    a12 = common * k12
+    a13 = common * k13
+    a14 = common * k14
+
+    gain_arg = np.exp(1j * arg - b * t)
+    gain = np.abs(
+        (vec - gain_arg * k11)
+        * (vec - gain_arg * k12)
+        * (vec - gain_arg * k13)
+        * (vec - gain_arg * k14)
+        * (t / (-np.exp(-2 * b * t) - vec + (1 + vec) * np.exp(-b * t))) ** 4
+    )
+
+    n = len(cf)
+    coefs = np.zeros((n, 10))
+    coefs[:, 0] = a0
+    coefs[:, 1] = a11
+    coefs[:, 2] = a12
+    coefs[:, 3] = a13
+    coefs[:, 4] = a14
+    coefs[:, 5] = a2
+    coefs[:, 6] = b0
+    coefs[:, 7] = b1
+    coefs[:, 8] = b2
+    coefs[:, 9] = gain
+    return coefs
+
+
+def _biquad(x: Array, b: Array, a: Array) -> Array:
+    """IIR biquad along the last axis (transposed direct form II) as one
+    ``lax.scan`` over time; ``b``/``a`` shape ``(..., 3)`` broadcasting over
+    the leading axes of ``x``."""
+    b = b / a[..., 0:1]
+    a = a / a[..., 0:1]
+    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
+    a1, a2 = a[..., 1], a[..., 2]
+
+    def step(carry, x_t):
+        z1, z2 = carry
+        y_t = b0 * x_t + z1
+        z1_new = b1 * x_t - a1 * y_t + z2
+        z2_new = b2 * x_t - a2 * y_t
+        return (z1_new, z2_new), y_t
+
+    x_t_first = jnp.moveaxis(x, -1, 0)  # (time, ...)
+    zeros = jnp.zeros_like(x_t_first[0])
+    _, y = jax.lax.scan(step, (zeros, zeros), x_t_first)
+    return jnp.moveaxis(y, 0, -1)
+
+
+def _erb_filterbank(wave: Array, coefs: np.ndarray) -> Array:
+    """4-stage gammatone cascade (reference ``srmr.py:116-144``):
+    ``wave (B, time)`` -> ``(B, N, time)``."""
+    n = coefs.shape[0]
+    x = jnp.broadcast_to(wave[:, None, :], (wave.shape[0], n, wave.shape[-1]))
+    bs = jnp.asarray(coefs[:, 6:9], jnp.float32)  # B0 B1 B2 (the a-side here)
+    gain = jnp.asarray(coefs[:, 9], jnp.float32)
+    for idx in (1, 2, 3, 4):
+        num = jnp.asarray(np.stack([coefs[:, 0], coefs[:, idx], coefs[:, 5]], axis=-1), jnp.float32)
+        x = _biquad(x, num, bs)
+    return x / gain[None, :, None]
+
+
+def _hilbert_envelope(x: Array) -> Array:
+    """|analytic signal| via FFT (reference ``srmr.py:91-113``)."""
+    time = x.shape[-1]
+    n = time if time % 16 == 0 else ceil(time / 16) * 16
+    x_fft = jnp.fft.fft(x, n=n, axis=-1)
+    h = np.zeros(n)
+    if n % 2 == 0:
+        h[0] = h[n // 2] = 1
+        h[1 : n // 2] = 2
+    else:
+        h[0] = 1
+        h[1 : (n + 1) // 2] = 2
+    y = jnp.fft.ifft(x_fft * jnp.asarray(h), axis=-1)
+    return jnp.abs(y[..., :time])
+
+
+@lru_cache(maxsize=100)
+def _modulation_filterbank_and_cutoffs(min_cf: float, max_cf: float, n: int, fs: float, q: int):
+    """Second-order bandpass bank + 3 dB cutoffs (reference ``srmr.py:58-88``)."""
+    spacing_factor = (max_cf / min_cf) ** (1.0 / (n - 1))
+    cfs = min_cf * spacing_factor ** np.arange(n)
+    w0 = 2 * pi * cfs / fs
+    w0t = np.tan(w0 / 2)
+    b0 = w0t / q
+    b = np.stack([b0, np.zeros(n), -b0], axis=-1)
+    a = np.stack([1 + b0 + w0t**2, 2 * w0t**2 - 2, 1 - b0 + w0t**2], axis=-1)
+    lower = cfs - b0 * fs / (2 * pi)
+    upper = cfs + b0 * fs / (2 * pi)
+    return cfs, b, a, lower, upper
+
+
+def _srmr_arg_validate(
+    fs: int,
+    n_cochlear_filters: int,
+    low_freq: float,
+    min_cf: float,
+    max_cf: Optional[float],
+    norm: bool,
+    fast: bool,
+) -> None:
+    """Validate arguments (reference ``srmr.py:308-340``)."""
+    if not (isinstance(fs, int) and fs > 0):
+        raise ValueError(f"Expected argument `fs` to be a positive int, but got {fs}")
+    if not (isinstance(n_cochlear_filters, int) and n_cochlear_filters > 0):
+        raise ValueError(
+            f"Expected argument `n_cochlear_filters` to be a positive int, but got {n_cochlear_filters}"
+        )
+    if not ((isinstance(low_freq, (float, int))) and low_freq > 0):
+        raise ValueError(f"Expected argument `low_freq` to be a positive float, but got {low_freq}")
+    if not ((isinstance(min_cf, (float, int))) and min_cf > 0):
+        raise ValueError(f"Expected argument `min_cf` to be a positive float, but got {min_cf}")
+    if max_cf is not None and not ((isinstance(max_cf, (float, int))) and max_cf > 0):
+        raise ValueError(f"Expected argument `max_cf` to be a positive float, but got {max_cf}")
+    if not isinstance(norm, bool):
+        raise ValueError("Expected argument `norm` to be a bool value")
+    if not isinstance(fast, bool):
+        raise ValueError("Expected argument `fast` to be a bool value")
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: Optional[float] = None,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """SRMR (reference ``srmr.py:177-305``; the ``fast`` gammatonegram path is
+    not replicated — the exact filterbank runs fast enough on TPU)."""
+    _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
+    if fast:
+        from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(
+            "`fast=True` is accepted for API parity but the exact ERB filterbank is used;"
+            " values equal the fast=False result, not the reference's gammatonegram approximation.",
+            UserWarning,
+        )
+    preds = jnp.asarray(preds)
+    shape = preds.shape
+    preds = preds.reshape(1, -1) if preds.ndim == 1 else preds.reshape(-1, shape[-1])
+    num_batch, time = preds.shape
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32) / jnp.iinfo(preds.dtype).max
+
+    # normalize into [-1, 1] like the reference's lfilter precondition
+    max_vals = jnp.abs(preds).max(axis=-1, keepdims=True)
+    preds = preds / jnp.where(max_vals > 1, max_vals, 1.0)
+
+    w_length_s, w_inc_s = 0.256, 0.064
+    fcoefs = _make_erb_filters(fs, n_cochlear_filters, low_freq)
+    gt_env = _hilbert_envelope(_erb_filterbank(preds, fcoefs))  # (B, N, time)
+    mfs = float(fs)
+
+    w_length = ceil(w_length_s * mfs)
+    w_inc = ceil(w_inc_s * mfs)
+
+    if max_cf is None:
+        max_cf = 30 if norm else 128
+    _, mf_b, mf_a, cutoffs_lower, _ = _modulation_filterbank_and_cutoffs(min_cf, max_cf, 8, mfs, 2)
+
+    # modulation filterbank over envelopes: (B, N, 8, time)
+    env8 = jnp.broadcast_to(gt_env[:, :, None, :], (*gt_env.shape[:2], 8, gt_env.shape[-1]))
+    mod_out = _biquad(env8, jnp.asarray(mf_b, jnp.float32), jnp.asarray(mf_a, jnp.float32))
+
+    num_frames = int(1 + (time - w_length) // w_inc) if time >= w_length else 1
+    pad = max(ceil(time / w_inc) * w_inc - time, w_length - time)
+    mod_out = jnp.pad(mod_out, ((0, 0), (0, 0), (0, 0), (0, pad)))
+    # periodic Hamming window (torch.hamming_window default), matching the
+    # reference's hamming_window(w_length + 1)[:-1]
+    window = jnp.asarray(np.hamming(w_length + 2)[:w_length], jnp.float32)
+    # windowed frame energy == strided correlation of mod_out² with window²:
+    # Σ_j (frame[j]·w[j])² = Σ_j frame[j]²·w[j]² — no frames materialized
+    b_, n_, m_, t_ = mod_out.shape
+    sq = (mod_out ** 2).reshape(b_ * n_ * m_, 1, t_)
+    kernel = (window ** 2).reshape(1, 1, w_length)
+    energy = jax.lax.conv_general_dilated(
+        sq, kernel, window_strides=(w_inc,), padding="VALID",
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    ).reshape(b_, n_, m_, -1)[..., :num_frames]  # (B, N, 8, num_frames)
+
+    if norm:
+        peak = energy.mean(axis=1, keepdims=True).max(axis=2, keepdims=True).max(axis=3, keepdims=True)
+        floor = peak * 10.0 ** (-30.0 / 10.0)
+        energy = jnp.clip(energy, floor, peak)
+
+    erbs = np.flipud(_calc_erbs(low_freq, fs, n_cochlear_filters))  # ascending
+
+    avg_energy = energy.mean(axis=-1)  # (B, N, 8)
+    total_energy = avg_energy.reshape(num_batch, -1).sum(axis=-1)
+    ac_energy = avg_energy.sum(axis=2)  # (B, N)
+    ac_perc = ac_energy * 100 / total_energy[:, None]
+    ac_perc_cumsum = jnp.flip(ac_perc, -1).cumsum(-1)
+    k90perc_idx = jnp.argmax((ac_perc_cumsum > 90).astype(jnp.int32), axis=-1)
+    bw = jnp.asarray(erbs.copy())[k90perc_idx]  # (B,)
+
+    cutoffs = jnp.asarray(cutoffs_lower)
+    # K* per sample from the 90%-energy bandwidth vs modulation cutoffs
+    # (reference _cal_srmr_score): count how many of cutoffs[4..7] are <= bw
+    kstar = 4 + (cutoffs[4:8][None, :] <= bw[:, None]).sum(axis=-1)  # in 5..8
+    if bool((np.asarray(kstar) < 5).any()):
+        raise ValueError("Something wrong with the cutoffs compared to bw values.")
+    low_e = avg_energy[:, :, :4].sum(axis=(1, 2))
+    # high energy = sum over mod bands 4..kstar-1 (exclusive of kstar)
+    band_idx = jnp.arange(8)
+    high_mask = (band_idx[None, :] >= 4) & (band_idx[None, :] < kstar[:, None])
+    high_e = (avg_energy.sum(axis=1) * high_mask).sum(axis=-1)
+    score = low_e / high_e
+    return score.reshape(*shape[:-1]) if len(shape) > 1 else score.reshape(())
