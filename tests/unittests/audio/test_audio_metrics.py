@@ -208,14 +208,73 @@ def test_pit_validation_errors():
 
 
 def test_callback_metrics_gated_when_backend_missing():
-    from torchmetrics_tpu.functional.audio.callbacks import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+    from torchmetrics_tpu.functional.audio.callbacks import _PESQ_AVAILABLE
 
     if not _PESQ_AVAILABLE:
         with pytest.raises(ModuleNotFoundError, match="pesq"):
             FA.perceptual_evaluation_speech_quality(np.zeros(8000), np.zeros(8000), 8000, "nb")
-    if not _PYSTOI_AVAILABLE:
-        with pytest.raises(ModuleNotFoundError, match="pystoi"):
-            FA.short_time_objective_intelligibility(np.zeros(8000), np.zeros(8000), 8000)
+
+
+def _broadband_speechlike(n, fs, seed=1):
+    rng = _rng(seed)
+    t = np.arange(n) / fs
+    spec = np.fft.rfft(rng.randn(n))
+    freqs = np.fft.rfftfreq(n, 1 / fs)
+    spec *= 1.0 / np.maximum(freqs, 50) ** 0.5
+    carrier = np.fft.irfft(spec, n)
+    envelope = 0.3 + 0.7 * (0.5 + 0.5 * np.sin(2 * np.pi * 4 * t))
+    x = carrier * envelope
+    return (x / np.abs(x).max()).astype(np.float64)
+
+
+def test_stoi_native_properties():
+    """Native STOI: exactly 1 on identical signals, monotone in SNR, with the
+    published psychometric range on broadband modulated signals."""
+    from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
+
+    fs = 10000
+    clean = _broadband_speechlike(3 * fs, fs)
+    np.testing.assert_allclose(float(FA.short_time_objective_intelligibility(clean, clean, fs)), 1.0, atol=1e-6)
+
+    rng = _rng(2)
+    scores = []
+    for snr in (30, 10, 0, -5):
+        noise = rng.randn(len(clean))
+        noise *= np.linalg.norm(clean) / np.linalg.norm(noise) / (10 ** (snr / 20))
+        scores.append(float(FA.short_time_objective_intelligibility(clean + noise, clean, fs)))
+    assert scores[0] > 0.99  # near-clean
+    assert all(a > b for a, b in zip(scores, scores[1:])), scores  # monotone in SNR
+    assert scores[-1] < 0.6  # heavily degraded
+
+    # extended variant runs and is also monotone at the extremes
+    est_hi = float(FA.short_time_objective_intelligibility(clean, clean, fs, extended=True))
+    noise = rng.randn(len(clean))
+    noise *= np.linalg.norm(clean) / np.linalg.norm(noise)
+    est_lo = float(FA.short_time_objective_intelligibility(clean + noise, clean, fs, extended=True))
+    assert est_hi > 0.99 and est_lo < est_hi
+
+    # resampling path (fs != 10k) + module streaming
+    clean16 = _broadband_speechlike(3 * 16000, 16000, seed=3)
+    deg16 = clean16 + 0.1 * _rng(4).randn(len(clean16))
+    val = float(FA.short_time_objective_intelligibility(deg16, clean16, 16000))
+    assert 0 < val <= 1
+    metric = ShortTimeObjectiveIntelligibility(fs=fs)
+    metric.update(np.stack([clean, clean]), np.stack([clean, clean]))
+    np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)
+
+
+@pytest.mark.skipif(
+    not __import__("importlib").util.find_spec("pystoi"), reason="pystoi not installed (parity oracle)"
+)
+def test_stoi_matches_pystoi():
+    from pystoi import stoi as pystoi_fn
+
+    fs = 10000
+    clean = _broadband_speechlike(3 * fs, fs)
+    deg = clean + 0.2 * _rng(5).randn(len(clean))
+    ours = float(FA.short_time_objective_intelligibility(deg, clean, fs))
+    ref = pystoi_fn(clean, deg, fs)
+    np.testing.assert_allclose(ours, ref, atol=0.01)
 
 
 def test_srmr_native_properties():

@@ -16,11 +16,10 @@ from torchmetrics_tpu.functional.audio.callbacks import (
     _LIBROSA_AVAILABLE,
     _ONNXRUNTIME_AVAILABLE,
     _PESQ_AVAILABLE,
-    _PYSTOI_AVAILABLE,
     deep_noise_suppression_mean_opinion_score,
     perceptual_evaluation_speech_quality,
-    short_time_objective_intelligibility,
 )
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
 from torchmetrics_tpu.functional.audio.sdr import (
@@ -222,22 +221,18 @@ class PerceptualEvaluationSpeechQuality(_AveragedAudioMetric):
 
 
 class ShortTimeObjectiveIntelligibility(_AveragedAudioMetric):
-    """STOI (reference ``audio/stoi.py:29``) — host-callback backed."""
+    """STOI (reference ``audio/stoi.py:29``) — implemented natively (no
+    ``pystoi`` dependency, unlike the reference)."""
 
     is_differentiable = False
 
     def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not _PYSTOI_AVAILABLE:
-            raise ModuleNotFoundError(
-                "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
-                " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
-            )
         self.fs = fs
         self.extended = extended
 
     def _metric(self, preds: Array, target: Array) -> Array:
-        return short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        return jnp.atleast_1d(short_time_objective_intelligibility(preds, target, self.fs, self.extended))
 
 
 class SpeechReverberationModulationEnergyRatio(_AveragedAudioMetric):

@@ -1,10 +1,10 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Host-callback audio metrics: PESQ, STOI, DNSMOS.
+"""Host-callback audio metrics: PESQ and DNSMOS.
 
 These wrap inherently host-native DSP/inference backends (the C ``pesq``
-library, ``pystoi``, onnxruntime — reference
-``functional/audio/{pesq,stoi,dnsmos}.py``) behind a clean
+library and onnxruntime — reference ``functional/audio/{pesq,dnsmos}.py``)
+behind a clean
 ``jax.pure_callback`` boundary so a jitted evaluation graph stays pure. Each
 raises ``ModuleNotFoundError`` when its backend isn't installed, exactly like
 the reference's import gates.
@@ -23,7 +23,6 @@ from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
 Array = jax.Array
 
 _PESQ_AVAILABLE = ModuleAvailableCache("pesq")
-_PYSTOI_AVAILABLE = ModuleAvailableCache("pystoi")
 _ONNXRUNTIME_AVAILABLE = ModuleAvailableCache("onnxruntime")
 _LIBROSA_AVAILABLE = ModuleAvailableCache("librosa")
 
@@ -63,28 +62,6 @@ def perceptual_evaluation_speech_quality(
         p = np.asarray(preds_np, np.float32).reshape(-1, preds_np.shape[-1])
         t = np.asarray(target_np, np.float32).reshape(-1, target_np.shape[-1])
         scores = [pesq_backend.pesq(fs, tt, pp, mode) for pp, tt in zip(p, t)]
-        return np.asarray(scores, np.float32).reshape(preds_np.shape[:-1])
-
-    return _batch_callback(host_fn, preds, target, preds.shape[:-1])
-
-
-def short_time_objective_intelligibility(
-    preds: Array, target: Array, fs: int, extended: bool = False, keep_same_device: bool = False
-) -> Array:
-    """STOI via ``pystoi`` on host (reference ``functional/audio/stoi.py:25-96``)."""
-    if not _PYSTOI_AVAILABLE:
-        raise ModuleNotFoundError(
-            "STOI metric requires that pystoi is installed. Either install as `pip install torchmetrics[audio]`"
-            " or `pip install pystoi`."
-        )
-    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
-
-    def host_fn(preds_np, target_np):
-        from pystoi import stoi as stoi_backend
-
-        p = np.asarray(preds_np, np.float64).reshape(-1, preds_np.shape[-1])
-        t = np.asarray(target_np, np.float64).reshape(-1, target_np.shape[-1])
-        scores = [stoi_backend(tt, pp, fs, extended) for pp, tt in zip(p, t)]
         return np.asarray(scores, np.float32).reshape(preds_np.shape[:-1])
 
     return _batch_callback(host_fn, preds, target, preds.shape[:-1])
