@@ -1,0 +1,57 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Checkpoint/resume via pytree serialization (SURVEY §5.4: metric states are
+pytrees, so orbax/msgpack checkpointing comes for free — the analogue of the
+reference's nn.Module state-dict protocol tests,
+``tests/unittests/bases/test_saving_loading.py``)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.classification.accuracy import MulticlassAccuracy
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    """A metric's state tree checkpoints and restores through orbax."""
+    ocp = pytest.importorskip("orbax.checkpoint")
+
+    metric = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        metric.update(rng.randint(0, 5, 64), rng.randint(0, 5, 64))
+    expected = float(metric.compute())
+
+    ckpt = {"state": metric.state_tree(), "update_count": metric._update_count}
+    checkpointer = ocp.PyTreeCheckpointer()
+    path = tmp_path / "metric_ckpt"
+    checkpointer.save(str(path), ckpt)
+
+    restored = checkpointer.restore(str(path))
+    fresh = MulticlassAccuracy(num_classes=5)
+    fresh.load_state_tree({k: jnp.asarray(v) for k, v in restored["state"].items()})
+    fresh._update_count = int(restored["update_count"])
+    np.testing.assert_allclose(float(fresh.compute()), expected, rtol=1e-6)
+
+    # resumed metric keeps accumulating correctly
+    extra_p, extra_t = rng.randint(0, 5, 64), rng.randint(0, 5, 64)
+    metric.update(extra_p, extra_t)
+    fresh.update(extra_p, extra_t)
+    np.testing.assert_allclose(float(fresh.compute()), float(metric.compute()), rtol=1e-6)
+
+
+def test_persistent_state_dict_roundtrip_across_domains():
+    """The state-dict protocol works for round-2 domain metrics too."""
+    metric = tm.PanopticQuality(things={0, 1}, stuffs={2}, allow_unknown_preds_category=True)
+    rng = np.random.RandomState(1)
+    metric.update(rng.randint(0, 3, (2, 8, 8, 2)), rng.randint(0, 3, (2, 8, 8, 2)))
+    metric.persistent(True)
+    sd = metric.state_dict()
+    assert set(sd) == {"iou_sum", "true_positives", "false_positives", "false_negatives"}
+    expected = np.asarray(metric.compute())
+
+    fresh = tm.PanopticQuality(things={0, 1}, stuffs={2}, allow_unknown_preds_category=True)
+    fresh.load_state_dict(sd)
+    fresh._update_count = 1
+    np.testing.assert_allclose(np.asarray(fresh.compute()), expected, rtol=1e-6)
