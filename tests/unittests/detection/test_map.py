@@ -229,3 +229,23 @@ def test_segm_map_module_streaming():
     res = metric.compute()
     np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
     np.testing.assert_allclose(float(res["mar_100"]), 1.0, atol=1e-6)
+
+
+def test_segm_sync_dist_routes_masks_through_object_gather():
+    """RLE mask states survive the distributed sync machinery: tensor states
+    take the pad/trim array gather, mask dicts take the object gather
+    (single-process degenerate case returns the local stream intact)."""
+    from torchmetrics_tpu.utilities.distributed import gather_all_arrays
+
+    boxes = np.array([[10, 10, 50, 50], [60, 60, 110, 110]], np.float64)
+    labels = np.array([0, 1])
+    masks = _boxes_to_masks(boxes)
+    metric = MeanAveragePrecision(iou_type="segm", sync_on_compute=False)
+    metric.update(
+        [{"masks": masks, "scores": np.array([0.9, 0.8]), "labels": labels}],
+        [{"masks": masks, "labels": labels}],
+    )
+    metric._sync_dist(gather_all_arrays)
+    assert len(metric.detection_mask) == 1 and len(metric.groundtruth_mask) == 1
+    res = metric.compute()
+    np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)

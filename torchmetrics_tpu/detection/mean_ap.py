@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.functional.detection.helpers import _input_validator, _validate_iou_type_arg
+from torchmetrics_tpu.utilities.distributed import gather_all_arrays
 from torchmetrics_tpu.functional.detection.map import (
     DEFAULT_IOU_THRESHOLDS,
     DEFAULT_MAX_DETECTIONS,
@@ -181,6 +182,27 @@ class MeanAveragePrecision(Metric):
             average=self.average,
             iou_type=self.iou_type[0],
         )
+
+    def _sync_dist(self, dist_sync_fn=gather_all_arrays, process_group=None) -> None:
+        """Multi-host sync: tensor states ride the generic pad/trim gather,
+        RLE mask states (Python dicts, not arrays) go through the host
+        object gather — the analogue of the reference's
+        ``all_gather_object`` path (``mean_ap.py:1029-1061``)."""
+        from torchmetrics_tpu.utilities.distributed import gather_all_objects
+
+        mask_states = {}
+        for attr in ("detection_mask", "groundtruth_mask"):
+            mask_states[attr] = getattr(self, attr)
+            setattr(self, attr, [])  # hide from the array gather
+        try:
+            super()._sync_dist(dist_sync_fn=dist_sync_fn, process_group=process_group)
+        finally:
+            for attr, local in mask_states.items():
+                gathered = gather_all_objects(local)
+                merged: list = []
+                for proc_masks in gathered:
+                    merged.extend(proc_masks)
+                setattr(self, attr, merged)
 
     def plot(self, val=None, ax=None):
         return self._plot(val, ax)
