@@ -237,17 +237,18 @@ def main() -> None:
         ref_sps = RECORDED_BASELINE_SPS
         baseline_live = False
 
-    # secondary workloads (SSIM, retrieval NDCG, COCO mAP); baselines are the
+    # secondary workloads (SSIM, retrieval NDCG, COCO mAP, FID inception); baselines are the
     # reference TorchMetrics on torch-CPU (this image has no CUDA build) and
     # are labelled as such — see BASELINE.md for the CUDA measurement plan
     extras = {}
     try:
-        from bench_workloads import bench_coco_map, bench_retrieval_ndcg, bench_ssim
+        from bench_workloads import bench_coco_map, bench_fid, bench_retrieval_ndcg, bench_ssim
 
         for name, fn, args in (
             ("ssim", bench_ssim, (max(4, n_batches // 2),)),
             ("retrieval_ndcg", bench_retrieval_ndcg, (max(4, n_batches // 2),)),
             ("coco_map", bench_coco_map, ()),
+            ("fid_inception", bench_fid, (max(4, n_batches // 2),)),
         ):
             try:
                 ours, baseline, unit = fn(*args)

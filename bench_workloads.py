@@ -1,6 +1,6 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Extra benchmark workloads used by ``bench.py``: SSIM, retrieval NDCG, COCO mAP.
+"""Extra benchmark workloads used by ``bench.py``: SSIM, retrieval NDCG, COCO mAP, FID inception.
 
 Each returns (ours_throughput, baseline_throughput_or_None, unit). Baselines
 run the reference TorchMetrics on torch — the CPU build shipped in this image
@@ -140,4 +140,42 @@ def bench_coco_map() -> Tuple[float, Optional[float], str]:
     t0 = time.perf_counter()
     coco_mean_average_precision(preds, target)
     ours = MAP_IMAGES / (time.perf_counter() - t0)
+    return ours, None, "images/s"
+
+
+def bench_fid(n_batches: int = 8) -> Tuple[float, Optional[float], str]:
+    """Images/sec of the FID pipeline: Flax InceptionV3 feature extraction
+    (the FLOP-dominant part of FID-50k) + streaming sum/cov updates on device.
+    The final d×d trace-sqrt runs once per evaluation on host (~seconds at
+    d=2048) and is excluded like pycocotools excludes dataset loading."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.image.backbones.inception import FIDInceptionV3
+
+    batch = 16
+    module = FIDInceptionV3(features_list=("2048",))
+    imgs0 = (jax.random.uniform(jax.random.key(0), (batch, 3, 299, 299)) * 255).astype(jnp.uint8)
+    variables = module.init(jax.random.PRNGKey(0), imgs0)
+
+    @jax.jit
+    def run(variables, imgs_stream):
+        def step(carry, imgs):
+            s, c, n = carry
+            feats = module.apply(variables, imgs)["2048"]
+            return (s + feats.sum(0), c + feats.T @ feats, n + feats.shape[0]), None
+
+        init = (jnp.zeros(2048), jnp.zeros((2048, 2048)), jnp.asarray(0))
+        (s, c, n), _ = jax.lax.scan(step, init, imgs_stream)
+        return s, c, n
+
+    stream = (
+        jax.random.uniform(jax.random.key(1), (n_batches, batch, 3, 299, 299)) * 255
+    ).astype(jnp.uint8)
+    out = run(variables, stream)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = run(variables, stream)
+    float(out[2])  # forced materialization
+    ours = n_batches * batch / (time.perf_counter() - t0)
     return ours, None, "images/s"
