@@ -249,3 +249,84 @@ def test_segm_sync_dist_routes_masks_through_object_gather():
     assert len(metric.detection_mask) == 1 and len(metric.groundtruth_mask) == 1
     res = metric.compute()
     np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [13, 21])
+def test_map_matches_oracle_larger_configs(seed):
+    # robustness at larger scales (more images/detections/classes)
+    rng = np.random.RandomState(seed)
+    preds, target = _make_dataset(rng, n_imgs=16, n_classes=7, max_gt=20, max_det=30, crowd_frac=0.15)
+    expected = coco_eval_oracle(preds, target)
+    got = coco_mean_average_precision(preds, target)
+    for key in KEYS:
+        np.testing.assert_allclose(
+            float(got[key]), expected[key], rtol=1e-5, atol=1e-6, err_msg=f"{key} (seed={seed})"
+        )
+
+
+def test_coco_json_roundtrip(tmp_path):
+    """tm_to_coco -> coco_to_tm preserves the evaluation result."""
+    import os
+
+    rng = np.random.RandomState(8)
+    preds, target = _make_dataset(rng, n_imgs=4, crowd_frac=0.2)
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    expected = metric.compute()
+
+    name = str(tmp_path / "roundtrip")
+    metric.tm_to_coco(name)
+    assert os.path.exists(f"{name}_preds.json") and os.path.exists(f"{name}_target.json")
+    preds2, target2 = MeanAveragePrecision.coco_to_tm(f"{name}_preds.json", f"{name}_target.json")
+    metric2 = MeanAveragePrecision()
+    metric2.update(preds2, target2)
+    got = metric2.compute()
+    for key in KEYS:
+        np.testing.assert_allclose(float(got[key]), float(expected[key]), atol=1e-6, err_msg=key)
+
+
+def test_coco_json_roundtrip_segm_and_formats(tmp_path):
+    """Round-trip for segm (compressed-RLE states pass back through update)
+    and for non-xyxy box formats."""
+    from torchmetrics_tpu.functional.detection import mask_utils
+
+    boxes = np.array([[10, 10, 50, 50], [60, 60, 110, 110]], np.float64)
+    labels = np.array([0, 1])
+    masks = _boxes_to_masks(boxes)
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(
+        [{"masks": masks, "scores": np.array([0.9, 0.8]), "labels": labels}],
+        [{"masks": masks, "labels": labels}],
+    )
+    expected = metric.compute()
+    name = str(tmp_path / "segm")
+    metric.tm_to_coco(name)
+    preds2, target2 = MeanAveragePrecision.coco_to_tm(f"{name}_preds.json", f"{name}_target.json", iou_type="segm")
+    metric2 = MeanAveragePrecision(iou_type="segm")
+    metric2.update(preds2, target2)
+    np.testing.assert_allclose(float(metric2.compute()["map"]), float(expected["map"]), atol=1e-6)
+
+    # compressed string counts decode identically
+    rle = mask_utils.encode(masks[0])
+    s = mask_utils.rle_to_string(rle["counts"])
+    np.testing.assert_array_equal(mask_utils.rle_from_string(s), np.asarray(rle["counts"], np.uint32))
+
+    # xywh metric exports valid xywh COCO boxes
+    metric3 = MeanAveragePrecision(box_format="xywh")
+    metric3.update(
+        [{"boxes": np.array([[10.0, 10.0, 40.0, 40.0]]), "scores": np.array([0.9]), "labels": np.array([0])}],
+        [{"boxes": np.array([[10.0, 10.0, 40.0, 40.0]]), "labels": np.array([0])}],
+    )
+    name3 = str(tmp_path / "xywh")
+    metric3.tm_to_coco(name3)
+    import json
+
+    with open(f"{name3}_preds.json") as f:
+        ann = json.load(f)[0]
+    np.testing.assert_allclose(ann["bbox"], [10.0, 10.0, 40.0, 40.0])  # valid xywh, positive extents
+
+    # mismatched image ids raise instead of silently dropping
+    with open(f"{name3}_preds.json", "w") as f:
+        json.dump([{"image_id": 999, "category_id": 0, "score": 0.5, "bbox": [0, 0, 1, 1]}], f)
+    with pytest.raises(ValueError, match="image_id"):
+        MeanAveragePrecision.coco_to_tm(f"{name3}_preds.json", f"{name3}_target.json")

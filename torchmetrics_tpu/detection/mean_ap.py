@@ -133,9 +133,15 @@ class MeanAveragePrecision(Metric):
         if segm:
             from torchmetrics_tpu.functional.detection import mask_utils
 
+        def _to_rle_list(masks):
+            out = []
+            for m in masks:
+                out.append(m if isinstance(m, dict) else mask_utils.encode(np.asarray(m)))
+            return out
+
         for item in preds:
             if segm:
-                self.detection_mask.append([mask_utils.encode(np.asarray(m)) for m in np.asarray(item["masks"])])
+                self.detection_mask.append(_to_rle_list(item["masks"]))
             else:
                 self.detection_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
             self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32).reshape(-1))
@@ -143,7 +149,7 @@ class MeanAveragePrecision(Metric):
         for item in target:
             n = np.asarray(item["labels"]).size
             if segm:
-                self.groundtruth_mask.append([mask_utils.encode(np.asarray(m)) for m in np.asarray(item["masks"])])
+                self.groundtruth_mask.append(_to_rle_list(item["masks"]))
             else:
                 self.groundtruth_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
             self.groundtruth_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
@@ -203,6 +209,145 @@ class MeanAveragePrecision(Metric):
                 for proc_masks in gathered:
                     merged.extend(proc_masks)
                 setattr(self, attr, merged)
+
+    @staticmethod
+    def coco_to_tm(
+        coco_preds: str,
+        coco_target: str,
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        backend: str = "jax",
+    ) -> Tuple[List[Dict[str, Any]], List[Dict[str, Any]]]:
+        """Convert COCO-format json files into this metric's input dicts
+        (reference ``mean_ap.py:648-757``; parsed directly — no pycocotools).
+
+        ``coco_target`` is a full COCO dataset file (``images`` +
+        ``annotations``); ``coco_preds`` is a results file (bare annotation
+        list or a dict with ``annotations``). Boxes convert xywh -> xyxy.
+        """
+        import json
+
+        iou_type = _validate_iou_type_arg(iou_type)
+        segm = iou_type[0] == "segm"
+        with open(coco_target) as f:
+            gt_data = json.load(f)
+        with open(coco_preds) as f:
+            pred_data = json.load(f)
+        if isinstance(pred_data, dict):
+            pred_data = pred_data.get("annotations", [])
+
+        image_ids = [img["id"] for img in gt_data.get("images", [])]
+        if not image_ids:
+            image_ids = sorted({a["image_id"] for a in gt_data.get("annotations", [])})
+
+        def group(annotations, with_scores):
+            from torchmetrics_tpu.functional.detection import mask_utils
+
+            by_img: Dict[Any, Dict[str, list]] = {i: {"boxes": [], "labels": [], "scores": [], "crowds": [], "area": [], "masks": []} for i in image_ids}
+            for ann in annotations:
+                entry = by_img.get(ann["image_id"])
+                if entry is None:
+                    raise ValueError(
+                        f"Annotation references image_id {ann['image_id']!r} which is not in the target"
+                        " file's image list — mismatched prediction/target files?"
+                    )
+                x, y, w, h = ann["bbox"] if not segm else (0, 0, 0, 0)
+                if not segm:
+                    entry["boxes"].append([x, y, x + w, y + h])
+                else:
+                    seg = ann["segmentation"]
+                    if isinstance(seg, list):
+                        raise NotImplementedError(
+                            "Polygon segmentations are not supported; convert them to RLE offline"
+                            " (e.g. with pycocotools `frPyObjects`) before loading."
+                        )
+                    counts = seg["counts"]
+                    if isinstance(counts, (str, bytes)):
+                        counts = mask_utils.rle_from_string(counts)
+                    entry["masks"].append({"size": seg["size"], "counts": np.asarray(counts, np.uint32)})
+                entry["labels"].append(ann["category_id"])
+                entry["crowds"].append(ann.get("iscrowd", 0))
+                entry["area"].append(ann.get("area", 0))
+                if with_scores:
+                    entry["scores"].append(ann.get("score", 1.0))
+            out = []
+            for i in image_ids:
+                e = by_img[i]
+                item: Dict[str, Any] = {"labels": np.asarray(e["labels"], np.int64)}
+                if segm:
+                    item["masks"] = e["masks"]
+                else:
+                    item["boxes"] = np.asarray(e["boxes"], np.float64).reshape(-1, 4)
+                if with_scores:
+                    item["scores"] = np.asarray(e["scores"], np.float64)
+                else:
+                    item["iscrowd"] = np.asarray(e["crowds"], np.int64)
+                    if any(a for a in e["area"]):
+                        item["area"] = np.asarray(e["area"], np.float64)
+                out.append(item)
+            return out
+
+        return group(pred_data, True), group(gt_data.get("annotations", []), False)
+
+    def tm_to_coco(self, name: str = "tm_map_input") -> None:
+        """Write the accumulated stream as COCO-format json files
+        ``{name}_preds.json`` / ``{name}_target.json`` (reference
+        ``mean_ap.py:759-822``)."""
+        import json
+
+        from torchmetrics_tpu.functional.detection import mask_utils
+        from torchmetrics_tpu.functional.detection.helpers import box_convert
+
+        segm = self._is_segm
+
+        def _to_xyxy(box):
+            box = np.asarray(box, np.float64).reshape(1, 4)
+            if self.box_format != "xyxy":
+                box = np.asarray(box_convert(box, self.box_format, "xyxy"))
+            return box[0]
+
+        images = []
+        gt_annotations = []
+        pred_annotations = []
+        ann_id = 1
+        n_imgs = len(self.groundtruth_labels)
+        for i in range(n_imgs):
+            images.append({"id": i})
+            labels = np.asarray(self.groundtruth_labels[i])
+            crowds = np.asarray(self.groundtruth_crowds[i])
+            areas = np.asarray(self.groundtruth_area[i])
+            for j in range(labels.size):
+                ann: Dict[str, Any] = {
+                    "id": ann_id,
+                    "image_id": i,
+                    "category_id": int(labels[j]),
+                    "iscrowd": int(crowds[j]) if crowds.size else 0,
+                }
+                if segm:
+                    rle = self.groundtruth_mask[i][j]
+                    ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
+                    ann["area"] = float(areas[j]) if areas.size else float(mask_utils.area(rle))
+                else:
+                    box = _to_xyxy(self.groundtruth_box[i][j])
+                    ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
+                    ann["area"] = float(areas[j]) if areas.size else float((box[2] - box[0]) * (box[3] - box[1]))
+                gt_annotations.append(ann)
+                ann_id += 1
+            scores = np.asarray(self.detection_scores[i])
+            det_labels = np.asarray(self.detection_labels[i])
+            for j in range(det_labels.size):
+                ann = {"image_id": i, "category_id": int(det_labels[j]), "score": float(scores[j])}
+                if segm:
+                    rle = self.detection_mask[i][j]
+                    ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
+                else:
+                    box = _to_xyxy(self.detection_box[i][j])
+                    ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
+                pred_annotations.append(ann)
+        categories = [{"id": int(c)} for c in sorted({a["category_id"] for a in gt_annotations + pred_annotations})]
+        with open(f"{name}_target.json", "w") as f:
+            json.dump({"images": images, "annotations": gt_annotations, "categories": categories}, f)
+        with open(f"{name}_preds.json", "w") as f:
+            json.dump(pred_annotations, f)
 
     def plot(self, val=None, ax=None):
         return self._plot(val, ax)

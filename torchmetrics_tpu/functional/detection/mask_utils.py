@@ -123,3 +123,48 @@ def iou(dt: Sequence[RLE], gt: Sequence[RLE], iscrowd: Optional[Sequence[int]] =
     area_g = gt_masks.sum(1)[None, :].astype(np.float64)
     union = np.where(crowd[None, :].astype(bool), area_d, area_d + area_g - inter)
     return np.where(union > 0, inter / np.maximum(union, 1), 0.0)
+
+
+def rle_from_string(s: Union[str, bytes]) -> np.ndarray:
+    """Decode COCO's compressed RLE ``counts`` string (the pycocotools
+    ``rleFrString`` varint + delta coding) into plain run lengths."""
+    if isinstance(s, bytes):
+        s = s.decode("ascii")
+    counts: List[int] = []
+    i = 0
+    while i < len(s):
+        x = 0
+        k = 0
+        more = True
+        while more:
+            c = ord(s[i]) - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            i += 1
+            k += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * k)
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(x)
+    return np.asarray(counts, np.uint32)
+
+
+def rle_to_string(counts: np.ndarray) -> str:
+    """Encode run lengths into COCO's compressed ``counts`` string
+    (pycocotools ``rleToString``)."""
+    counts = np.asarray(counts, np.int64)
+    out = []
+    for i, x in enumerate(counts):
+        x = int(x)
+        if i > 2:
+            x -= int(counts[i - 2])
+        more = True
+        while more:
+            c = x & 0x1F
+            x >>= 5
+            more = not (x == -1 if (c & 0x10) else x == 0)
+            if more:
+                c |= 0x20
+            out.append(chr(c + 48))
+    return "".join(out)
