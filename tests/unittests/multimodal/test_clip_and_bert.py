@@ -271,3 +271,16 @@ def test_infolm_validation():
         _InformationMeasure("alpha_divergence", alpha=1.0)
     with pytest.raises(ValueError, match="beta"):
         _InformationMeasure("beta_divergence", beta=0.0)
+
+
+def test_bert_score_all_layers(bert_pair):
+    model, tokenizer = bert_pair
+    preds = ["hello world", "general kenobi"]
+    target = ["hello there world", "general grievous"]
+    res = bert_score(preds, target, model=model, user_tokenizer=tokenizer, all_layers=True)
+    n_layers = model.config.num_hidden_layers + 1  # hidden_states includes embeddings
+    f1 = np.asarray(res["f1"])
+    assert f1.shape == (n_layers * len(preds),)
+    # the last layer's scores equal the default (num_layers=None) run
+    default = np.asarray(bert_score(preds, target, model=model, user_tokenizer=tokenizer)["f1"])
+    np.testing.assert_allclose(f1.reshape(n_layers, len(preds))[-1], default, rtol=1e-5)

@@ -136,7 +136,13 @@ class MeanAveragePrecision(Metric):
         def _to_rle_list(masks):
             out = []
             for m in masks:
-                out.append(m if isinstance(m, dict) else mask_utils.encode(np.asarray(m)))
+                if isinstance(m, dict):
+                    counts = m["counts"]
+                    if isinstance(counts, (str, bytes)):  # compressed pycocotools-style RLE
+                        counts = mask_utils.rle_from_string(counts)
+                    out.append({"size": list(m["size"]), "counts": np.asarray(counts, np.uint32)})
+                else:
+                    out.append(mask_utils.encode(np.asarray(m)))
             return out
 
         for item in preds:
@@ -266,7 +272,7 @@ class MeanAveragePrecision(Metric):
                     entry["masks"].append({"size": seg["size"], "counts": np.asarray(counts, np.uint32)})
                 entry["labels"].append(ann["category_id"])
                 entry["crowds"].append(ann.get("iscrowd", 0))
-                entry["area"].append(ann.get("area", 0))
+                entry["area"].append(ann.get("area"))
                 if with_scores:
                     entry["scores"].append(ann.get("score", 1.0))
             out = []
@@ -281,8 +287,19 @@ class MeanAveragePrecision(Metric):
                     item["scores"] = np.asarray(e["scores"], np.float64)
                 else:
                     item["iscrowd"] = np.asarray(e["crowds"], np.int64)
-                    if any(a for a in e["area"]):
-                        item["area"] = np.asarray(e["area"], np.float64)
+                    if any(a is not None for a in e["area"]):
+                        # fill missing areas from the geometry so mixed files
+                        # don't corrupt small/medium/large binning
+                        filled = []
+                        for j, a in enumerate(e["area"]):
+                            if a is not None:
+                                filled.append(float(a))
+                            elif segm:
+                                filled.append(float(mask_utils.area(e["masks"][j])))
+                            else:
+                                b = e["boxes"][j]
+                                filled.append(float((b[2] - b[0]) * (b[3] - b[1])))
+                        item["area"] = np.asarray(filled, np.float64)
                 out.append(item)
             return out
 
@@ -299,11 +316,11 @@ class MeanAveragePrecision(Metric):
 
         segm = self._is_segm
 
-        def _to_xyxy(box):
-            box = np.asarray(box, np.float64).reshape(1, 4)
-            if self.box_format != "xyxy":
-                box = np.asarray(box_convert(box, self.box_format, "xyxy"))
-            return box[0]
+        def _boxes_to_xyxy(boxes):
+            boxes = np.asarray(boxes, np.float64).reshape(-1, 4)
+            if self.box_format != "xyxy" and boxes.size:
+                boxes = np.asarray(box_convert(boxes, self.box_format, "xyxy"))
+            return boxes
 
         images = []
         gt_annotations = []
@@ -311,10 +328,18 @@ class MeanAveragePrecision(Metric):
         ann_id = 1
         n_imgs = len(self.groundtruth_labels)
         for i in range(n_imgs):
-            images.append({"id": i})
+            image_entry: Dict[str, Any] = {"id": i}
+            if segm:
+                for rle_list in (self.groundtruth_mask[i], self.detection_mask[i]):
+                    if rle_list:
+                        image_entry["height"], image_entry["width"] = (int(v) for v in rle_list[0]["size"])
+                        break
+            images.append(image_entry)
             labels = np.asarray(self.groundtruth_labels[i])
             crowds = np.asarray(self.groundtruth_crowds[i])
             areas = np.asarray(self.groundtruth_area[i])
+            gt_boxes_xyxy = None if segm else _boxes_to_xyxy(self.groundtruth_box[i])
+            det_boxes_xyxy = None if segm else _boxes_to_xyxy(self.detection_box[i])
             for j in range(labels.size):
                 ann: Dict[str, Any] = {
                     "id": ann_id,
@@ -327,7 +352,7 @@ class MeanAveragePrecision(Metric):
                     ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
                     ann["area"] = float(areas[j]) if areas.size else float(mask_utils.area(rle))
                 else:
-                    box = _to_xyxy(self.groundtruth_box[i][j])
+                    box = gt_boxes_xyxy[j]
                     ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
                     ann["area"] = float(areas[j]) if areas.size else float((box[2] - box[0]) * (box[3] - box[1]))
                 gt_annotations.append(ann)
@@ -340,7 +365,7 @@ class MeanAveragePrecision(Metric):
                     rle = self.detection_mask[i][j]
                     ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
                 else:
-                    box = _to_xyxy(self.detection_box[i][j])
+                    box = det_boxes_xyxy[j]
                     ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
                 pred_annotations.append(ann)
         categories = [{"id": int(c)} for c in sorted({a["category_id"] for a in gt_annotations + pred_annotations})]

@@ -58,6 +58,7 @@ def _embed(
     idf: bool,
     tokens_idf: Optional[Dict[int, float]],
     batch_size: int,
+    all_layers: bool = False,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Unit-norm token embeddings masked for special tokens + per-sentence
     normalized idf scales (reference ``bert.py:69-149``)."""
@@ -71,17 +72,20 @@ def _embed(
         mask = jnp.asarray(attention_mask[start : start + batch_size])
         if user_forward_fn is not None:
             out = user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})
-            out = jnp.asarray(out)
+            out = jnp.asarray(out)[:, None]  # (B, 1, S, D)
         else:
             result = model(ids, mask, output_hidden_states=True)
             hidden = result.hidden_states
-            out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])
+            if all_layers:
+                out = jnp.stack([jnp.asarray(h) for h in hidden], axis=1)  # (B, L, S, D)
+            else:
+                out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])[:, None]
         out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
         embeddings_list.append(np.asarray(out))
-    embeddings = np.concatenate(embeddings_list)
+    embeddings = np.concatenate(embeddings_list)  # (B, L, S, D); L == 1 unless all_layers
 
     processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
-    embeddings = embeddings * processed_mask[:, :, None]
+    embeddings = embeddings * processed_mask[:, None, :, None]
 
     if idf:
         assert tokens_idf is not None
@@ -99,13 +103,19 @@ def _get_precision_recall_f1(
     preds_idf_scale: Array,
     target_idf_scale: Array,
 ) -> Tuple[Array, Array, Array]:
-    """Greedy-matching P/R/F1 (reference ``bert.py:150-184``)."""
-    cos_sim = jnp.einsum("bpd, brd -> bpr", preds_embeddings, target_embeddings)
-    precision = (cos_sim.max(axis=2) * preds_idf_scale).sum(-1)
-    recall = (cos_sim.max(axis=1) * target_idf_scale).sum(-1)
+    """Greedy-matching P/R/F1 over ``(B, L, S, D)`` embeddings (reference
+    ``bert.py:150-184``); the layer axis L is 1 unless ``all_layers``."""
+    cos_sim = jnp.einsum("blpd, blrd -> blpr", preds_embeddings, target_embeddings)
+    precision = (cos_sim.max(axis=3) * preds_idf_scale[:, None, :]).sum(-1)  # (B, L)
+    recall = (cos_sim.max(axis=2) * target_idf_scale[:, None, :]).sum(-1)
     f1 = 2 * precision * recall / (precision + recall)
     f1 = jnp.nan_to_num(f1)
-    return precision, recall, f1
+
+    # match the reference output layout: (L, B) squeezed to (B,) for L == 1
+    def _flatten(t: Array) -> Array:
+        return jnp.squeeze(t.T, 0) if t.shape[1] == 1 else t.T.reshape(-1)
+
+    return _flatten(precision), _flatten(recall), _flatten(f1)
 
 
 def _load_default_model(model_name_or_path: str):
@@ -176,18 +186,20 @@ def bert_score(
     target_ids, target_mask = tokenize(target)
 
     tokens_idf = _get_tokens_idf(target_ids, target_mask) if idf else None
-    preds_emb, preds_scale = _embed(preds_ids, preds_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size)
+    preds_emb, preds_scale = _embed(
+        preds_ids, preds_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size, all_layers
+    )
     target_emb, target_scale = _embed(
-        target_ids, target_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size
+        target_ids, target_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size, all_layers
     )
 
     # pad both sides to a common sequence length for one batched einsum
-    max_len = max(preds_emb.shape[1], target_emb.shape[1])
+    max_len = max(preds_emb.shape[2], target_emb.shape[2])
 
     def pad_to(x, scale):
-        pad = max_len - x.shape[1]
+        pad = max_len - x.shape[2]
         if pad:
-            x = np.pad(x, ((0, 0), (0, pad), (0, 0)))
+            x = np.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
             scale = np.pad(scale, ((0, 0), (0, pad)))
         return x, scale
 
@@ -204,10 +216,18 @@ def bert_score(
         with open(baseline_path) as fname:
             rows = [[float(v) for v in row] for i, row in enumerate(csv.reader(fname)) if i > 0]
         baseline = np.asarray(rows)[:, 1:]
-        scale = jnp.asarray(baseline[num_layers if num_layers is not None else -1])
-        precision = (precision - scale[0]) / (1 - scale[0])
-        recall = (recall - scale[1]) / (1 - scale[1])
-        f1 = (f1 - scale[2]) / (1 - scale[2])
+        if all_layers:
+            # per-layer baselines over the (L, B)-flattened scores
+            n_b = precision.shape[0] // baseline.shape[0]
+            scale = jnp.asarray(np.repeat(baseline, n_b, axis=0))  # (L*B, 3)
+            precision = (precision - scale[:, 0]) / (1 - scale[:, 0])
+            recall = (recall - scale[:, 1]) / (1 - scale[:, 1])
+            f1 = (f1 - scale[:, 2]) / (1 - scale[:, 2])
+        else:
+            scale = jnp.asarray(baseline[num_layers if num_layers is not None else -1])
+            precision = (precision - scale[0]) / (1 - scale[0])
+            recall = (recall - scale[1]) / (1 - scale[1])
+            f1 = (f1 - scale[2]) / (1 - scale[2])
 
     output = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
