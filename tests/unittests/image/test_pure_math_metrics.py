@@ -291,3 +291,24 @@ def test_vif_identical_close_to_one():
     np.testing.assert_allclose(float(m.compute()), val2, rtol=1e-4)
     with pytest.raises(ValueError, match="at least 41x41"):
         FI.visual_information_fidelity(np.zeros((1, 1, 30, 30)), np.zeros((1, 1, 30, 30)))
+
+
+def test_ssim_reduction_variants_and_full_image():
+    rng = _rng(15)
+    preds = rng.rand(4, 1, 16, 16).astype(np.float32)
+    target = rng.rand(4, 1, 16, 16).astype(np.float32)
+    per_image = np.asarray(FI.structural_similarity_index_measure(preds, target, data_range=1.0, reduction="none"))
+    assert per_image.shape == (4,)
+    total = float(FI.structural_similarity_index_measure(preds, target, data_range=1.0, reduction="sum"))
+    np.testing.assert_allclose(total, per_image.sum(), rtol=1e-5)
+    # module with reduction="none" returns the full stream
+    m = StructuralSimilarityIndexMeasure(data_range=1.0, reduction="none")
+    m.update(preds[:2], target[:2])
+    m.update(preds[2:], target[2:])
+    np.testing.assert_allclose(np.asarray(m.compute()), per_image, rtol=1e-5)
+    # return_full_image produces the per-pixel map alongside the scores
+    score, image = FI.structural_similarity_index_measure(
+        preds, target, data_range=1.0, return_full_image=True
+    )
+    assert np.asarray(image).shape[0] == 4 and np.asarray(image).ndim == 4
+    np.testing.assert_allclose(float(score), per_image.mean(), rtol=1e-5)
