@@ -150,3 +150,44 @@ def test_cross_domain_metric_collection():
     # compute groups: precision + recall share the stat-scores state, mse doesn't
     groups = [sorted(names) for names in coll.compute_groups.values()]
     assert sorted(groups) == [["mse"], ["precision", "recall"]]
+
+
+def test_wrappers_compose_with_round2_domains():
+    """Wrappers are domain-agnostic: bootstrap an image metric, track an
+    audio metric over time, and multitask classification + regression."""
+    from torchmetrics_tpu.wrappers import BootStrapper, MetricTracker, MultitaskWrapper
+
+    rng = np.random.RandomState(9)
+
+    # BootStrapper over SSIM (multinomial + fixed seed: poisson resampling can
+    # leave a copy with zero samples, whose compute is NaN — reference behavior)
+    boot = BootStrapper(
+        tm.StructuralSimilarityIndexMeasure(data_range=1.0, kernel_size=5, sigma=0.8),
+        num_bootstraps=4,
+        sampling_strategy="multinomial",
+        seed=0,
+    )
+    preds = rng.rand(8, 1, 16, 16).astype(np.float32)
+    target = rng.rand(8, 1, 16, 16).astype(np.float32)
+    boot.update(preds, target)
+    out = boot.compute()
+    assert np.isfinite(float(out["mean"])) and float(out["std"]) >= 0
+
+    # MetricTracker over SNR across "epochs"
+    tracker = MetricTracker(tm.SignalNoiseRatio())
+    for _ in range(3):
+        tracker.increment()
+        tracker.update(rng.randn(4, 64).astype(np.float32), rng.randn(4, 64).astype(np.float32))
+    best, which = tracker.best_metric(return_step=True)
+    assert np.isfinite(float(best)) and 0 <= int(which) < 3
+
+    # MultitaskWrapper mixing classification and regression heads
+    multitask = MultitaskWrapper(
+        {"cls": MulticlassAccuracy(num_classes=3), "reg": tm.MeanSquaredError()}
+    )
+    multitask.update(
+        {"cls": rng.randint(0, 3, 32), "reg": rng.randn(32).astype(np.float32)},
+        {"cls": rng.randint(0, 3, 32), "reg": rng.randn(32).astype(np.float32)},
+    )
+    out = multitask.compute()
+    assert set(out) == {"cls", "reg"}
