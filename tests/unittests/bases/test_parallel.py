@@ -163,3 +163,44 @@ def test_sharded_update_mean_state_weighted_merge():
     for i in range(3):
         sharded_update(metric, mesh, jnp.full((16,), float(i)))
     assert np.allclose(float(metric.compute()), 1.0)
+
+
+def test_sequence_parallel_perplexity_long_context():
+    """Long-context regime (SURVEY §5.7): the SEQUENCE dimension is sharded
+    over the mesh, each device folds its sequence slice into partial
+    (-log-prob sum, token count) states, and ``psum`` merges them — the
+    metrics-framework analogue of sequence/context parallelism."""
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from torchmetrics_tpu.functional.text.perplexity import _perplexity_update
+
+    mesh = _mesh()
+    batch, seq_len, vocab = 2, 64 * NUM_DEVICES, 16  # long sequence, 8-way sharded
+    rng = np.random.RandomState(0)
+    logits = rng.randn(batch, seq_len, vocab).astype(np.float32)
+    target = rng.randint(0, vocab, (batch, seq_len)).astype(np.int32)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, "data", None), P(None, "data")),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def sharded_perplexity(logits_shard, target_shard):
+        total, count = _perplexity_update(logits_shard, target_shard)
+        merged = jax.lax.psum(jnp.stack([total, count]), "data")
+        return jnp.exp(merged[0] / merged[1])
+
+    logits_sharded = jax.device_put(logits, NamedSharding(mesh, jax.sharding.PartitionSpec(None, "data", None)))
+    target_sharded = jax.device_put(target, NamedSharding(mesh, jax.sharding.PartitionSpec(None, "data")))
+    got = float(jax.jit(sharded_perplexity)(logits_sharded, target_sharded))
+
+    from torchmetrics_tpu import Perplexity
+
+    single = Perplexity()
+    single.update(logits, target)
+    np.testing.assert_allclose(got, float(single.compute()), rtol=1e-4)
