@@ -320,3 +320,34 @@ def test_srmr_norm_and_validation():
         FA.speech_reverberation_modulation_energy_ratio(x, -1)
     with pytest.raises(ValueError, match="norm"):
         FA.speech_reverberation_modulation_energy_ratio(x, fs, norm="yes")
+
+
+def test_dnsmos_mel_features_native():
+    """The native mel-spectrogram front-end: correct shape, dB scaling into
+    the model's expected (x+40)/40 domain, and deterministic."""
+    from torchmetrics_tpu.functional.audio.dnsmos import _audio_melspec, _mel_filterbank
+
+    fb = _mel_filterbank()
+    assert fb.shape == (120, 161)
+    assert np.all(fb >= 0) and fb.sum() > 0
+    # each FFT bin in the covered range contributes to at most 2 mel bands
+    assert int((fb > 0).sum(axis=0).max()) <= 2
+
+    rng = _rng(6)
+    audio = rng.randn(2, 16000 * 2).astype(np.float32)
+    mel = _audio_melspec(audio)
+    assert mel.shape[0] == 2 and mel.shape[-1] == 120
+    # dB mapping lands in [(max-80)+40)/40, (0+40)/40] = [-1, 1]
+    assert mel.max() <= 1.0 + 1e-6 and mel.min() >= -1.0 - 1e-6
+    np.testing.assert_allclose(mel, _audio_melspec(audio), rtol=0, atol=0)
+
+
+def test_dnsmos_gated_without_models():
+    from torchmetrics_tpu.functional.audio.dnsmos import _ONNXRUNTIME_AVAILABLE
+
+    if not _ONNXRUNTIME_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
+            FA.deep_noise_suppression_mean_opinion_score(np.zeros(16000), 16000, False)
+    else:  # pragma: no cover - environment-dependent
+        with pytest.raises(FileNotFoundError, match="DNSMOS model file"):
+            FA.deep_noise_suppression_mean_opinion_score(np.zeros(16000), 16000, False)

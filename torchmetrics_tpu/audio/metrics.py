@@ -12,13 +12,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.audio.callbacks import (
-    _LIBROSA_AVAILABLE,
-    _ONNXRUNTIME_AVAILABLE,
-    _PESQ_AVAILABLE,
-    deep_noise_suppression_mean_opinion_score,
-    perceptual_evaluation_speech_quality,
-)
+from torchmetrics_tpu.functional.audio.callbacks import _PESQ_AVAILABLE, perceptual_evaluation_speech_quality
+from torchmetrics_tpu.functional.audio.dnsmos import _ONNXRUNTIME_AVAILABLE, deep_noise_suppression_mean_opinion_score
 from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
@@ -273,22 +268,34 @@ class SpeechReverberationModulationEnergyRatio(_AveragedAudioMetric):
         self.total = self.total + value.size
 
 
-class DeepNoiseSuppressionMeanOpinionScore(_AveragedAudioMetric):
-    """DNSMOS (reference ``audio/dnsmos.py:35``) — host-callback backed."""
+class DeepNoiseSuppressionMeanOpinionScore(Metric):
+    """DNSMOS (reference ``audio/dnsmos.py:35``) — native mel features, ONNX
+    inference on host (requires ``onnxruntime`` + local model files)."""
 
     is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
 
-    def __init__(self, fs: int, personalized: bool = False, **kwargs: Any) -> None:
+    def __init__(self, fs: int, personalized: bool = False, num_threads: Any = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE):
+        if not _ONNXRUNTIME_AVAILABLE:
             raise ModuleNotFoundError(
-                "DeepNoiseSuppressionMeanOpinionScore metric requires that librosa and onnxruntime are installed."
-                " Install as `pip install librosa onnxruntime-gpu`."
+                "DeepNoiseSuppressionMeanOpinionScore metric requires that onnxruntime is installed."
+                " Install as `pip install onnxruntime` (mel features are computed natively; librosa is not needed)."
             )
         self.fs = fs
         self.personalized = personalized
+        self.num_threads = num_threads
+        self.add_state("sum_mos", jnp.zeros(4), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0), dist_reduce_fx="sum")
 
     def update(self, preds: Array) -> None:  # type: ignore[override]
-        value = deep_noise_suppression_mean_opinion_score(preds, self.fs, self.personalized)
-        self.sum_value = self.sum_value + value.sum()
-        self.total = self.total + value.size
+        value = deep_noise_suppression_mean_opinion_score(
+            preds, self.fs, self.personalized, num_threads=self.num_threads
+        ).reshape(-1, 4)
+        self.sum_mos = self.sum_mos + value.sum(axis=0)
+        self.total = self.total + value.shape[0]
+
+    def compute(self) -> Array:
+        """Mean ``[p808_mos, mos_sig, mos_bak, mos_ovr]`` over the stream."""
+        return self.sum_mos / self.total

@@ -1,6 +1,6 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Host-callback audio metrics: PESQ and DNSMOS.
+"""Host-callback audio metrics: PESQ.
 
 These wrap inherently host-native DSP/inference backends (the C ``pesq``
 library and onnxruntime — reference ``functional/audio/{pesq,dnsmos}.py``)
@@ -23,8 +23,6 @@ from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
 Array = jax.Array
 
 _PESQ_AVAILABLE = ModuleAvailableCache("pesq")
-_ONNXRUNTIME_AVAILABLE = ModuleAvailableCache("onnxruntime")
-_LIBROSA_AVAILABLE = ModuleAvailableCache("librosa")
 
 
 def _batch_callback(host_fn, preds: Array, target: Optional[Array], out_shape) -> Array:
@@ -65,16 +63,3 @@ def perceptual_evaluation_speech_quality(
         return np.asarray(scores, np.float32).reshape(preds_np.shape[:-1])
 
     return _batch_callback(host_fn, preds, target, preds.shape[:-1])
-
-
-def deep_noise_suppression_mean_opinion_score(
-    preds: Array, fs: int, personalized: bool = False, device: Optional[str] = None, num_threads: Optional[int] = None
-) -> Array:
-    """DNSMOS via onnxruntime inference on host (reference
-    ``functional/audio/dnsmos.py:22-168``)."""
-    if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE):
-        raise ModuleNotFoundError(
-            "DNSMOS metric requires that librosa and onnxruntime are installed."
-            " Install as `pip install librosa onnxruntime-gpu`."
-        )
-    raise NotImplementedError  # pragma: no cover - unreachable without onnxruntime
