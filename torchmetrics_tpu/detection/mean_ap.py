@@ -242,6 +242,11 @@ class MeanAveragePrecision(Metric):
             pred_data = pred_data.get("annotations", [])
 
         image_ids = [img["id"] for img in gt_data.get("images", [])]
+        img_sizes = {
+            img["id"]: (img["height"], img["width"])
+            for img in gt_data.get("images", [])
+            if "height" in img and "width" in img
+        }
         if not image_ids:
             image_ids = sorted({a["image_id"] for a in gt_data.get("annotations", [])})
 
@@ -262,14 +267,19 @@ class MeanAveragePrecision(Metric):
                 else:
                     seg = ann["segmentation"]
                     if isinstance(seg, list):
-                        raise NotImplementedError(
-                            "Polygon segmentations are not supported; convert them to RLE offline"
-                            " (e.g. with pycocotools `frPyObjects`) before loading."
-                        )
-                    counts = seg["counts"]
-                    if isinstance(counts, (str, bytes)):
-                        counts = mask_utils.rle_from_string(counts)
-                    entry["masks"].append({"size": seg["size"], "counts": np.asarray(counts, np.uint32)})
+                        # polygon format: rasterize through the native codec
+                        img_meta = img_sizes.get(ann["image_id"])
+                        if img_meta is None:
+                            raise ValueError(
+                                "Polygon segmentations need image height/width in the target file's"
+                                f" images entry for image_id {ann['image_id']!r}."
+                            )
+                        entry["masks"].append(mask_utils.from_polygons(seg, img_meta[0], img_meta[1]))
+                    else:
+                        counts = seg["counts"]
+                        if isinstance(counts, (str, bytes)):
+                            counts = mask_utils.rle_from_string(counts)
+                        entry["masks"].append({"size": seg["size"], "counts": np.asarray(counts, np.uint32)})
                 entry["labels"].append(ann["category_id"])
                 entry["crowds"].append(ann.get("iscrowd", 0))
                 entry["area"].append(ann.get("area"))

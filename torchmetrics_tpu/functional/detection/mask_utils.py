@@ -168,3 +168,75 @@ def rle_to_string(counts: np.ndarray) -> str:
                 c |= 0x20
             out.append(chr(c + 48))
     return "".join(out)
+
+
+def from_polygons(polygons: Sequence[Sequence[float]], h: int, w: int) -> RLE:
+    """Rasterize COCO polygon segmentation(s) into one RLE (the pycocotools
+    ``frPyObjects`` + ``merge`` path): each polygon is a flat
+    ``[x0, y0, x1, y1, ...]`` list; multiple polygons union into one mask.
+    Requires the native codec (the rasterization lives in C++)."""
+    if not (isinstance(h, int) and isinstance(w, int) and h > 0 and w > 0):
+        raise ValueError(f"Polygon rasterization needs positive integer image dims, got h={h}, w={w}")
+    lib = get_rle_library()
+    if lib is None:
+        raise RuntimeError(
+            "Polygon rasterization requires the native RLE codec (g++ unavailable?);"
+            " convert polygons to RLE offline instead."
+        )
+    rles = []
+    for poly in polygons:
+        xy = np.asarray(poly, np.float64).reshape(-1)
+        if xy.size < 6:
+            continue  # degenerate polygon (< 3 vertices)
+        buf = np.zeros(h * w + 2, np.uint32)
+        n = lib.rle_from_polygon(
+            xy.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_uint64(xy.size // 2),
+            ctypes.c_uint64(h),
+            ctypes.c_uint64(w),
+            buf.ctypes.data_as(ctypes.c_void_p),
+        )
+        rles.append({"size": [h, w], "counts": buf[:n].copy()})
+    if not rles:
+        return {"size": [h, w], "counts": np.asarray([h * w], np.uint32)}
+    if len(rles) == 1:
+        return rles[0]
+    return merge_union(rles)
+
+
+def merge_union(rles: Sequence[RLE]) -> RLE:
+    """Union of several same-size RLEs at the run level (pycocotools
+    ``merge`` semantics) — no dense masks are materialized."""
+    h, w = rles[0]["size"]
+    size = int(h) * int(w)
+    starts_list, ends_list = [], []
+    for r in rles:
+        if list(r["size"]) != [h, w]:
+            raise ValueError("All RLEs must share the same size for merging")
+        cum = np.concatenate([[0], np.cumsum(np.asarray(r["counts"], np.int64))])
+        starts_list.append(cum[1:-1:2] if cum.size > 2 else cum[1:0])
+        ends_list.append(cum[2::2])
+    starts = np.concatenate([s for s in starts_list if s.size] or [np.zeros(0, np.int64)])
+    ends = np.concatenate([e for e in ends_list if e.size] or [np.zeros(0, np.int64)])
+    if starts.size == 0:
+        return {"size": [h, w], "counts": np.asarray([size], np.uint32)}
+    order = np.argsort(starts, kind="stable")
+    starts, ends = starts[order], ends[order]
+    # sweep-merge overlapping [start, end) intervals
+    merged_s, merged_e = [int(starts[0])], [int(ends[0])]
+    for s, e in zip(starts[1:], ends[1:]):
+        if s <= merged_e[-1]:
+            merged_e[-1] = max(merged_e[-1], int(e))
+        else:
+            merged_s.append(int(s))
+            merged_e.append(int(e))
+    counts = []
+    pos = 0
+    for s, e in zip(merged_s, merged_e):
+        counts.append(s - pos)  # zeros run (may be 0 only for the first)
+        counts.append(e - s)
+        pos = e
+    counts.append(size - pos)
+    if counts[-1] == 0:
+        counts.pop()
+    return {"size": [h, w], "counts": np.asarray(counts, np.uint32)}

@@ -49,6 +49,8 @@ def _build_library() -> Optional[ctypes.CDLL]:
     lib.rle_iou_pair.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
     lib.rle_iou_matrix.restype = None
     lib.rle_iou_matrix.argtypes = [ctypes.c_void_p] * 3 + [ctypes.c_uint64] + [ctypes.c_void_p] * 3 + [ctypes.c_uint64] + [ctypes.c_void_p] * 2
+    lib.rle_from_polygon.restype = ctypes.c_uint64
+    lib.rle_from_polygon.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p]
     return lib
 
 

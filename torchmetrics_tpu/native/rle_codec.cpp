@@ -102,3 +102,94 @@ void rle_iou_matrix(
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Polygon -> RLE rasterization, following the published COCO convention
+// (pycocotools maskApi `rleFrPoly`): vertices are upsampled 5x, the boundary
+// is traced with integer line stepping, downsampled crossings per column give
+// the y-boundary points, and sorted crossing positions become run lengths
+// (even-odd fill in column-major order).
+
+#include <cmath>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+
+// xy: k vertex pairs (x0, y0, x1, y1, ...); out buffer sized h*w+2.
+// Returns the number of runs written.
+uint64_t rle_from_polygon(const double* xy, uint64_t k, uint64_t h, uint64_t w,
+                          uint32_t* counts_out) {
+    const double scale = 5.0;
+    std::vector<long> x(k + 1), y(k + 1);
+    for (uint64_t j = 0; j < k; ++j) {
+        x[j] = static_cast<long>(scale * xy[2 * j + 0] + 0.5);
+        y[j] = static_cast<long>(scale * xy[2 * j + 1] + 0.5);
+    }
+    x[k] = x[0];
+    y[k] = y[0];
+
+    // dense boundary points via integer line stepping
+    std::vector<long> u, v;
+    for (uint64_t j = 0; j < k; ++j) {
+        long xs = x[j], xe = x[j + 1], ys = y[j], ye = y[j + 1];
+        long dx = std::labs(xe - xs), dy = std::labs(ys - ye);
+        bool flip = (dx >= dy && xs > xe) || (dx < dy && ys > ye);
+        if (flip) { std::swap(xs, xe); std::swap(ys, ye); }
+        double s = dx >= dy ? static_cast<double>(ye - ys) / std::max<long>(dx, 1)
+                            : static_cast<double>(xe - xs) / std::max<long>(dy, 1);
+        if (dx >= dy) {
+            for (long d = 0; d <= dx; ++d) {
+                long t = flip ? dx - d : d;
+                u.push_back(t + xs);
+                v.push_back(static_cast<long>(ys + s * t + 0.5));
+            }
+        } else {
+            for (long d = 0; d <= dy; ++d) {
+                long t = flip ? dy - d : d;
+                v.push_back(t + ys);
+                u.push_back(static_cast<long>(xs + s * t + 0.5));
+            }
+        }
+    }
+
+    // column crossings, downsampled back to the pixel grid
+    std::vector<uint32_t> a;
+    for (size_t j = 1; j < u.size(); ++j) {
+        if (u[j] == u[j - 1]) continue;
+        double xd = static_cast<double>(u[j] < u[j - 1] ? u[j] : u[j] - 1);
+        xd = (xd + 0.5) / scale - 0.5;
+        if (std::floor(xd) != xd || xd < 0 || xd > static_cast<double>(w) - 1.0) continue;
+        double yd = static_cast<double>(v[j] < v[j - 1] ? v[j] : v[j - 1]);
+        yd = (yd + 0.5) / scale - 0.5;
+        if (yd < 0) yd = 0;
+        else if (yd > static_cast<double>(h)) yd = static_cast<double>(h);
+        yd = std::ceil(yd);
+        a.push_back(static_cast<uint32_t>(xd * static_cast<double>(h) + yd));
+    }
+
+    // even-odd fill: sorted crossing positions delta-encode into runs
+    a.push_back(static_cast<uint32_t>(h * w));
+    std::sort(a.begin(), a.end());
+    uint32_t prev = 0;
+    for (auto& val : a) {
+        uint32_t t = val;
+        val -= prev;
+        prev = t;
+    }
+    std::vector<uint32_t> b;
+    size_t j = 0;
+    b.push_back(a[j++]);
+    while (j < a.size()) {
+        if (a[j] > 0) {
+            b.push_back(a[j++]);
+        } else {
+            ++j;
+            if (j < a.size()) b[b.size() - 1] += a[j++];
+        }
+    }
+    for (size_t i = 0; i < b.size(); ++i) counts_out[i] = b[i];
+    return b.size();
+}
+
+}  // extern "C"
