@@ -181,6 +181,26 @@ class MetricPropertyTester:
         _assert_close(metric.compute(), fresh.compute(), rtol, atol, "reset")
 
     @staticmethod
+    def check_differentiability(metric_class, metric_args, batch) -> None:
+        """Metrics declaring ``is_differentiable=True`` admit finite, non-trivial
+        gradients w.r.t. ``preds`` through update+compute (the reference's
+        gradcheck-consistency pass, ``testers.py:552-587``)."""
+        if not metric_class.is_differentiable:
+            return
+        preds = jnp.asarray(batch[0], dtype=jnp.float32)
+        rest = batch[1:]
+
+        def scalar_eval(p):
+            metric = metric_class(**metric_args)
+            metric.update(p, *rest)
+            leaves = jax.tree_util.tree_leaves(metric.compute())
+            return sum(jnp.sum(leaf) for leaf in leaves)
+
+        grad = np.asarray(jax.grad(scalar_eval)(preds))
+        assert np.all(np.isfinite(grad)), f"{metric_class.__name__}: non-finite gradient"
+        assert np.any(grad != 0), f"{metric_class.__name__}: gradient identically zero"
+
+    @staticmethod
     def check_sharded_equivalence(metric_class, metric_args, batches, rtol, atol) -> None:
         """Sharded in-step update on the 8-device mesh == single-device
         (the reference's ddp=True parametrization, ``testers.py:162,474-482``)."""

@@ -127,6 +127,41 @@ def test_bf16_inputs_give_close_results(name, metric_class, args, batches):
     np.testing.assert_allclose(a, b, rtol=0.05, atol=0.05)
 
 
+def _pair(rng, *shape):
+    return (rng.randn(*shape).astype(np.float32), rng.randn(*shape).astype(np.float32))
+
+
+def _img_pair(rng):
+    return (rng.rand(4, 1, 16, 16).astype(np.float32), rng.rand(4, 1, 16, 16).astype(np.float32))
+
+
+_DIFFERENTIABLE = [
+    # (id, metric_class, args, batch factory over a fresh seeded RNG)
+    ("mse", tm.MeanSquaredError, {}, lambda rng: _pair(rng, N)),
+    ("mae", tm.MeanAbsoluteError, {}, lambda rng: _pair(rng, N)),
+    ("cosine_similarity", tm.CosineSimilarity, {}, lambda rng: _pair(rng, N, 4)),
+    ("explained_variance", tm.ExplainedVariance, {}, lambda rng: _pair(rng, N, 4)),
+    ("log_cosh", tm.LogCoshError, {}, lambda rng: _pair(rng, N)),
+    ("psnr", tm.PeakSignalNoiseRatio, {"data_range": 1.0}, _img_pair),
+    ("ssim", tm.StructuralSimilarityIndexMeasure, {"data_range": 1.0, "kernel_size": 5, "sigma": 0.8}, _img_pair),
+    ("total_variation", tm.TotalVariation, {}, lambda rng: (rng.rand(4, 2, 8, 8).astype(np.float32),)),
+    ("snr", tm.SignalNoiseRatio, {}, lambda rng: _pair(rng, 4, 64)),
+    ("si_sdr", tm.ScaleInvariantSignalDistortionRatio, {}, lambda rng: _pair(rng, 4, 64)),
+    ("perplexity", tm.Perplexity, {}, lambda rng: (rng.randn(4, 6, 5).astype(np.float32), rng.randint(0, 5, (4, 6)))),
+]
+
+
+@pytest.mark.parametrize(
+    "name,metric_class,args,make_batch", _DIFFERENTIABLE, ids=[d[0] for d in _DIFFERENTIABLE]
+)
+def test_differentiability(name, metric_class, args, make_batch):
+    """jax.grad flows through update+compute for metrics declaring
+    ``is_differentiable=True`` (reference testers.py:552-587)."""
+    assert metric_class.is_differentiable, f"{name} no longer declares is_differentiable"
+    batch = make_batch(np.random.RandomState(99))
+    MetricPropertyTester.check_differentiability(metric_class, args, batch)
+
+
 def test_cross_domain_metric_collection():
     """One MetricCollection spanning classification + regression metrics
     routes keyword inputs and dedups compute groups across domains."""
