@@ -1,0 +1,57 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Parity of the native C++ batched edit-distance kernel vs the Python DP."""
+import random
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.text.helper import _batch_edit_distance, _edit_distance
+from torchmetrics_tpu.native import get_edit_library
+
+_WORDS = ["the", "cat", "sat", "on", "a", "mat", "dog", "ran", "xyz", "q"]
+
+
+def _random_corpus(rng, n_pairs, max_len):
+    preds, tgts = [], []
+    for _ in range(n_pairs):
+        preds.append([rng.choice(_WORDS) for _ in range(rng.randint(0, max_len))])
+        tgts.append([rng.choice(_WORDS) for _ in range(rng.randint(0, max_len))])
+    return preds, tgts
+
+
+@pytest.mark.parametrize("substitution_cost", [1, 2])
+def test_batch_matches_python_dp(substitution_cost):
+    rng = random.Random(1234)
+    preds, tgts = _random_corpus(rng, 200, 30)
+    batched = _batch_edit_distance(preds, tgts, substitution_cost)
+    expected = np.array([_edit_distance(p, t, substitution_cost) for p, t in zip(preds, tgts)])
+    np.testing.assert_array_equal(batched, expected)
+
+
+def test_empty_and_degenerate_pairs():
+    preds = [[], ["a"], [], ["a", "b", "c"]]
+    tgts = [["x", "y"], [], [], ["a", "b", "c"]]
+    np.testing.assert_array_equal(_batch_edit_distance(preds, tgts), [2, 1, 0, 0])
+
+
+@pytest.mark.skipif(get_edit_library() is None, reason="no C++ toolchain")
+def test_native_kernel_is_used_and_exact():
+    """With the library present, the native path must agree with the Python DP
+    on character-level inputs (the CER/EditDistance shape of the problem)."""
+    rng = random.Random(7)
+    preds = ["".join(rng.choice("abcdef ") for _ in range(rng.randint(0, 50))) for _ in range(100)]
+    tgts = ["".join(rng.choice("abcdef ") for _ in range(rng.randint(0, 50))) for _ in range(100)]
+    batched = _batch_edit_distance([list(p) for p in preds], [list(t) for t in tgts])
+    expected = np.array([_edit_distance(list(p), list(t)) for p, t in zip(preds, tgts)])
+    np.testing.assert_array_equal(batched, expected)
+
+
+def test_wer_cer_values_survive_batching():
+    """End-to-end: the error-rate kernels give the documented values."""
+    from torchmetrics_tpu.functional.text.wer import char_error_rate, word_error_rate
+
+    preds = ["this is the prediction", "there is an other sample"]
+    target = ["this is the reference", "there is another one"]
+    assert float(word_error_rate(preds, target)) == pytest.approx(0.5)
+    assert float(char_error_rate(preds, target)) == pytest.approx(0.3415, abs=2e-4)

@@ -8,7 +8,7 @@ from typing import Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.text.helper import _edit_distance
+from torchmetrics_tpu.functional.text.helper import _batch_edit_distance
 
 Array = jax.Array
 
@@ -31,7 +31,7 @@ def _edit_distance_update(
         raise ValueError(
             f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
         )
-    distance = [_edit_distance(list(p), list(t), substitution_cost) for p, t in zip(preds, target)]
+    distance = _batch_edit_distance([list(p) for p in preds], [list(t) for t in target], substitution_cost)
     return jnp.asarray(distance, dtype=jnp.int32)
 
 

@@ -36,6 +36,49 @@ def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence, subs
     return int(prev[n])
 
 
+def _batch_edit_distance(
+    pred_seqs: Sequence[Sequence], target_seqs: Sequence[Sequence], substitution_cost: int = 1
+) -> np.ndarray:
+    """Edit distance for every (pred, target) pair at once.
+
+    Tokens are interned to consecutive integer ids (exact equality — no hash
+    collisions), then the whole batch runs through the native C++ DP kernel
+    (``native/edit_distance.cpp``, OpenMP over pairs). Falls back to the
+    per-pair numpy recurrence when no compiler is available.
+    """
+    from torchmetrics_tpu.native import get_edit_library
+
+    if len(pred_seqs) != len(target_seqs):
+        raise ValueError(
+            f"Expected `pred_seqs` and `target_seqs` to have same length, got {len(pred_seqs)} and {len(target_seqs)}"
+        )
+    lib = get_edit_library()
+    if lib is None:
+        return np.array(
+            [_edit_distance(p, t, substitution_cost) for p, t in zip(pred_seqs, target_seqs)],
+            dtype=np.int64,
+        )
+
+    vocab: dict = {}
+
+    def intern(seq):
+        return [vocab.setdefault(tok, len(vocab)) for tok in seq]
+
+    pred_ids = [intern(s) for s in pred_seqs]
+    tgt_ids = [intern(s) for s in target_seqs]
+    pred_flat = np.array([i for s in pred_ids for i in s], dtype=np.uint64)
+    tgt_flat = np.array([i for s in tgt_ids for i in s], dtype=np.uint64)
+    pred_off = np.concatenate(([0], np.cumsum([len(s) for s in pred_ids]))).astype(np.int64)
+    tgt_off = np.concatenate(([0], np.cumsum([len(s) for s in tgt_ids]))).astype(np.int64)
+    out = np.empty(len(pred_ids), dtype=np.int64)
+    lib.batch_edit_distance(
+        pred_flat.ctypes.data, pred_off.ctypes.data,
+        tgt_flat.ctypes.data, tgt_off.ctypes.data,
+        len(pred_ids), substitution_cost, out.ctypes.data,
+    )
+    return out
+
+
 def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
     """All n-grams up to ``n_gram`` (reference ``bleu.py:25-41``)."""
     ngram_counter: Counter = Counter()
@@ -53,4 +96,8 @@ def _normalize_inputs(
         preds = [preds]
     if isinstance(target, str):
         target = [target]
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
     return list(preds), list(target)

@@ -9,7 +9,7 @@ from typing import List, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.text.helper import _edit_distance, _normalize_inputs
+from torchmetrics_tpu.functional.text.helper import _batch_edit_distance, _normalize_inputs
 
 Array = jax.Array
 
@@ -17,11 +17,10 @@ Array = jax.Array
 def _wer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Summed edit ops + reference word count (reference ``wer.py:22-47``)."""
     preds, target = _normalize_inputs(preds, target)
-    errors = total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens, tgt_tokens = pred.split(), tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        total += len(tgt_tokens)
+    pred_tokens = [p.split() for p in preds]
+    tgt_tokens = [t.split() for t in target]
+    errors = int(_batch_edit_distance(pred_tokens, tgt_tokens).sum())
+    total = sum(len(t) for t in tgt_tokens)
     return jnp.asarray(float(errors)), jnp.asarray(float(total))
 
 
@@ -39,10 +38,8 @@ def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
 def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Summed char edit ops + reference char count (reference ``cer.py:22-48``)."""
     preds, target = _normalize_inputs(preds, target)
-    errors = total = 0
-    for pred, tgt in zip(preds, target):
-        errors += _edit_distance(list(pred), list(tgt))
-        total += len(tgt)
+    errors = int(_batch_edit_distance([list(p) for p in preds], [list(t) for t in target]).sum())
+    total = sum(len(t) for t in target)
     return jnp.asarray(float(errors)), jnp.asarray(float(total))
 
 
@@ -60,11 +57,10 @@ def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
 def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
     """Summed edit ops + max(len) count (reference ``mer.py:22-48``)."""
     preds, target = _normalize_inputs(preds, target)
-    errors = total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens, tgt_tokens = pred.split(), tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        total += max(len(tgt_tokens), len(pred_tokens))
+    pred_tokens = [p.split() for p in preds]
+    tgt_tokens = [t.split() for t in target]
+    errors = int(_batch_edit_distance(pred_tokens, tgt_tokens).sum())
+    total = sum(max(len(t), len(p)) for p, t in zip(pred_tokens, tgt_tokens))
     return jnp.asarray(float(errors)), jnp.asarray(float(total))
 
 
@@ -84,13 +80,12 @@ def _wil_wip_update(
 ) -> Tuple[Array, Array, Array]:
     """Shared accumulation of WIL/WIP (reference ``wil.py:21-52``, ``wip.py:21-52``)."""
     preds, target = _normalize_inputs(preds, target)
-    errors = total = target_total = preds_total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens, target_tokens = pred.split(), tgt.split()
-        errors += _edit_distance(pred_tokens, target_tokens)
-        target_total += len(target_tokens)
-        preds_total += len(pred_tokens)
-        total += max(len(target_tokens), len(pred_tokens))
+    pred_tokens = [p.split() for p in preds]
+    tgt_tokens = [t.split() for t in target]
+    errors = int(_batch_edit_distance(pred_tokens, tgt_tokens).sum())
+    target_total = sum(len(t) for t in tgt_tokens)
+    preds_total = sum(len(p) for p in pred_tokens)
+    total = sum(max(len(t), len(p)) for p, t in zip(pred_tokens, tgt_tokens))
     return (
         jnp.asarray(float(errors - total)),
         jnp.asarray(float(target_total)),

@@ -2,9 +2,12 @@
 # Licensed under the Apache License, Version 2.0.
 """Native (C++) host extensions.
 
-Currently: the COCO RLE mask codec (``rle_codec.cpp``), compiled on first use
-with the system ``g++`` into a cached shared object and bound via ``ctypes``.
-A pure-numpy fallback keeps everything working where no compiler exists.
+- ``rle_codec.cpp`` — COCO RLE mask codec (encode/decode/area/IoU/polygon).
+- ``edit_distance.cpp`` — batched Levenshtein DP for the text error rates.
+
+Each source is compiled on first use with the system ``g++`` into a cached
+shared object (keyed by source hash) and bound via ``ctypes``. Pure-numpy
+fallbacks keep everything working where no compiler exists.
 """
 from __future__ import annotations
 
@@ -14,31 +17,35 @@ import os
 import subprocess
 import tempfile
 from pathlib import Path
-from typing import Optional
+from typing import Dict, Optional, Sequence
 
-import numpy as np
-
-_SRC = Path(__file__).parent / "rle_codec.cpp"
-_lib: Optional[ctypes.CDLL] = None
-_lib_tried = False
+_HERE = Path(__file__).parent
+_libs: Dict[str, Optional[ctypes.CDLL]] = {}
 
 
-def _build_library() -> Optional[ctypes.CDLL]:
-    """Compile the codec with g++ (cached by source hash)."""
-    src_hash = hashlib.sha256(_SRC.read_bytes()).hexdigest()[:16]
+def _build_library(stem: str, extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Compile ``<stem>.cpp`` with g++ (cached by source hash)."""
+    src = _HERE / f"{stem}.cpp"
+    try:
+        src_hash = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    except OSError:
+        return None  # source not shipped — callers use their numpy fallbacks
     cache_dir = Path(os.environ.get("TM_TPU_NATIVE_CACHE", Path(tempfile.gettempdir()) / "tm_tpu_native"))
     cache_dir.mkdir(parents=True, exist_ok=True)
-    so_path = cache_dir / f"rle_codec_{src_hash}.so"
+    so_path = cache_dir / f"{stem}_{src_hash}.so"
     if not so_path.exists():
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", str(_SRC), "-o", str(so_path)]
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *extra_flags, str(src), "-o", str(so_path)]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         except (subprocess.SubprocessError, FileNotFoundError):
             return None
     try:
-        lib = ctypes.CDLL(str(so_path))
+        return ctypes.CDLL(str(so_path))
     except OSError:
         return None
+
+
+def _bind_rle(lib: ctypes.CDLL) -> None:
     lib.rle_encode.restype = ctypes.c_uint64
     lib.rle_encode.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
     lib.rle_decode.restype = None
@@ -51,16 +58,34 @@ def _build_library() -> Optional[ctypes.CDLL]:
     lib.rle_iou_matrix.argtypes = [ctypes.c_void_p] * 3 + [ctypes.c_uint64] + [ctypes.c_void_p] * 3 + [ctypes.c_uint64] + [ctypes.c_void_p] * 2
     lib.rle_from_polygon.restype = ctypes.c_uint64
     lib.rle_from_polygon.argtypes = [ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_void_p]
-    return lib
+
+
+def _bind_edit(lib: ctypes.CDLL) -> None:
+    lib.batch_edit_distance.restype = None
+    lib.batch_edit_distance.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+    ]
+
+
+def _get_library(stem: str, bind, extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Build/load + bind prototypes once per process, cached by stem."""
+    if stem not in _libs:
+        lib = _build_library(stem, extra_flags)
+        if lib is not None:
+            bind(lib)
+        _libs[stem] = lib
+    return _libs[stem]
 
 
 def get_rle_library() -> Optional[ctypes.CDLL]:
     """The compiled codec, or ``None`` if compilation isn't possible."""
-    global _lib, _lib_tried
-    if not _lib_tried:
-        _lib = _build_library()
-        _lib_tried = True
-    return _lib
+    return _get_library("rle_codec", _bind_rle)
+
+
+def get_edit_library() -> Optional[ctypes.CDLL]:
+    """The compiled batched edit-distance kernel, or ``None``."""
+    return _get_library("edit_distance", _bind_edit, extra_flags=("-fopenmp",))
 
 
 def native_available() -> bool:
