@@ -52,22 +52,87 @@ def test_collection_basic():
 def test_collection_compute_groups_merge():
     col = MetricCollection([SumM(), MeanM()])
     col.update(jnp.asarray([1.0]))
-    # identical states -> one group
+    # merging is deferred until two independent batches agree
+    assert len(col.compute_groups) == 2
+    col.update(jnp.asarray([2.0, 3.0]))
+    # identical states twice in a row -> one group
     assert len(col.compute_groups) == 1
-    col.update(jnp.asarray([2.0, 3.0]))  # only leader updates
+    col.update(jnp.asarray([4.0]))  # only leader updates now
     res = col.compute()
-    assert float(res["SumM"]) == 6.0
-    assert float(res["MeanM"]) == 2.0
+    assert float(res["SumM"]) == 10.0
+    assert float(res["MeanM"]) == 2.5
 
 
 def test_collection_groups_split_on_different_states():
     col = MetricCollection({"a": SumM(), "b": SumM(scale=2.0)})
     col.update(jnp.asarray([1.0]))
-    assert len(col.compute_groups) == 2
     col.update(jnp.asarray([1.0]))
+    assert len(col.compute_groups) == 2
     res = col.compute()
     assert float(res["a"]) == 2.0
     assert float(res["b"]) == 4.0
+
+
+def test_collection_no_false_merge_on_first_batch_coincidence():
+    """Metrics whose states coincide on the first batch but diverge later must
+    NOT share state (the reference's one-update heuristic falsely fuses e.g.
+    WER with MER when the first batch has no length mismatch)."""
+    from torchmetrics_tpu import MatchErrorRate, WordErrorRate
+    from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+    preds = ["this is the prediction", "there is an other sample"]
+    target = ["this is the reference", "there is another one"]
+    col = MetricCollection({"wer": WordErrorRate(), "mer": MatchErrorRate()})
+    for p, t in zip(preds, target):
+        col.update([p], [t])
+    res = col.compute()
+    errors = sum(_edit_distance(p.split(), t.split()) for p, t in zip(preds, target))
+    wer_tot = sum(len(t.split()) for t in target)
+    mer_tot = sum(max(len(p.split()), len(t.split())) for p, t in zip(preds, target))
+    assert float(res["wer"]) == pytest.approx(errors / wer_tot)
+    assert float(res["mer"]) == pytest.approx(errors / mer_tot)
+    assert float(res["wer"]) != float(res["mer"])
+
+
+def test_collection_divergence_evidence_survives_reset():
+    """A pre-reset batch on which two metrics' states DIVERGE keeps them
+    split even when the post-reset batch coincides (partition intersection)."""
+    from torchmetrics_tpu import MatchErrorRate, WordErrorRate
+
+    col = MetricCollection({"wer": WordErrorRate(), "mer": MatchErrorRate()})
+    col.update(["a b c"], ["a b"])  # length mismatch: wer total=2, mer total=3
+    col.reset()
+    col.update(["this is the prediction"], ["this is the reference"])  # states coincide
+    col.update(["there is an other sample"], ["there is another one"])  # diverge again
+    res = col.compute()
+    assert float(res["wer"]) != float(res["mer"])
+
+
+def test_collection_groups_form_in_update_compute_reset_loop():
+    """The common per-step update/compute/reset loop must still establish
+    compute groups (the dedup optimization) by the second step."""
+    from torchmetrics_tpu.classification.f_beta import MulticlassF1Score
+    from torchmetrics_tpu.classification.precision_recall import MulticlassPrecision, MulticlassRecall
+
+    rng = np.random.RandomState(3)
+    col = MetricCollection({
+        "p": MulticlassPrecision(num_classes=4),
+        "r": MulticlassRecall(num_classes=4),
+        "f1": MulticlassF1Score(num_classes=4),
+    })
+    for _ in range(3):
+        col.update(rng.randint(0, 4, 32), rng.randint(0, 4, 32))
+        col.compute()
+        col.reset()
+    assert col._groups_checked
+    assert len(col.compute_groups) == 1
+
+
+def test_text_error_rates_reject_mismatched_lengths():
+    from torchmetrics_tpu.functional.text.wer import word_error_rate
+
+    with pytest.raises(ValueError, match="same length"):
+        word_error_rate(["a b", "c d"], "a b")
 
 
 def test_collection_prefix_postfix_clone():

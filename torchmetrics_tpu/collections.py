@@ -14,7 +14,10 @@ same observable behavior, no aliasing hazards.
 Group discovery keys on the cheap state-spec signature first (names,
 reductions, shapes, dtypes — instead of the reference's O(n²) value
 comparison, see SURVEY §7) and falls back to value equality within a
-signature bucket after the first update.
+signature bucket. Metrics merge only when the partitions observed at TWO
+individual update events agree (intersection) — the reference merges after
+one, which falsely fuses metrics whose states coincide on the first batch
+(e.g. WER vs MER when no length mismatch has occurred yet).
 """
 from __future__ import annotations
 
@@ -56,6 +59,7 @@ class MetricCollection(dict):
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
         self._groups_checked: bool = False
+        self._pending_groups: Optional[Dict[int, List[str]]] = None
         self._state_is_copy: bool = False
         self._groups: Dict[int, List[str]] = {}
 
@@ -152,6 +156,7 @@ class MetricCollection(dict):
         else:
             raise ValueError("Unknown input to MetricCollection.")
         self._groups_checked = False
+        self._pending_groups = None
         if self._enable_compute_groups:
             self._init_compute_groups()
         else:
@@ -161,7 +166,7 @@ class MetricCollection(dict):
         """Initial group assignment (reference ``collections.py:_init_compute_groups``).
 
         User-specified groups are trusted; otherwise every metric starts in
-        its own group and groups merge after the first update.
+        its own group and groups merge after the first two updates.
         """
         if isinstance(self._enable_compute_groups, list):
             self._groups = dict(enumerate(self._enable_compute_groups))
@@ -197,34 +202,71 @@ class MetricCollection(dict):
             for m in self._base_metrics.values():
                 m.update(*args, **m._filter_kwargs(**kwargs))
             if self._enable_compute_groups and not self._groups_checked:
-                self._merge_compute_groups()
-                self._groups_checked = True
-
-    def _merge_compute_groups(self) -> None:
-        """Merge groups whose metrics ended the first update with identical
-        states (reference ``collections.py:238-272``); candidates are
-        pre-bucketed by state-spec signature so comparisons stay cheap."""
-        num_groups = len(self._groups)
-        while True:
-            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
-                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
-                    if cg_idx1 == cg_idx2:
-                        continue
-                    metric1 = dict.__getitem__(self, cg_members1[0])
-                    metric2 = dict.__getitem__(self, cg_members2[0])
-                    if self._equal_metric_states(metric1, metric2):
-                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
-                        break
+                # Merge only when TWO individual update events agree on which
+                # metrics hold identical states. The reference merges after
+                # ONE (collections.py:227-230), which falsely fuses metrics
+                # whose states coincide on the first batch (e.g. WER vs MER
+                # when no length mismatch occurs yet). Partition intersection
+                # lets divergence evidence persist across ``reset()``, so the
+                # common update/compute/reset-per-step loop still forms
+                # groups at the second step.
+                current = self._value_groups()
+                if self._pending_groups is None:
+                    self._pending_groups = current
                 else:
-                    continue
-                break
+                    self._groups = self._intersect_groups(self._pending_groups, current)
+                    self._pending_groups = None
+                    self._groups_checked = True
+
+    def _value_groups(self) -> Dict[int, List[str]]:
+        """Partition metrics by current state equality (the reference's
+        ``_merge_compute_groups``, ``collections.py:238-272``); candidates are
+        pre-bucketed by state-spec signature so comparisons stay cheap.
+
+        Like the reference, this is a value-equality heuristic: metrics whose
+        states coincide on every batch seen before the merge are fused for
+        good. Pass ``compute_groups`` as an explicit list (or ``False``) to
+        override the automatic grouping.
+        """
+        groups: List[List[str]] = []
+        reps: Dict[tuple, List[int]] = {}  # spec signature -> group positions
+        for key in sorted(dict.keys(self)):
+            metric = dict.__getitem__(self, key)
+            sig = self._state_spec_signature(metric)
+            for gi in reps.get(sig, []):
+                if self._equal_metric_states(dict.__getitem__(self, groups[gi][0]), metric):
+                    groups[gi].append(key)
+                    break
             else:
-                break
-            if len(self._groups) == num_groups:
-                break
-            num_groups = len(self._groups)
-        # rename group keys 0..N
-        self._groups = dict(enumerate(self._groups.values()))
+                reps.setdefault(sig, []).append(len(groups))
+                groups.append([key])
+        return dict(enumerate(groups))
+
+    @staticmethod
+    def _intersect_groups(g1: Dict[int, List[str]], g2: Dict[int, List[str]]) -> Dict[int, List[str]]:
+        """Coarsest common refinement: metrics stay grouped only if BOTH
+        partitions co-grouped them."""
+        label1 = {k: i for i, members in g1.items() for k in members}
+        label2 = {k: i for i, members in g2.items() for k in members}
+        buckets: Dict[tuple, List[str]] = {}
+        for k in sorted(label1):
+            buckets.setdefault((label1[k], label2.get(k)), []).append(k)
+        return dict(enumerate(buckets.values()))
+
+    @staticmethod
+    def _state_spec_signature(metric: Metric) -> tuple:
+        """Hashable (name, kind, shape, dtype, reduction) spec of a metric's
+        current states; only equal-signature groups can possibly merge."""
+        parts = []
+        for key in sorted(metric._defaults):
+            val = getattr(metric, key)
+            red = metric._reductions.get(key)
+            red_tok = red if isinstance(red, (str, type(None))) else getattr(red, "__name__", repr(red))
+            if isinstance(val, list):
+                parts.append((key, "list", tuple((tuple(v.shape), str(v.dtype)) for v in val), red_tok))
+            else:
+                parts.append((key, "array", tuple(val.shape), str(val.dtype), red_tok))
+        return tuple(parts)
 
     @staticmethod
     def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
@@ -306,6 +348,9 @@ class MetricCollection(dict):
         """Reset all metrics (reference ``collections.py:391``)."""
         for m in self._base_metrics.values():
             m.reset()
+        # _pending_groups deliberately survives reset: a partition observed on
+        # a pre-reset batch is still one independent agreement/divergence
+        # check, so per-step update/compute/reset loops form groups normally.
         if self._enable_compute_groups and self._groups_checked:
             self._state_is_copy = False
 
