@@ -228,6 +228,18 @@ def bench_reference(n_batches: int) -> float:
 
 
 def main() -> None:
+    # persistent compilation cache: repeated bench runs over the remote TPU
+    # tunnel skip the (slow) XLA compile of the big workload programs
+    try:
+        import os
+
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+
     n_batches = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     ours_sps = bench_ours(n_batches)
     baseline_live = True
@@ -242,13 +254,14 @@ def main() -> None:
     # are labelled as such — see BASELINE.md for the CUDA measurement plan
     extras = {}
     try:
-        from bench_workloads import bench_coco_map, bench_fid, bench_retrieval_ndcg, bench_ssim
+        from bench_workloads import bench_bertscore, bench_coco_map, bench_fid, bench_retrieval_ndcg, bench_ssim
 
         for name, fn, args in (
             ("ssim", bench_ssim, (max(4, n_batches // 2),)),
             ("retrieval_ndcg", bench_retrieval_ndcg, (max(4, n_batches // 2),)),
             ("coco_map", bench_coco_map, ()),
             ("fid_inception", bench_fid, (max(4, n_batches // 2),)),
+            ("bertscore", bench_bertscore, (max(32, n_batches * 8),)),
         ):
             try:
                 ours, baseline, unit = fn(*args)

@@ -143,6 +143,55 @@ def bench_coco_map() -> Tuple[float, Optional[float], str]:
     return ours, None, "images/s"
 
 
+def bench_bertscore(n_pairs: int = 128) -> Tuple[float, Optional[float], str]:
+    """Sentence-pairs/sec of BERTScore end to end on pre-tokenized inputs
+    (reference ``functional/text/bert.py:69-257``: transformer forward is the
+    hot loop, then pairwise cosine + greedy match). A BERT-base-sized encoder
+    with random weights — FLOP-identical to a trained bert-base checkpoint;
+    the torch-CPU baseline runs the reference pipeline on the same shapes."""
+    import jax
+    from transformers import BertConfig, FlaxBertModel
+
+    from torchmetrics_tpu.functional.text.bert import bert_score
+
+    seq, batch_size, num_layers = 128, 32, 12
+    rng = np.random.default_rng(0)
+    lens = rng.integers(seq // 2, seq + 1, n_pairs)
+    mask = (np.arange(seq)[None, :] < lens[:, None]).astype(np.int64)
+    preds = {"input_ids": rng.integers(5, 30000, (n_pairs, seq)), "attention_mask": mask}
+    target = {"input_ids": rng.integers(5, 30000, (n_pairs, seq)), "attention_mask": mask}
+
+    # init weights on the host CPU backend: eager random init on a remote TPU
+    # costs one round-trip per op (~minutes for bert-base); the jitted forward
+    # transfers them in one shot on first call
+    with jax.default_device(jax.local_devices(backend="cpu")[0]):
+        model = FlaxBertModel(BertConfig(), seed=0)
+        jax.block_until_ready(model.params)
+    bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)  # compile + warm
+    t0 = time.perf_counter()
+    out = bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)
+    np.asarray(out["f1"])  # forced materialization
+    ours = n_pairs / (time.perf_counter() - t0)
+
+    baseline = None
+    try:
+        import torch
+        from torchmetrics.functional.text.bert import bert_score as ref_bert_score
+        from transformers import BertModel
+
+        tmodel = BertModel(BertConfig()).eval()
+        n_b = max(8, n_pairs // 32)
+        tp = {k: torch.from_numpy(np.asarray(v[:n_b])) for k, v in preds.items()}
+        tt = {k: torch.from_numpy(np.asarray(v[:n_b])) for k, v in target.items()}
+        t0 = time.perf_counter()
+        with torch.no_grad():
+            ref_bert_score(tp, tt, model=tmodel, batch_size=batch_size, num_layers=num_layers)
+        baseline = n_b / (time.perf_counter() - t0)
+    except Exception:
+        pass
+    return ours, baseline, "pairs/s"
+
+
 def bench_fid(n_batches: int = 8) -> Tuple[float, Optional[float], str]:
     """Images/sec of the FID pipeline: Flax InceptionV3 feature extraction
     (the FLOP-dominant part of FID-50k) + streaming sum/cov updates on device.

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.functional.multimodal.clip_score import _get_clip_model_and_processor
+from torchmetrics_tpu.utilities.jit_cache import jitted_forward
 
 Array = jax.Array
 
@@ -68,7 +69,9 @@ def _clip_iqa_get_anchor_vectors(model: Any, processor: Callable, prompts_list: 
     """Unit-norm text anchors (reference ``clip_iqa.py:145-176``)."""
     processed = processor(text=prompts_list, return_tensors="np", padding=True)
     anchors = jnp.asarray(
-        model.get_text_features(jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"]))
+        jitted_forward(model, "get_text_features")(
+            jnp.asarray(processed["input_ids"]), jnp.asarray(processed["attention_mask"])
+        )
     )
     return anchors / jnp.linalg.norm(anchors, axis=-1, keepdims=True)
 
@@ -79,7 +82,7 @@ def _clip_iqa_update(
     """Unit-norm image features (reference ``clip_iqa.py:179-204``)."""
     images = jnp.asarray(images) / float(data_range)
     processed = processor(images=[np.asarray(i) for i in images], return_tensors="np", padding=True)
-    img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
+    img_features = jnp.asarray(jitted_forward(model, "get_image_features")(jnp.asarray(processed["pixel_values"])))
     return img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
 
 

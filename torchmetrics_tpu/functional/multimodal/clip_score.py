@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.utilities.jit_cache import jitted_forward
 from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -65,7 +66,7 @@ def _clip_score_update(
         )
     processed = processor(text=text, images=[np.asarray(i) for i in images], return_tensors="np", padding=True)
 
-    img_features = jnp.asarray(model.get_image_features(jnp.asarray(processed["pixel_values"])))
+    img_features = jnp.asarray(jitted_forward(model, "get_image_features")(jnp.asarray(processed["pixel_values"])))
     img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
 
     max_position_embeddings = model.config.text_config.max_position_embeddings
@@ -82,7 +83,7 @@ def _clip_score_update(
         attention_mask = attention_mask[..., :max_position_embeddings]
         input_ids = input_ids[..., :max_position_embeddings]
 
-    txt_features = jnp.asarray(model.get_text_features(input_ids, attention_mask))
+    txt_features = jnp.asarray(jitted_forward(model, "get_text_features")(input_ids, attention_mask))
     txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
 
     score = 100 * (img_features * txt_features).sum(axis=-1)

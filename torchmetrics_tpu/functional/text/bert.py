@@ -53,15 +53,15 @@ def _embed(
     input_ids: np.ndarray,
     attention_mask: np.ndarray,
     model: Any,
-    num_layers: Optional[int],
-    user_forward_fn: Optional[Callable],
+    user_forward_fn: Callable,
     idf: bool,
     tokens_idf: Optional[Dict[int, float]],
     batch_size: int,
-    all_layers: bool = False,
-) -> Tuple[np.ndarray, np.ndarray]:
+) -> Tuple[Array, Array]:
     """Unit-norm token embeddings masked for special tokens + per-sentence
-    normalized idf scales (reference ``bert.py:69-149``)."""
+    normalized idf scales, via a user-supplied forward (reference
+    ``bert.py:69-149``). The default Flax path runs the fused corpus program
+    (:func:`_fused_score_forward`) instead."""
     # trim to the longest real sequence (reference _input_data_collator)
     real_len = int(attention_mask.sum(1).max())
     input_ids = input_ids[:, :real_len]
@@ -70,22 +70,14 @@ def _embed(
     for start in range(0, input_ids.shape[0], batch_size):
         ids = jnp.asarray(input_ids[start : start + batch_size])
         mask = jnp.asarray(attention_mask[start : start + batch_size])
-        if user_forward_fn is not None:
-            out = user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})
-            out = jnp.asarray(out)[:, None]  # (B, 1, S, D)
-        else:
-            result = model(ids, mask, output_hidden_states=True)
-            hidden = result.hidden_states
-            if all_layers:
-                out = jnp.stack([jnp.asarray(h) for h in hidden], axis=1)  # (B, L, S, D)
-            else:
-                out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])[:, None]
+        out = user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})
+        out = jnp.asarray(out)[:, None]  # (B, 1, S, D)
         out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
-        embeddings_list.append(np.asarray(out))
-    embeddings = np.concatenate(embeddings_list)  # (B, L, S, D); L == 1 unless all_layers
+        embeddings_list.append(out)
+    embeddings = jnp.concatenate(embeddings_list)  # (B, L, S, D); L == 1 unless all_layers
 
     processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
-    embeddings = embeddings * processed_mask[:, None, :, None]
+    embeddings = embeddings * jnp.asarray(processed_mask)[:, None, :, None]
 
     if idf:
         assert tokens_idf is not None
@@ -94,28 +86,131 @@ def _embed(
     else:
         idf_weights = processed_mask.astype(np.float64)
     idf_scale = idf_weights / idf_weights.sum(-1, keepdims=True)
-    return embeddings, idf_scale
+    return embeddings, jnp.asarray(idf_scale, jnp.float32)
 
 
+def _pairwise_prf(
+    preds_embeddings: Array,
+    target_embeddings: Array,
+    preds_idf_scale: Array,
+    target_idf_scale: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matching P/R/F1 over ``(B, L, S, D)`` embeddings as ``(B, L)``
+    (reference ``bert.py:150-184``); the layer axis L is 1 unless
+    ``all_layers``. Traced into the fused score program."""
+    cos_sim = jnp.einsum("blpd, blrd -> blpr", preds_embeddings, target_embeddings)
+    precision = (cos_sim.max(axis=3) * preds_idf_scale[:, None, :]).sum(-1)  # (B, L)
+    recall = (cos_sim.max(axis=2) * target_idf_scale[:, None, :]).sum(-1)
+    f1 = 2 * precision * recall / (precision + recall)
+    return precision, recall, jnp.nan_to_num(f1)
+
+
+def _flatten_layerwise(t: Array) -> Array:
+    """Reference output layout: (L, B) squeezed to (B,) for L == 1."""
+    return jnp.squeeze(t.T, 0) if t.shape[1] == 1 else t.T.reshape(-1)
+
+
+@jax.jit
 def _get_precision_recall_f1(
     preds_embeddings: Array,
     target_embeddings: Array,
     preds_idf_scale: Array,
     target_idf_scale: Array,
 ) -> Tuple[Array, Array, Array]:
-    """Greedy-matching P/R/F1 over ``(B, L, S, D)`` embeddings (reference
-    ``bert.py:150-184``); the layer axis L is 1 unless ``all_layers``."""
-    cos_sim = jnp.einsum("blpd, blrd -> blpr", preds_embeddings, target_embeddings)
-    precision = (cos_sim.max(axis=3) * preds_idf_scale[:, None, :]).sum(-1)  # (B, L)
-    recall = (cos_sim.max(axis=2) * target_idf_scale[:, None, :]).sum(-1)
-    f1 = 2 * precision * recall / (precision + recall)
-    f1 = jnp.nan_to_num(f1)
+    """Standalone jitted matching (the ``user_forward_fn`` path)."""
+    precision, recall, f1 = _pairwise_prf(
+        preds_embeddings, target_embeddings, preds_idf_scale, target_idf_scale
+    )
+    return _flatten_layerwise(precision), _flatten_layerwise(recall), _flatten_layerwise(f1)
 
-    # match the reference output layout: (L, B) squeezed to (B,) for L == 1
-    def _flatten(t: Array) -> Array:
-        return jnp.squeeze(t.T, 0) if t.shape[1] == 1 else t.T.reshape(-1)
 
-    return _flatten(precision), _flatten(recall), _flatten(f1)
+def _fused_score_forward(model: Any, num_layers: Optional[int], all_layers: bool) -> Callable:
+    """ONE compiled program for the whole corpus: a ``lax.map`` over chunks,
+    each chunk running encoder forward for BOTH sides + special-token
+    masking + idf scaling + greedy matching.
+
+    One dispatch per *evaluation*, not per chunk: on a remote TPU every
+    dispatch re-ships the weight pytree (~0.4GB for bert-base), so the
+    whole corpus must ride a single call — inputs go up once, one small
+    ``(C, 3, bs, L)`` score tensor comes down."""
+    from torchmetrics_tpu.utilities.jit_cache import jitted_forward
+
+    def make_fn(m):
+        def encode(params, ids, mask, pmask):
+            hidden = m(ids, mask, params=params, output_hidden_states=True).hidden_states
+            if all_layers:
+                out = jnp.stack(hidden, axis=1)  # (bs, L, S, D)
+            else:
+                out = hidden[num_layers if num_layers is not None else -1][:, None]
+            out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
+            return out * pmask[:, None, :, None]
+
+        def fwd(params, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t):
+            def body(chunk):
+                i_p, a_p, p_p, s_p, i_t, a_t, p_t, s_t = chunk
+                emb_p = encode(params, i_p, a_p, p_p)
+                emb_t = encode(params, i_t, a_t, p_t)
+                return jnp.stack(_pairwise_prf(emb_p, emb_t, s_p, s_t))  # (3, bs, L)
+
+            return jax.lax.map(body, (ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t))
+
+        return fwd
+
+    return jitted_forward(model, f"fused_score:{num_layers}:{all_layers}", make_fn)
+
+
+def _host_side_inputs(
+    input_ids: np.ndarray, attention_mask: np.ndarray, idf: bool, tokens_idf: Optional[Dict[int, float]]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Trim to the longest real sequence + special-token mask + idf scale
+    (the cheap host-side prep of reference ``bert.py:69-149``)."""
+    real_len = int(attention_mask.sum(1).max())
+    input_ids = input_ids[:, :real_len]
+    attention_mask = attention_mask[:, :real_len]
+    pmask = _process_attention_mask_for_special_tokens(attention_mask)
+    if idf:
+        assert tokens_idf is not None
+        weights = np.vectorize(lambda t: tokens_idf[int(t)])(input_ids).astype(np.float64) * pmask
+    else:
+        weights = pmask.astype(np.float64)
+    scale = weights / weights.sum(-1, keepdims=True)
+    return input_ids, attention_mask, pmask, scale.astype(np.float32)
+
+
+def _chunked_fused_score(
+    preds_ids: np.ndarray,
+    preds_mask: np.ndarray,
+    target_ids: np.ndarray,
+    target_mask: np.ndarray,
+    model: Any,
+    num_layers: Optional[int],
+    all_layers: bool,
+    idf: bool,
+    tokens_idf: Optional[Dict[int, float]],
+    batch_size: int,
+) -> Tuple[Array, Array, Array]:
+    """Run the fused corpus program: ONE device dispatch for all pairs,
+    nothing but ``(C, 3, bs, L)`` scores crossing the wire back."""
+    ids_p, am_p, pm_p, sc_p = _host_side_inputs(preds_ids, preds_mask, idf, tokens_idf)
+    ids_t, am_t, pm_t, sc_t = _host_side_inputs(target_ids, target_mask, idf, tokens_idf)
+    n = ids_p.shape[0]
+    fn = _fused_score_forward(model, num_layers, all_layers)
+    # pad to full chunks; padded rows have zero masks/scales and are trimmed
+    # before returning
+    n_pad = (-n) % batch_size
+
+    def chunked(x):
+        if n_pad:
+            x = np.pad(x, ((0, n_pad),) + ((0, 0),) * (x.ndim - 1))
+        return x.reshape(-1, batch_size, *x.shape[1:])
+
+    out = np.asarray(fn(*(chunked(a) for a in (ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t))))
+    prf = np.moveaxis(out, 1, 0).reshape(3, n + n_pad, -1)[:, :n]  # (3, B, L)
+
+    def flat(t: np.ndarray) -> np.ndarray:
+        return t.T.squeeze(0) if t.shape[1] == 1 else t.T.reshape(-1)
+
+    return flat(prf[0]), flat(prf[1]), flat(prf[2])
 
 
 def _load_default_model(model_name_or_path: str):
@@ -186,29 +281,34 @@ def bert_score(
     target_ids, target_mask = tokenize(target)
 
     tokens_idf = _get_tokens_idf(target_ids, target_mask) if idf else None
-    preds_emb, preds_scale = _embed(
-        preds_ids, preds_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size, all_layers
-    )
-    target_emb, target_scale = _embed(
-        target_ids, target_mask, model, num_layers, user_forward_fn, idf, tokens_idf, batch_size, all_layers
-    )
 
-    # pad both sides to a common sequence length for one batched einsum
-    max_len = max(preds_emb.shape[2], target_emb.shape[2])
+    if user_forward_fn is not None:
+        preds_emb, preds_scale = _embed(
+            preds_ids, preds_mask, model, user_forward_fn, idf, tokens_idf, batch_size
+        )
+        target_emb, target_scale = _embed(
+            target_ids, target_mask, model, user_forward_fn, idf, tokens_idf, batch_size
+        )
 
-    def pad_to(x, scale):
-        pad = max_len - x.shape[2]
-        if pad:
-            x = np.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
-            scale = np.pad(scale, ((0, 0), (0, pad)))
-        return x, scale
+        # pad both sides to a common sequence length for one batched einsum
+        max_len = max(preds_emb.shape[2], target_emb.shape[2])
 
-    preds_emb, preds_scale = pad_to(preds_emb, preds_scale)
-    target_emb, target_scale = pad_to(target_emb, target_scale)
+        def pad_to(x, scale):
+            pad = max_len - x.shape[2]
+            if pad:
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+                scale = jnp.pad(scale, ((0, 0), (0, pad)))
+            return x, scale
 
-    precision, recall, f1 = _get_precision_recall_f1(
-        jnp.asarray(preds_emb), jnp.asarray(target_emb), jnp.asarray(preds_scale), jnp.asarray(target_scale)
-    )
+        preds_emb, preds_scale = pad_to(preds_emb, preds_scale)
+        target_emb, target_scale = pad_to(target_emb, target_scale)
+
+        precision, recall, f1 = _get_precision_recall_f1(preds_emb, target_emb, preds_scale, target_scale)
+    else:
+        precision, recall, f1 = _chunked_fused_score(
+            preds_ids, preds_mask, target_ids, target_mask,
+            model, num_layers, all_layers, idf, tokens_idf, batch_size,
+        )
 
     if rescale_with_baseline and baseline_path is not None:
         import csv

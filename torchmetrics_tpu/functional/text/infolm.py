@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.utilities.imports import ModuleAvailableCache
+from torchmetrics_tpu.utilities.jit_cache import jitted_forward
 
 Array = jax.Array
 
@@ -154,9 +155,10 @@ def _get_data_distribution(
         # (L, B, S): variant l has position l replaced with [MASK]
         ids_rep = np.broadcast_to(ids, (s, b, s)).copy()
         ids_rep[np.arange(s), :, np.arange(s)] = special_tokens_map["mask_token_id"]
-        logits = model(
+        mlm_logits = jitted_forward(model, "mlm_logits", lambda m: lambda p, i, a: m(i, a, params=p).logits)
+        logits = mlm_logits(
             jnp.asarray(ids_rep.reshape(s * b, s)), jnp.asarray(np.broadcast_to(att, (s, b, s)).reshape(s * b, s))
-        ).logits  # (L*B, S, V)
+        )  # (L*B, S, V)
         logits = jnp.asarray(logits).reshape(s, b, s, -1)
         # distribution at the masked position of each variant -> (B, S, V)
         probs = jax.nn.softmax(logits[jnp.arange(s), :, jnp.arange(s)] / temperature, axis=-1)

@@ -1,6 +1,7 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
 """Text module metrics (reference ``src/torchmetrics/text/__init__.py``)."""
+from torchmetrics_tpu.text.bert import BERTScore
 from torchmetrics_tpu.text.infolm import InfoLM
 from torchmetrics_tpu.text.metrics import (
     BLEUScore,
@@ -20,6 +21,7 @@ from torchmetrics_tpu.text.metrics import (
 )
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
     "CharErrorRate",
     "CHRFScore",

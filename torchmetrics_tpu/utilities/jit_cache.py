@@ -1,0 +1,80 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Per-object cache of jitted forward functions for embedded towers.
+
+Flax transformers models called eagerly dispatch thousands of individual XLA
+ops — one host round-trip each on a remote TPU. Metrics that embed a neural
+tower (BERTScore, InfoLM, CLIPScore, CLIP-IQA) route every model call through
+here so the whole encoder runs as ONE compiled program per input shape.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+_CACHE: Dict[Tuple[int, str], Callable] = {}
+_PARAMS_ON_DEVICE: Dict[int, Tuple[Any, Any]] = {}  # id(obj) -> (source params, device copy)
+
+
+def _device_params(obj: Any) -> Any:
+    """The model's params resident on the default device, transferred once.
+
+    Towers are initialized on the host CPU backend (eager random init on a
+    remote TPU costs one round-trip per op); without this cache every jit
+    call would re-upload the full weight pytree (~0.4GB for bert-base) over
+    the wire. Re-transfers only when ``obj.params`` is rebound.
+    """
+    entry = _PARAMS_ON_DEVICE.get(id(obj))
+    src = obj.params
+    if entry is None or entry[0] is not src:
+        entry = (src, jax.device_put(src))
+        _PARAMS_ON_DEVICE[id(obj)] = entry
+    return entry[1]
+
+
+def jitted_forward(obj: Any, method: str, make_fn: Optional[Callable[[Any], Callable]] = None) -> Callable:
+    """A jitted callable for ``obj.<method>``, compiled once per (object, tag).
+
+    The model's weights enter the compiled program as jit ARGUMENTS, never as
+    captured constants — baking ~100M floats into the HLO multiplies compile
+    time several-fold (measured 140s → 18s for a 2-layer BERT on a remote
+    TPU). ``obj.params`` is re-read on every call, so weight swaps are seen.
+
+    ``make_fn(obj)`` can build a custom closure ``inner(params, *args)``
+    instead (e.g. to select an output field) — ``method`` then only serves as
+    the cache tag.
+    """
+    key = (id(obj), method)
+    fn = _CACHE.get(key)
+    if fn is None:
+        if make_fn is not None:
+            inner = make_fn(obj)
+        else:
+            bound = getattr(obj, method)
+
+            def inner(params, *args):
+                return bound(*args, params=params)
+
+        fn = _CACHE[key] = jax.jit(inner)
+
+    def call(*args):
+        return fn(_device_params(obj), *args)
+
+    return call
+
+
+def evict(obj: Any = None) -> None:
+    """Drop cached programs and device weights — for ``obj``, or all.
+
+    The caches are id-keyed and pin the model, its compiled programs, and a
+    device-resident weight copy for process lifetime; long-lived processes
+    that construct many towers should evict the ones they retire.
+    """
+    if obj is None:
+        _CACHE.clear()
+        _PARAMS_ON_DEVICE.clear()
+        return
+    for key in [k for k in _CACHE if k[0] == id(obj)]:
+        del _CACHE[key]
+    _PARAMS_ON_DEVICE.pop(id(obj), None)
