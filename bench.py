@@ -18,6 +18,7 @@ metric suite — Accuracy + macro-F1 (confusion-matrix state), binned AUROC
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
+import os
 import sys
 import time
 
@@ -231,8 +232,6 @@ def main() -> None:
     # persistent compilation cache: repeated bench runs over the remote TPU
     # tunnel skip the (slow) XLA compile of the big workload programs
     try:
-        import os
-
         import jax
 
         jax.config.update("jax_compilation_cache_dir", os.path.join(os.path.dirname(__file__), ".jax_cache"))
@@ -249,10 +248,18 @@ def main() -> None:
         ref_sps = RECORDED_BASELINE_SPS
         baseline_live = False
 
-    # secondary workloads (SSIM, retrieval NDCG, COCO mAP, FID inception); baselines are the
-    # reference TorchMetrics on torch-CPU (this image has no CUDA build) and
-    # are labelled as such — see BASELINE.md for the CUDA measurement plan
+    # secondary workloads (SSIM, retrieval NDCG, COCO mAP, FID inception,
+    # BERTScore); baselines are the reference TorchMetrics on torch-CPU (this
+    # image has no CUDA build) and are labelled as such — see BASELINE.md for
+    # the CUDA measurement plan. A soft wall-clock budget guarantees the JSON
+    # line always lands inside the driver's window: remaining workloads are
+    # skipped (and say so) once the budget is spent.
     extras = {}
+    try:
+        budget_s = float(os.environ.get("TM_TPU_BENCH_BUDGET_S", "420"))
+    except ValueError:
+        budget_s = 420.0
+    t_start = time.perf_counter()
     try:
         from bench_workloads import bench_bertscore, bench_coco_map, bench_fid, bench_retrieval_ndcg, bench_ssim
 
@@ -261,17 +268,24 @@ def main() -> None:
             ("retrieval_ndcg", bench_retrieval_ndcg, (max(4, n_batches // 2),)),
             ("coco_map", bench_coco_map, ()),
             ("fid_inception", bench_fid, (max(4, n_batches // 2),)),
-            ("bertscore", bench_bertscore, (max(32, n_batches * 8),)),
+            ("bertscore", bench_bertscore, (max(64, n_batches * 16),)),
         ):
-            try:
-                ours, baseline, unit = fn(*args)
-                extras[name] = {
-                    "value": round(ours, 1),
-                    "unit": unit,
-                    "vs_torch_cpu": round(ours / baseline, 2) if baseline else None,
-                }
-            except Exception as err:  # pragma: no cover - bench resilience
-                extras[name] = {"error": str(err)[:120]}
+            if time.perf_counter() - t_start > budget_s:
+                extras[name] = {"skipped": "time budget"}
+                continue
+            for attempt in (0, 1):  # one retry: the remote compile service drops connections transiently
+                try:
+                    ours, baseline, unit = fn(*args)
+                    extras[name] = {
+                        "value": round(ours, 1),
+                        "unit": unit,
+                        "vs_torch_cpu": round(ours / baseline, 2) if baseline else None,
+                    }
+                    break
+                except Exception as err:  # pragma: no cover - bench resilience
+                    extras[name] = {"error": str(err)[:120]}
+                    if time.perf_counter() - t_start > budget_s:
+                        break
     except Exception:
         pass
 

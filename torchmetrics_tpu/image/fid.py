@@ -91,10 +91,26 @@ class FrechetInceptionDistance(Metric):
         self.add_state("fake_features_num_samples", jnp.asarray(0, jnp.int64 if jax.config.jax_enable_x64 else jnp.int32), dist_reduce_fx="sum")
 
     def update(self, imgs: Array, real: bool) -> None:
-        """Extract features and fold sum/cov-sum (reference ``fid.py:354-377``)."""
+        """Extract features and fold sum/cov-sum (reference ``fid.py:354-377``).
+
+        Built-in extractor path: feature extraction AND the streaming
+        sum/cov folds run as ONE compiled program per batch — on a remote
+        TPU each extra eager dispatch is a multi-second host round-trip."""
         imgs = jnp.asarray(imgs)
         if self.normalize and not self.used_custom_model:
             imgs = (imgs * 255).astype(jnp.uint8)
+        if not self.used_custom_model:
+            s, c, n = self._fused_extract_fold(
+                imgs,
+                *((self.real_features_sum, self.real_features_cov_sum, self.real_features_num_samples)
+                  if real else
+                  (self.fake_features_sum, self.fake_features_cov_sum, self.fake_features_num_samples)),
+            )
+            if real:
+                self.real_features_sum, self.real_features_cov_sum, self.real_features_num_samples = s, c, n
+            else:
+                self.fake_features_sum, self.fake_features_cov_sum, self.fake_features_num_samples = s, c, n
+            return
         features = jnp.asarray(self.inception(imgs))
         if features.ndim == 1:
             features = features[None, :]
@@ -107,6 +123,25 @@ class FrechetInceptionDistance(Metric):
             self.fake_features_sum = self.fake_features_sum + features.sum(axis=0)
             self.fake_features_cov_sum = self.fake_features_cov_sum + features.T @ features
             self.fake_features_num_samples = self.fake_features_num_samples + imgs.shape[0]
+
+    def _fused_extract_fold(self, imgs: Array, s: Array, c: Array, n: Array):
+        """One jitted program: inception forward + sum/cov/count folds.
+
+        Cached per extractor object via ``utilities.jit_cache`` (keeps metric
+        instances deep-copyable and gives ``jit_cache.evict`` coverage)."""
+        from torchmetrics_tpu.utilities.jit_cache import jitted_forward
+
+        def make_fn(extractor):
+            tap = extractor.features_list[0]
+
+            def fused(variables, imgs, s, c, n):
+                feats = extractor.module.apply(variables, imgs)[tap].astype(s.dtype)
+                return s + feats.sum(axis=0), c + feats.T @ feats, n + imgs.shape[0]
+
+            return fused
+
+        fn = jitted_forward(self.inception, "fid_extract_fold", make_fn, params_attr="variables")
+        return fn(imgs, s, c, n)
 
     def compute(self) -> Array:
         """Mean/cov from streaming sums, host f64 trace-sqrt (reference ``fid.py:379-389``)."""
