@@ -284,3 +284,33 @@ def test_bert_score_all_layers(bert_pair):
     # the last layer's scores equal the default (num_layers=None) run
     default = np.asarray(bert_score(preds, target, model=model, user_tokenizer=tokenizer)["f1"])
     np.testing.assert_allclose(f1.reshape(n_layers, len(preds))[-1], default, rtol=1e-5)
+
+
+def test_fused_bert_score_program_shards_over_batch(bert_pair):
+    """The fused corpus program (encoder+matching in one jit) runs under a
+    batch-sharded 8-device mesh and matches the unsharded result — the SPMD
+    regime for distributed tower-metric evaluation."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchmetrics_tpu.functional.text.bert import _fused_score_forward, _host_side_inputs
+
+    model, tokenizer = bert_pair
+    sentences_p = [f"the cat number {i} sat on the mat" for i in range(8)]
+    sentences_t = [f"the dog number {i} sat on the rug" for i in range(8)]
+    enc_p = tokenizer(sentences_p)
+    enc_t = tokenizer(sentences_t)
+    ids_p, am_p, pm_p, sc_p = _host_side_inputs(np.asarray(enc_p["input_ids"]), np.asarray(enc_p["attention_mask"]), False, None)
+    ids_t, am_t, pm_t, sc_t = _host_side_inputs(np.asarray(enc_t["input_ids"]), np.asarray(enc_t["attention_mask"]), False, None)
+    chunked = [a.reshape(1, 8, *a.shape[1:]) for a in (ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t)]
+
+    fn = _fused_score_forward(model, None, False)
+    plain = np.asarray(fn(*chunked))
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharded_inputs = [
+        jax.device_put(a, NamedSharding(mesh, P(None, "data", *([None] * (a.ndim - 2)))))
+        for a in chunked
+    ]
+    sharded = np.asarray(fn(*sharded_inputs))
+    np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6)

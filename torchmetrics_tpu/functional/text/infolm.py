@@ -145,32 +145,46 @@ def _get_data_distribution(
     masked position over real tokens (reference ``infolm.py:367-462``), with
     all masked variants batched into one forward per input batch."""
     token_mask = _get_token_mask(input_ids, special_tokens_map)
-    idf_weights = _get_tokens_idf(input_ids, token_mask) if idf else None
-    out = []
-    for start in range(0, input_ids.shape[0], batch_size):
-        ids = input_ids[start : start + batch_size]
-        att = attention_mask[start : start + batch_size]
-        tmask = token_mask[start : start + batch_size]
-        b, s = ids.shape
-        # (L, B, S): variant l has position l replaced with [MASK]
-        ids_rep = np.broadcast_to(ids, (s, b, s)).copy()
-        ids_rep[np.arange(s), :, np.arange(s)] = special_tokens_map["mask_token_id"]
-        mlm_logits = jitted_forward(model, "mlm_logits", lambda m: lambda p, i, a: m(i, a, params=p).logits)
-        logits = mlm_logits(
-            jnp.asarray(ids_rep.reshape(s * b, s)), jnp.asarray(np.broadcast_to(att, (s, b, s)).reshape(s * b, s))
-        )  # (L*B, S, V)
-        logits = jnp.asarray(logits).reshape(s, b, s, -1)
-        # distribution at the masked position of each variant -> (B, S, V)
-        probs = jax.nn.softmax(logits[jnp.arange(s), :, jnp.arange(s)] / temperature, axis=-1)
-        probs = jnp.moveaxis(probs, 0, 1)
-        weights = jnp.asarray(tmask, jnp.float32)
-        if idf:
-            w_idf = jnp.asarray(idf_weights[start : start + batch_size], jnp.float32)
-            probs = probs * w_idf[:, :, None]
-            weights = weights * w_idf
-        probs = probs * jnp.asarray(tmask, jnp.float32)[:, :, None]
-        out.append(probs.sum(axis=1) / weights.sum(axis=1, keepdims=True))
-    return jnp.concatenate(out)
+    idf_weights = (
+        _get_tokens_idf(input_ids, token_mask) if idf else np.ones_like(token_mask, dtype=np.float64)
+    )
+    mask_token_id = int(special_tokens_map["mask_token_id"])
+
+    # ONE compiled program per batch: variant construction, MLM forward,
+    # masked-position softmax, and the weighted average all fuse — on a
+    # remote TPU each extra eager dispatch is a multi-second host round-trip
+    def make_fn(m):
+        def fwd(params, temp, ids, att, tmask, w_idf):
+            b, s = ids.shape
+            # (L, B, S): variant l has position l replaced with [MASK]
+            eye = jnp.eye(s, dtype=bool)[:, None, :]
+            ids_rep = jnp.where(eye, mask_token_id, jnp.broadcast_to(ids[None], (s, b, s)))
+            att_rep = jnp.broadcast_to(att[None], (s, b, s))
+            logits = m(ids_rep.reshape(s * b, s), att_rep.reshape(s * b, s), params=params).logits
+            logits = logits.reshape(s, b, s, -1)
+            # distribution at the masked position of each variant -> (B, S, V)
+            probs = jax.nn.softmax(logits[jnp.arange(s), :, jnp.arange(s)] / temp, axis=-1)
+            probs = jnp.moveaxis(probs, 0, 1)
+            tmask_f = tmask.astype(jnp.float32)
+            weights = tmask_f * w_idf
+            probs = probs * (w_idf * tmask_f)[:, :, None]
+            return probs.sum(axis=1) / weights.sum(axis=1, keepdims=True)
+
+        return fwd
+
+    # temperature rides as a traced scalar — sweeping it must not recompile
+    fn = jitted_forward(model, f"mlm_probs:{mask_token_id}", make_fn)
+    out = [
+        fn(
+            np.float32(temperature),
+            input_ids[start : start + batch_size],
+            attention_mask[start : start + batch_size],
+            token_mask[start : start + batch_size],
+            idf_weights[start : start + batch_size].astype(np.float32),
+        )
+        for start in range(0, input_ids.shape[0], batch_size)
+    ]
+    return jnp.concatenate(out) if len(out) > 1 else out[0]
 
 
 def _load_default_mlm(model_name_or_path: str):
