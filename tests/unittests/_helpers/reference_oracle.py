@@ -1,0 +1,106 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Import the reference TorchMetrics (torch-CPU) from /root/reference.
+
+The reference depends on ``lightning_utilities``, which isn't installed in
+this image; a minimal shim provides the few names it actually uses. Test-only
+— the framework itself never touches the reference.
+"""
+from __future__ import annotations
+
+import importlib.util
+import sys
+import types
+from enum import Enum
+from pathlib import Path
+
+REFERENCE_SRC = Path("/root/reference/src")
+
+
+def _install_shim() -> None:
+    if "lightning_utilities" in sys.modules:
+        return
+    lu = types.ModuleType("lightning_utilities")
+    core = types.ModuleType("lightning_utilities.core")
+    imports_mod = types.ModuleType("lightning_utilities.core.imports")
+    enums_mod = types.ModuleType("lightning_utilities.core.enums")
+    rank_zero_mod = types.ModuleType("lightning_utilities.core.rank_zero")
+
+    class RequirementCache:
+        def __init__(self, requirement=None, module=None):
+            self.requirement = requirement
+            self.module = module or (requirement.split(">")[0].split("=")[0].strip() if requirement else None)
+
+        def __bool__(self):
+            try:
+                return importlib.util.find_spec(self.module.replace("-", "_")) is not None
+            except Exception:
+                return False
+
+        def __str__(self):
+            return f"Requirement {self.requirement} not met"
+
+    def package_available(name):
+        try:
+            return importlib.util.find_spec(name) is not None
+        except Exception:
+            return False
+
+    class StrEnum(str, Enum):
+        @classmethod
+        def from_str(cls, value, source="key"):
+            for st in cls:
+                if st.value.lower() == value.lower() or st.name.lower() == value.lower():
+                    return st
+            return None
+
+        @classmethod
+        def try_from_str(cls, value, source="key"):
+            return cls.from_str(value, source)
+
+        def __eq__(self, other):
+            if isinstance(other, Enum):
+                other = other.value
+            return self.value.lower() == str(other).lower()
+
+        def __hash__(self):
+            return hash(self.value.lower())
+
+    def apply_to_collection(data, dtype, function, *args, **kwargs):
+        if isinstance(data, dtype):
+            return function(data, *args, **kwargs)
+        if isinstance(data, dict):
+            return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
+        return data
+
+    imports_mod.RequirementCache = RequirementCache
+    imports_mod.package_available = package_available
+    enums_mod.StrEnum = StrEnum
+    rank_zero_mod.rank_zero_warn = lambda *a, **k: None
+    lu.apply_to_collection = apply_to_collection
+    lu.core = core
+    core.imports = imports_mod
+    core.enums = enums_mod
+    core.rank_zero = rank_zero_mod
+    sys.modules["lightning_utilities"] = lu
+    sys.modules["lightning_utilities.core"] = core
+    sys.modules["lightning_utilities.core.imports"] = imports_mod
+    sys.modules["lightning_utilities.core.enums"] = enums_mod
+    sys.modules["lightning_utilities.core.rank_zero"] = rank_zero_mod
+
+
+def reference_functional():
+    """The reference ``torchmetrics.functional`` module, or ``None``."""
+    if not REFERENCE_SRC.exists():
+        return None
+    _install_shim()
+    if str(REFERENCE_SRC) not in sys.path:
+        sys.path.insert(0, str(REFERENCE_SRC))
+    try:
+        import torchmetrics.functional as ref_f  # noqa: PLC0415
+
+        return ref_f
+    except Exception:
+        return None
