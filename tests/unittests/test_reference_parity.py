@@ -120,6 +120,22 @@ _CASES = [
     ("ter", "translation_edit_rate", lambda: (_CORPUS_P, [[t] for t in _CORPUS_T]), {}),
     ("eed", "extended_edit_distance", lambda: (_CORPUS_P, [[t] for t in _CORPUS_T]), {}),
     ("perplexity", "perplexity", lambda: (_RNG.randn(4, 8, 6).astype(np.float32), _RNG.randint(0, 6, (4, 8))), {}),
+    ("calinski_harabasz", "calinski_harabasz_score", lambda: (_RNG.randn(40, 4).astype(np.float32), _RNG.randint(0, 3, 40)), {}),
+    ("davies_bouldin", "davies_bouldin_score", lambda: (_RNG.randn(40, 4).astype(np.float32), _RNG.randint(0, 3, 40)), {}),
+    ("dunn_index", "dunn_index", lambda: (_RNG.randn(24, 4).astype(np.float32), _RNG.randint(0, 3, 24)), {}),
+    ("normalized_mutual_info", "normalized_mutual_info_score", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("adjusted_mutual_info", "adjusted_mutual_info_score", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("rand_score", "rand_score", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("fleiss_kappa", "fleiss_kappa", lambda: (_RNG.randint(1, 6, (16, 5)).astype(np.int64),), {"mode": "counts"}),
+    ("pearsons_contingency", "pearsons_contingency_coefficient", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("panoptic_quality", "panoptic_quality", lambda: (
+        _RNG.randint(0, 3, (2, 16, 16, 2)),
+        _RNG.randint(0, 3, (2, 16, 16, 2)),
+    ), {"things": {0, 1}, "stuffs": {2}, "allow_unknown_preds_category": True}),
+    ("mean_iou", "mean_iou", lambda: (
+        _RNG.randint(0, 3, (2, 16, 16)),
+        _RNG.randint(0, 3, (2, 16, 16)),
+    ), {"num_classes": 3, "input_format": "index"}),
 ]
 
 
@@ -157,7 +173,7 @@ def test_functional_parity_with_reference(name, fn_name, make_args, kwargs):
 
     ref_fn = getattr(ref_f, fn_name, None)
     if ref_fn is None:
-        for sub in ("clustering", "text", "nominal"):
+        for sub in ("clustering", "text", "nominal", "segmentation", "detection"):
             try:
                 mod = importlib.import_module(f"torchmetrics.functional.{sub}")
             except Exception:
