@@ -1,0 +1,53 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""LPIPS weight converter: torch-layout arrays -> working Flax net_params."""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[3] / "tools"))
+from convert_lpips_weights import convert_lpips_params, load_lpips_params, save_lpips_params  # noqa: E402
+
+_ALEX_SHAPES = {
+    0: (64, 3, 11, 11), 3: (192, 64, 5, 5), 6: (384, 192, 3, 3), 8: (256, 384, 3, 3), 10: (256, 256, 3, 3),
+}
+_ALEX_WIDTHS = (64, 192, 384, 256, 256)
+
+
+def _fake_alex_states(rng):
+    trunk = {}
+    for idx, (o, i, kh, kw) in _ALEX_SHAPES.items():
+        trunk[f"{idx}.weight"] = rng.randn(o, i, kh, kw).astype(np.float32) * 0.05
+        trunk[f"{idx}.bias"] = rng.randn(o).astype(np.float32) * 0.05
+    heads = {f"lin{n}.model.1.weight": np.abs(rng.randn(1, w, 1, 1)).astype(np.float32) for n, w in enumerate(_ALEX_WIDTHS)}
+    return trunk, heads
+
+
+def test_converted_params_drive_lpips(tmp_path):
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+
+    rng = np.random.RandomState(0)
+    tree = convert_lpips_params("alex", *_fake_alex_states(rng))
+    path = tmp_path / "alex.npz"
+    save_lpips_params(tree, str(path))
+    loaded = load_lpips_params(str(path))
+
+    metric = LearnedPerceptualImagePatchSimilarity(net_type="alex", net_params=loaded)
+    a = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+    b = jnp.asarray(rng.rand(2, 3, 64, 64).astype(np.float32) * 2 - 1)
+    metric.update(a, b)
+    val = float(metric.compute())
+    assert np.isfinite(val) and val > 0
+    # identical images -> exactly zero distance
+    metric2 = LearnedPerceptualImagePatchSimilarity(net_type="alex", net_params=loaded)
+    metric2.update(a, a)
+    assert float(metric2.compute()) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_converter_rejects_unknown_net():
+    with pytest.raises(ValueError, match="net_type"):
+        convert_lpips_params("resnet", {}, {})

@@ -1,0 +1,96 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Convert published LPIPS weights to the Flax ``net_params`` tree.
+
+Inputs (both torch ``state_dict``-style mappings of numpy-convertible
+tensors; load them offline wherever torch + the checkpoints are available):
+
+- trunk: torchvision ``alexnet(weights=...)`` / ``vgg16(weights=...)``
+  ``.features.state_dict()`` (keys ``"0.weight"``, ``"0.bias"``, ...)
+- heads: the richzhang/PerceptualSimilarity linear heads as shipped in the
+  reference (``functional/image/lpips_models/{alex,vgg}.pth`` — keys
+  ``"lin{i}.model.1.weight"`` with shape ``(1, C, 1, 1)``)
+
+Usage::
+
+    python tools/convert_lpips_weights.py alex trunk.npz heads.npz out.npz
+    # then: LearnedPerceptualImagePatchSimilarity(net_type="alex",
+    #           net_params=load_lpips_params("out.npz"))
+
+The converter itself is pure numpy — no torch needed at load time.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Mapping
+
+import numpy as np
+
+# torchvision `features` conv indices per trunk
+_TRUNK_CONV_INDICES = {
+    "alex": {0: "conv1", 3: "conv2", 6: "conv3", 8: "conv4", 10: "conv5"},
+    "vgg": {i: f"conv{n}" for n, i in enumerate((0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28))},
+}
+_NUM_HEADS = 5
+
+
+def convert_lpips_params(
+    net_type: str, trunk_state: Mapping[str, np.ndarray], heads_state: Mapping[str, np.ndarray]
+) -> Dict:
+    """Build the Flax params tree for ``_LPIPSNet`` from torch-layout arrays."""
+    if net_type not in _TRUNK_CONV_INDICES:
+        raise ValueError(f"net_type must be one of {sorted(_TRUNK_CONV_INDICES)}, got {net_type}")
+    trunk: Dict[str, Dict[str, np.ndarray]] = {}
+    for idx, name in _TRUNK_CONV_INDICES[net_type].items():
+        weight = np.asarray(trunk_state[f"{idx}.weight"], np.float32)  # OIHW
+        bias = np.asarray(trunk_state[f"{idx}.bias"], np.float32)
+        trunk[name] = {"kernel": weight.transpose(2, 3, 1, 0), "bias": bias}  # HWIO
+    params: Dict[str, Dict] = {"trunk": trunk}
+    for i in range(_NUM_HEADS):
+        key = f"lin{i}.model.1.weight"
+        if key not in heads_state:  # some exports drop the Sequential wrapper
+            key = f"lin{i}.weight"
+        weight = np.asarray(heads_state[key], np.float32)  # (1, C, 1, 1)
+        params[f"lin{i}"] = {"kernel": weight.transpose(2, 3, 1, 0)}  # (1, 1, C, 1)
+    return {"params": params}
+
+
+def save_lpips_params(tree: Dict, path: str) -> None:
+    flat = {}
+
+    def walk(node, prefix=""):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                walk(v, f"{prefix}{k}/")
+            else:
+                flat[f"{prefix}{k}"] = np.asarray(v)
+
+    walk(tree)
+    np.savez(path, **flat)
+
+
+def load_lpips_params(path: str) -> Dict:
+    tree: Dict = {}
+    with np.load(path) as data:
+        for key in data.files:
+            node = tree
+            *parents, leaf = key.split("/")
+            for p in parents:
+                node = node.setdefault(p, {})
+            node[leaf] = data[key]
+    return tree
+
+
+def main() -> None:
+    if len(sys.argv) != 5:
+        print(__doc__)
+        raise SystemExit(1)
+    net_type, trunk_npz, heads_npz, out = sys.argv[1:]
+    with np.load(trunk_npz) as t, np.load(heads_npz) as h:
+        tree = convert_lpips_params(net_type, dict(t), dict(h))
+    save_lpips_params(tree, out)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
