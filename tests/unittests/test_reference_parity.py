@@ -136,6 +136,17 @@ _CASES = [
         _RNG.randint(0, 3, (2, 16, 16)),
         _RNG.randint(0, 3, (2, 16, 16)),
     ), {"num_classes": 3, "input_format": "index"}),
+    ("binary_roc_binned", "roc", lambda: (_probs(), _labels(c=2)), {"task": "binary", "thresholds": 9}),
+    ("binary_prc_binned", "precision_recall_curve", lambda: (_probs(), _labels(c=2)), {"task": "binary", "thresholds": 9}),
+    ("multiclass_roc_binned", "roc", lambda: (_logits(), _labels()), {"task": "multiclass", "num_classes": 5, "thresholds": 9}),
+    ("multilabel_accuracy", "accuracy", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"task": "multilabel", "num_labels": 4}),
+    ("multilabel_f1", "f1_score", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"task": "multilabel", "num_labels": 4, "average": "macro"}),
+    ("multilabel_auroc_binned", "auroc", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"task": "multilabel", "num_labels": 4, "thresholds": 9}),
+    ("multilabel_ranking_ap", "multilabel_ranking_average_precision", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"num_labels": 4}),
+    ("multilabel_coverage", "multilabel_coverage_error", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"num_labels": 4}),
+    ("exact_match_multilabel", "exact_match", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"task": "multilabel", "num_labels": 4}),
+    ("dice", "dice", lambda: (_logits(), _labels()), {"average": "micro"}),
+    ("sacre_bleu", "sacre_bleu_score", lambda: (_CORPUS_P, [[t] for t in _CORPUS_T]), {}),
 ]
 
 
@@ -173,7 +184,7 @@ def test_functional_parity_with_reference(name, fn_name, make_args, kwargs):
 
     ref_fn = getattr(ref_f, fn_name, None)
     if ref_fn is None:
-        for sub in ("clustering", "text", "nominal", "segmentation", "detection"):
+        for sub in ("classification", "clustering", "text", "nominal", "segmentation", "detection"):
             try:
                 mod = importlib.import_module(f"torchmetrics.functional.{sub}")
             except Exception:

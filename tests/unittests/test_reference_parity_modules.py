@@ -136,3 +136,66 @@ def _walk(mod, cls_name):
         if hasattr(sub, cls_name):
             return getattr(sub, cls_name)
     raise AttributeError(cls_name)
+
+
+@pytest.mark.parametrize("wrapper_name", ["minmax", "multioutput", "classwise", "tracker"])
+def test_wrapper_parity_with_reference(wrapper_name):
+    """L5 wrapper semantics match the reference over identical streams."""
+    rng = np.random.RandomState(7)
+
+    if wrapper_name == "minmax":
+        ours = our_tm.MinMaxMetric(our_tm.MeanAbsoluteError())
+        from torchmetrics.wrappers import MinMaxMetric as RefMinMax
+
+        ref = RefMinMax(ref_tm.MeanAbsoluteError())
+        for _ in range(3):
+            p, t = rng.randn(16).astype(np.float32), rng.randn(16).astype(np.float32)
+            ours.update(p, t)
+            ref.update(torch.from_numpy(p), torch.from_numpy(t))
+            ours_val, ref_val = ours.compute(), ref.compute()
+            for k in ("raw", "min", "max"):
+                np.testing.assert_allclose(float(ours_val[k]), float(ref_val[k]), rtol=1e-5, err_msg=k)
+    elif wrapper_name == "multioutput":
+        ours = our_tm.MultioutputWrapper(our_tm.MeanSquaredError(), num_outputs=3)
+        from torchmetrics.wrappers import MultioutputWrapper as RefMO
+
+        ref = RefMO(ref_tm.MeanSquaredError(), num_outputs=3)
+        for _ in range(3):
+            p, t = rng.randn(16, 3).astype(np.float32), rng.randn(16, 3).astype(np.float32)
+            ours.update(p, t)
+            ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        np.testing.assert_allclose(
+            np.asarray(ours.compute()).ravel(), np.asarray([float(v) for v in ref.compute()]), rtol=1e-5
+        )
+    elif wrapper_name == "classwise":
+        from torchmetrics.classification import MulticlassAccuracy as RefMCA
+        from torchmetrics.wrappers import ClasswiseWrapper as RefCW
+
+        from torchmetrics_tpu.classification.accuracy import MulticlassAccuracy as OurMCA
+
+        ours = our_tm.ClasswiseWrapper(OurMCA(num_classes=4, average=None))
+        ref = RefCW(RefMCA(num_classes=4, average=None))
+        for _ in range(3):
+            p, t = rng.randint(0, 4, 32), rng.randint(0, 4, 32)
+            ours.update(p, t)
+            ref.update(torch.from_numpy(p).long(), torch.from_numpy(t).long())
+        ours_val, ref_val = ours.compute(), ref.compute()
+        assert set(ours_val) == set(ref_val)
+        for k in ref_val:
+            np.testing.assert_allclose(float(ours_val[k]), float(ref_val[k]), rtol=1e-5, err_msg=k)
+    else:  # tracker
+        from torchmetrics.wrappers import MetricTracker as RefTracker
+
+        ours = our_tm.MetricTracker(our_tm.MeanSquaredError(), maximize=False)
+        ref = RefTracker(ref_tm.MeanSquaredError(), maximize=False)
+        for _ in range(3):
+            ours.increment()
+            ref.increment()
+            for _ in range(2):
+                p, t = rng.randn(16).astype(np.float32), rng.randn(16).astype(np.float32)
+                ours.update(p, t)
+                ref.update(torch.from_numpy(p), torch.from_numpy(t))
+        best_ours, idx_ours = ours.best_metric(return_step=True)
+        best_ref, idx_ref = ref.best_metric(return_step=True)
+        np.testing.assert_allclose(float(best_ours), float(best_ref), rtol=1e-5)
+        assert int(idx_ours) == int(idx_ref)
