@@ -199,3 +199,19 @@ def test_wrapper_parity_with_reference(wrapper_name):
         best_ref, idx_ref = ref.best_metric(return_step=True)
         np.testing.assert_allclose(float(best_ours), float(best_ref), rtol=1e-5)
         assert int(idx_ours) == int(idx_ref)
+
+
+def test_compositional_metric_parity_with_reference():
+    """Operator-composed metrics evaluate like the reference's lazy trees."""
+    rng = np.random.RandomState(11)
+    ours_a, ours_b = our_tm.MeanSquaredError(), our_tm.MeanAbsoluteError()
+    ref_a, ref_b = ref_tm.MeanSquaredError(), ref_tm.MeanAbsoluteError()
+    ours_combo = 2 * ours_a + abs(ours_b) / 4 - 1
+    ref_combo = 2 * ref_a + abs(ref_b) / 4 - 1
+    for _ in range(3):
+        p, t = rng.randn(16).astype(np.float32), rng.randn(16).astype(np.float32)
+        ours_a.update(p, t)
+        ours_b.update(p, t)
+        ref_a.update(torch.from_numpy(p), torch.from_numpy(t))
+        ref_b.update(torch.from_numpy(p), torch.from_numpy(t))
+    np.testing.assert_allclose(float(ours_combo.compute()), float(ref_combo.compute()), rtol=1e-5)
