@@ -81,6 +81,41 @@ _SUITE = [
     ("panoptic_quality", tm.PanopticQuality, {"things": {0, 1}, "stuffs": {2}, "allow_unknown_preds_category": True}, [
         (_RNG.randint(0, 3, (2, 8, 8, 2)), _RNG.randint(0, 3, (2, 8, 8, 2))) for _ in range(BATCHES)
     ], False),
+    ("edit_distance", tm.EditDistance, {}, [
+        (["abcd", "xyz"], ["abce", "xy"]) for _ in range(BATCHES)
+    ], False),
+    ("fleiss_kappa", tm.FleissKappa, {"mode": "counts"}, [
+        (_RNG.randint(1, 5, (8, 4)),) for _ in range(BATCHES)
+    ], False),
+    ("sdr", tm.SignalDistortionRatio, {}, [
+        (_RNG.randn(4, 128).astype(np.float32), _RNG.randn(4, 128).astype(np.float32)) for _ in range(BATCHES)
+    ], False),
+    ("retrieval_ndcg", tm.RetrievalNormalizedDCG, {}, [
+        (
+            _RNG.rand(N).astype(np.float32),
+            _RNG.randint(0, 2, N),
+            np.repeat(np.arange(4), 8),
+        )
+        for _ in range(BATCHES)
+    ], False),
+    ("detection_iou", tm.IntersectionOverUnion, {}, [
+        (
+            [{
+                "boxes": (lambda xy, wh: np.concatenate([xy, xy + wh], 1))(
+                    _RNG.rand(6, 2) * 50, _RNG.rand(6, 2) * 20 + 2
+                ).astype(np.float32),
+                "scores": _RNG.rand(6).astype(np.float32),
+                "labels": _RNG.randint(0, 3, 6),
+            }],
+            [{
+                "boxes": (lambda xy, wh: np.concatenate([xy, xy + wh], 1))(
+                    _RNG.rand(4, 2) * 50, _RNG.rand(4, 2) * 20 + 2
+                ).astype(np.float32),
+                "labels": _RNG.randint(0, 3, 4),
+            }],
+        )
+        for _ in range(BATCHES)
+    ], False),
     ("retrieval_map", tm.RetrievalMAP, {}, [
         (
             _RNG.rand(N).astype(np.float32),
