@@ -1,0 +1,112 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Training-loop integration — the analogue of the reference's Lightning
+integration tests (``tests/integrations/test_lightning.py``): metrics logged
+per epoch inside a real flax/optax train loop, reset between epochs, with the
+evaluation step sharded over the device mesh.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import torchmetrics_tpu as tm
+
+NUM_CLASSES = 4
+N_PER_EPOCH = 64
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(32)(x))
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def _make_data(seed, n=N_PER_EPOCH):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(NUM_CLASSES, 8) * 3
+    y = rng.randint(0, NUM_CLASSES, n)
+    x = centers[y] + rng.randn(n, 8)
+    return x.astype(np.float32), y
+
+
+def test_metrics_inside_train_loop_reset_and_improve():
+    model = _MLP()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    metrics = tm.MetricCollection(
+        {
+            "acc": tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES),
+            "f1": tm.F1Score(task="multiclass", num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    epoch_acc = []
+    for epoch in range(6):
+        x, y = _make_data(seed=epoch % 2)
+        for i in range(0, N_PER_EPOCH, 16):
+            xb, yb = x[i : i + 16], y[i : i + 16]
+            params, opt_state, _ = train_step(params, opt_state, jnp.asarray(xb), jnp.asarray(yb))
+            logits = model.apply(params, jnp.asarray(xb))
+            metrics.update(logits, yb)
+        vals = metrics.compute()
+        epoch_acc.append(float(vals["acc"]))
+        metrics.reset()
+        # post-reset state must be pristine (the Lightning-loop contract)
+        for m in metrics.values():
+            assert m._update_count == 0
+    assert epoch_acc[-1] > epoch_acc[0], f"accuracy did not improve: {epoch_acc}"
+    assert epoch_acc[-1] > 0.9
+
+
+def test_sharded_eval_step_in_loop_matches_replicated():
+    """Eval-time metric accumulation under a dp-sharded step equals the
+    unsharded loop (the multi-chip evaluation regime)."""
+    model = _MLP()
+    params = model.init(jax.random.PRNGKey(1), jnp.zeros((1, 8)))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+
+    plain = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES)
+    from torchmetrics_tpu.parallel import ShardedMetric
+
+    sharded = ShardedMetric(tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES), mesh)
+
+    for seed in range(3):
+        x, y = _make_data(seed, n=32)
+        logits = np.asarray(model.apply(params, jnp.asarray(x)))
+        plain.update(logits, y)
+        sharded.update(
+            jax.device_put(logits, NamedSharding(mesh, P("data", None))),
+            jax.device_put(y, NamedSharding(mesh, P("data"))),
+        )
+    np.testing.assert_allclose(float(plain.compute()), float(sharded.compute()), rtol=1e-6)
+
+
+def test_metric_values_feed_back_into_jit_loop():
+    """Metric results are ordinary arrays: usable inside jitted control (e.g.
+    early-stopping thresholds) without host round-trips."""
+    acc = tm.Accuracy(task="multiclass", num_classes=NUM_CLASSES)
+    rng = np.random.RandomState(0)
+    acc.update(rng.randn(32, NUM_CLASSES).astype(np.float32), rng.randint(0, NUM_CLASSES, 32))
+    val = acc.compute()
+
+    @jax.jit
+    def gate(v):
+        return jnp.where(v > 0.5, 1.0, 0.0)
+
+    assert float(gate(val)) in (0.0, 1.0)
