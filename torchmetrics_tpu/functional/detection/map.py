@@ -145,7 +145,46 @@ def _match_one_image(
     return det_matched, det_ig, gt_ig
 
 
-_match_images = jax.jit(jax.vmap(_match_one_image, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None)))
+def _pack_bool_bits(x: Array) -> Array:
+    """Pack a (..., L) bool array into (..., ceil(L/8)) uint8, little-endian
+    bit order (``np.unpackbits(..., bitorder='little')`` inverts it).
+
+    The match/ignore tensors are the only large device→host transfer of the
+    evaluation; shipping bits instead of bool bytes cuts it 8×."""
+    length = x.shape[-1]
+    pad = (-length) % 8
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    x = x.reshape(*x.shape[:-1], -1, 8)
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.int32)
+    return (x.astype(jnp.int32) * weights).sum(-1, dtype=jnp.int32).astype(jnp.uint8)
+
+
+@jax.jit
+def _match_images_packed(*args):
+    det_matched, det_ignored, gt_ignored = jax.vmap(
+        _match_one_image, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, None, None)
+    )(*args)
+    return _pack_bool_bits(det_matched), _pack_bool_bits(det_ignored), _pack_bool_bits(gt_ignored)
+
+
+def _match_images(
+    iou, det_area, det_labels, det_valid, gt_labels, gt_valid, gt_crowd, gt_area, iou_thrs, area_rngs
+):
+    """Vectorized per-image matching; results cross the wire bit-packed and
+    in one batched fetch."""
+    packed = jax.device_get(
+        _match_images_packed(
+            iou, det_area, det_labels, det_valid, gt_labels, gt_valid, gt_crowd, gt_area, iou_thrs, area_rngs
+        )
+    )
+    num_d = det_labels.shape[1]
+    num_g = gt_labels.shape[1]
+    out = []
+    for arr, length in zip(packed, (num_d, num_d, num_g)):
+        bits = np.unpackbits(arr, axis=-1, bitorder="little")
+        out.append(bits[..., :length].astype(bool))
+    return out
 
 
 @jax.jit
