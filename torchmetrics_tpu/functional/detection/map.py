@@ -365,9 +365,26 @@ def coco_mean_average_precision(
         dtm_flat = det_matched.transpose(1, 2, 0, 3).reshape(num_a, num_t, -1)
         dtig_flat = det_ignored.transpose(1, 2, 0, 3).reshape(num_a, num_t, -1)
         gtig_flat = gt_ignored.transpose(1, 0, 2).reshape(num_a, -1)
+        # group det/gt indices by class ONCE per image (stable sort keeps the
+        # per-image score order within each class group) instead of scanning
+        # every image again for every class
+        def _group_by_class(labels, valid):
+            sels = []
+            for i in range(labels.shape[0]):
+                pos = np.searchsorted(classes, labels[i])
+                pos = np.clip(pos, 0, num_k - 1)
+                key = np.where(valid[i] & (classes[pos] == labels[i]), pos, num_k)
+                order = np.argsort(key, kind="stable")
+                counts = np.bincount(key, minlength=num_k + 1)
+                offs = np.concatenate(([0], np.cumsum(counts[:num_k])))
+                sels.append((order, offs))
+            return sels
+
+        det_groups = _group_by_class(det_labels, det_valid)
+        gt_groups = _group_by_class(gt_labels, gt_valid)
         for ki, k in enumerate(classes):
-            det_sel = [np.nonzero(det_valid[i] & (det_labels[i] == k))[0] for i in range(n_imgs)]
-            gt_sel = [np.nonzero(gt_valid[i] & (gt_labels[i] == k))[0] for i in range(n_imgs)]
+            det_sel = [order[offs[ki] : offs[ki + 1]] for order, offs in det_groups]
+            gt_sel = [order[offs[ki] : offs[ki + 1]] for order, offs in gt_groups]
             if not any(len(s) for s in det_sel) and not any(len(s) for s in gt_sel):
                 continue
             # hoist per-(maxdet) selections out of the area loop: scores and
