@@ -147,6 +147,23 @@ _CASES = [
     ("exact_match_multilabel", "exact_match", lambda: (_RNG.rand(N, 4).astype(np.float32), _RNG.randint(0, 2, (N, 4))), {"task": "multilabel", "num_labels": 4}),
     ("dice", "dice", lambda: (_logits(), _labels()), {"average": "micro"}),
     ("sacre_bleu", "sacre_bleu_score", lambda: (_CORPUS_P, [[t] for t in _CORPUS_T]), {}),
+    ("sdr", "signal_distortion_ratio", lambda: (
+        _RNG.randn(2, 512).astype(np.float64), _RNG.randn(2, 512).astype(np.float64)
+    ), {}),
+    ("sa_sdr", "source_aggregated_signal_distortion_ratio", lambda: (
+        _RNG.randn(2, 2, 256).astype(np.float32), _RNG.randn(2, 2, 256).astype(np.float32)
+    ), {}),
+    ("retrieval_fall_out", "retrieval_fall_out", lambda: (_probs(16), _RNG.randint(0, 2, 16)), {"top_k": 5}),
+    ("retrieval_hit_rate", "retrieval_hit_rate", lambda: (_probs(16), _RNG.randint(0, 2, 16)), {"top_k": 5}),
+    ("retrieval_precision", "retrieval_precision", lambda: (_probs(16), _RNG.randint(0, 2, 16)), {"top_k": 5}),
+    ("retrieval_recall", "retrieval_recall", lambda: (_probs(16), _RNG.randint(0, 2, 16)), {"top_k": 5}),
+    ("homogeneity", "homogeneity_score", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("completeness", "completeness_score", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("v_measure", "v_measure_score", lambda: (_labels(c=4), _labels(c=4)), {}),
+    ("kappa_binary", "cohen_kappa", lambda: (_probs(), _labels(c=2)), {"task": "binary"}),
+    ("weighted_mape", "weighted_mean_absolute_percentage_error", lambda: (_pos(), _pos()), {}),
+    ("smape", "symmetric_mean_absolute_percentage_error", lambda: (_pos(), _pos()), {}),
+    ("csi", "critical_success_index", lambda: (_probs(), _labels(c=2)), {"threshold": 0.5}),
 ]
 
 
@@ -184,7 +201,7 @@ def test_functional_parity_with_reference(name, fn_name, make_args, kwargs):
 
     ref_fn = getattr(ref_f, fn_name, None)
     if ref_fn is None:
-        for sub in ("classification", "clustering", "text", "nominal", "segmentation", "detection"):
+        for sub in ("classification", "clustering", "text", "nominal", "segmentation", "detection", "audio"):
             try:
                 mod = importlib.import_module(f"torchmetrics.functional.{sub}")
             except Exception:
