@@ -164,6 +164,10 @@ _CASES = [
     ("weighted_mape", "weighted_mean_absolute_percentage_error", lambda: (_pos(), _pos()), {}),
     ("smape", "symmetric_mean_absolute_percentage_error", lambda: (_pos(), _pos()), {}),
     ("csi", "critical_success_index", lambda: (_probs(), _labels(c=2)), {"threshold": 0.5}),
+    ("binary_roc_exact", "roc", lambda: (_probs(), _labels(c=2)), {"task": "binary"}),
+    ("binary_prc_exact", "precision_recall_curve", lambda: (_probs(), _labels(c=2)), {"task": "binary"}),
+    ("binary_ap_exact", "average_precision", lambda: (_probs(), _labels(c=2)), {"task": "binary"}),
+    ("multiclass_auroc_exact", "auroc", lambda: (_logits(), _labels()), {"task": "multiclass", "num_classes": 5}),
 ]
 
 
