@@ -184,3 +184,22 @@ def test_collection_state_dict_roundtrip():
     col2 = MetricCollection([SumM()])
     col2.load_state_dict(sd)
     assert float(col2["SumM"].total) == 5.0
+
+
+def test_add_metrics_syncs_stale_group_members():
+    """add_metrics must propagate leader state to lazy group members before
+    regrouping, or members resume individual updates from stale state
+    (advisor round-2 medium finding)."""
+    col = MetricCollection({"a": SumM(), "b": SumM()})
+    col.update(jnp.asarray([1.0]))
+    col.update(jnp.asarray([2.0]))  # groups form: a leads, b goes lazy
+    assert len(col.compute_groups) == 1
+    col.update(jnp.asarray([3.0]))  # leader-only update; b's state is stale
+    col.add_metrics({"c": MeanM()})
+    col.update(jnp.asarray([4.0]))  # individual updates while groups re-form
+    res = col.compute()
+    assert float(res["a"]) == 10.0
+    assert float(res["b"]) == 10.0  # was 7.0 before the fix
+    col.update(jnp.asarray([5.0]))
+    res = col.compute()
+    assert float(res["a"]) == float(res["b"]) == 15.0

@@ -54,3 +54,18 @@ def test_evict_drops_cached_state():
     jit_cache.jitted_forward(tower, "forward")(jnp.ones((2,)))
     jit_cache.evict()
     assert not jit_cache._CACHE and not jit_cache._PARAMS_ON_DEVICE
+
+
+def test_gc_auto_evicts_cache_entries():
+    """Dropping a tower must release its compiled programs and device weights
+    without a manual evict() (advisor round-2 finding: id-keyed pinning)."""
+    import gc
+
+    tower = _Tower(2.0)
+    obj_id = id(tower)
+    jit_cache.jitted_forward(tower, "forward")(jnp.ones((2,)))
+    assert any(k[0] == obj_id for k in jit_cache._CACHE)
+    del tower
+    gc.collect()
+    assert not any(k[0] == obj_id for k in jit_cache._CACHE)
+    assert obj_id not in jit_cache._PARAMS_ON_DEVICE

@@ -109,6 +109,10 @@ class MetricCollection(dict):
     # ------------------------------------------------------------ add metrics
     def add_metrics(self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric) -> None:
         """Add new metrics to the collection (reference ``collections.py:434``)."""
+        # Members of established compute groups hold stale state (they skip
+        # leader-only updates); sync them from their leaders before grouping
+        # restarts, or they would silently resume updating from stale state.
+        self._compute_groups_create_state_ref(copy=True)
         if isinstance(metrics, Metric):
             metrics = [metrics]
         if isinstance(metrics, Sequence):

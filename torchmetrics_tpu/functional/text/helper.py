@@ -91,7 +91,14 @@ def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
 def _normalize_inputs(
     preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
 ) -> Tuple[List[str], List[str]]:
-    """Promote single strings to lists and validate pairing."""
+    """Promote single strings to lists and validate pairing.
+
+    Deliberate divergence: the reference's WER/CER/MER/WIL/WIP silently
+    ``zip``-truncate mismatched preds/target lists to the shorter one; here a
+    length mismatch raises, since truncation silently discards data. Tested in
+    ``tests/unittests/bases/test_collections.py``
+    (``test_text_error_rates_reject_mismatched_lengths``).
+    """
     if isinstance(preds, str):
         preds = [preds]
     if isinstance(target, str):
