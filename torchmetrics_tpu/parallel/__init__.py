@@ -17,12 +17,25 @@ This subsystem replaces the reference's process-group model (NCCL/Gloo
   ``metric.py:401-433``) — usable directly inside user ``pjit`` eval steps.
 - :class:`ShardedMetric` wraps any :class:`~torchmetrics_tpu.Metric` so its
   ``update`` transparently executes sharded over a mesh axis.
+- :mod:`~torchmetrics_tpu.parallel.cat_buffer` gives list ("cat") states a
+  fixed-capacity, jit/scan-safe representation (:class:`CatBuffer`) so exact
+  curves, rank statistics, and retrieval run inside compiled streaming loops
+  and under ``shard_map`` (round 3; the reference's list states are host-only).
 
 Multi-host (DCN) sync of replicated states stays in
 ``torchmetrics_tpu.utilities.distributed`` — the two regimes compose.
 """
+from torchmetrics_tpu.parallel.cat_buffer import (
+    CatBuffer,
+    cat_buffer_all_gather,
+    cat_buffer_append,
+    cat_buffer_init,
+    cat_buffer_merge,
+    cat_buffer_values,
+)
 from torchmetrics_tpu.parallel.sharded import (
     ShardedMetric,
+    fold_jit_state,
     make_jit_update,
     make_sharded_update,
     metric_merge,
@@ -32,7 +45,14 @@ from torchmetrics_tpu.parallel.sharded import (
 )
 
 __all__ = [
+    "CatBuffer",
     "ShardedMetric",
+    "cat_buffer_all_gather",
+    "cat_buffer_append",
+    "cat_buffer_init",
+    "cat_buffer_merge",
+    "cat_buffer_values",
+    "fold_jit_state",
     "make_jit_update",
     "make_sharded_update",
     "metric_merge",

@@ -1,0 +1,149 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Fixed-capacity append buffers: list ("cat") metric states under jit/shard_map.
+
+The reference accumulates ``cat`` states as Python lists of tensors
+(reference ``metric.py:260-271``) and concatenates per-rank lists at sync
+time. Lists of per-batch tensors are inherently dynamic-shape — they cannot
+live inside a compiled XLA program, which is why round-2's sharded regime
+rejected them. The TPU-native answer (SURVEY.md §7 "static shapes first") is
+a capacity-bounded buffer::
+
+    CatBuffer(data=(capacity, *elem), count=int32, overflowed=bool)
+
+- ``append`` writes batch rows at offset ``count`` with an out-of-bounds-
+  dropping scatter — static shapes, jit/scan/vmap-safe.
+- ``merge`` splices another buffer's valid rows in (pairwise reduction).
+- ``all_gather_compact`` is the cross-device merge: inside ``shard_map`` it
+  gathers every device's buffer and compacts the valid rows into one
+  ``(n_devices * capacity,)`` buffer ordered by device index — the collective
+  analogue of the reference's gather-then-``dim_zero_cat``.
+- Overflow never corrupts data: rows past capacity are dropped and the
+  ``overflowed`` flag latches; ``values()`` raises on the host so callers can
+  re-run with a larger capacity or fall back to host accumulation.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class CatBuffer(NamedTuple):
+    """A fixed-capacity append buffer (a pytree of three arrays)."""
+
+    data: Array  # (capacity, *elem)
+    count: Array  # int32 scalar: valid rows
+    overflowed: Array  # bool scalar: an append ran past capacity
+
+
+def cat_buffer_init(capacity: int, elem_shape: Sequence[int] = (), dtype: Any = jnp.float32) -> CatBuffer:
+    """An empty buffer holding up to ``capacity`` rows of shape ``elem_shape``."""
+    return CatBuffer(
+        data=jnp.zeros((capacity, *elem_shape), dtype),
+        count=jnp.asarray(0, jnp.int32),
+        overflowed=jnp.asarray(False),
+    )
+
+
+def cat_buffer_append(buf: CatBuffer, rows: Array) -> CatBuffer:
+    """Append ``rows`` (shape ``(B, *elem)``) at the current offset.
+
+    Rows that would land past capacity are dropped (scatter ``mode="drop"``)
+    and ``overflowed`` latches — no clamped-index overwrite of earlier rows.
+    """
+    rows = jnp.asarray(rows)
+    if rows.ndim == buf.data.ndim - 1:  # single row convenience
+        rows = rows[None]
+    n = rows.shape[0]
+    idx = buf.count + jnp.arange(n)
+    data = buf.data.at[idx].set(rows.astype(buf.data.dtype), mode="drop")
+    new_total = buf.count + n
+    return CatBuffer(
+        data=data,
+        count=jnp.minimum(new_total, buf.data.shape[0]).astype(jnp.int32),
+        overflowed=buf.overflowed | (new_total > buf.data.shape[0]),
+    )
+
+
+def cat_buffer_merge(a: CatBuffer, b: CatBuffer) -> CatBuffer:
+    """Splice ``b``'s valid rows after ``a``'s (pairwise cat reduction)."""
+    cap_a = a.data.shape[0]
+    rb = jnp.arange(b.data.shape[0])
+    # invalid source rows route to index cap_a: out of bounds, dropped
+    idx = jnp.where(rb < b.count, a.count + rb, cap_a)
+    data = a.data.at[idx].set(b.data.astype(a.data.dtype), mode="drop")
+    new_total = a.count + b.count
+    return CatBuffer(
+        data=data,
+        count=jnp.minimum(new_total, cap_a).astype(jnp.int32),
+        overflowed=a.overflowed | b.overflowed | (new_total > cap_a),
+    )
+
+
+def cat_buffer_all_gather(buf: CatBuffer, axis_name: str) -> CatBuffer:
+    """Cross-device merge: compact every device's valid rows into one buffer.
+
+    Must run inside ``shard_map``/``pmap`` with ``axis_name`` bound. Returns a
+    replicated ``CatBuffer`` of capacity ``n_devices * capacity`` whose rows
+    are ordered by device index (the reference's rank-ordered gather,
+    ``metric.py:459-474``) — deterministic, so downstream sort-based metrics
+    (Spearman, exact curves) see identical inputs on every device.
+    """
+    cap = buf.data.shape[0]
+    data = jax.lax.all_gather(buf.data, axis_name)  # (n_dev, cap, *elem)
+    counts = jax.lax.all_gather(buf.count, axis_name)  # (n_dev,)
+    over = jax.lax.all_gather(buf.overflowed, axis_name).any()
+    n_dev = data.shape[0]
+    offsets = jnp.cumsum(counts) - counts  # exclusive prefix sum
+    rows = jnp.arange(cap)
+    # per (device, row) destination; invalid rows route out of bounds
+    dest = jnp.where(rows[None, :] < counts[:, None], offsets[:, None] + rows[None, :], n_dev * cap)
+    flat_dest = dest.reshape(-1)
+    flat_data = data.reshape((n_dev * cap, *data.shape[2:]))
+    out = jnp.zeros_like(flat_data).at[flat_dest].set(flat_data, mode="drop")
+    return CatBuffer(data=out, count=counts.sum().astype(jnp.int32), overflowed=over)
+
+
+def cat_buffer_values(buf: CatBuffer) -> Array:
+    """The valid rows, host-side. Raises if the buffer ever overflowed."""
+    if bool(buf.overflowed):
+        raise RuntimeError(
+            f"CatBuffer overflowed its capacity of {buf.data.shape[0]} rows; rows were dropped."
+            " Re-run with a larger capacity, or fall back to host (list-state) accumulation."
+        )
+    return buf.data[: int(buf.count)]
+
+
+def infer_cat_layout(metric: Any, example_batch: Tuple[Any, ...]) -> dict:
+    """Per-list-state ``(elem_shape, dtype)`` via abstract eval.
+
+    Runs the metric's ``update`` under ``jax.eval_shape`` (no FLOPs, no
+    device) on the example batch to learn what each list state appends.
+    """
+    def probe(*batch):
+        saved = metric._copy_state_dict()
+        saved_count, saved_computed = metric._update_count, metric._computed
+        try:
+            metric.reset()
+            metric.update(*batch)
+            tree = metric.state_tree()
+            return {k: [jnp.atleast_1d(x) for x in v] for k, v in tree.items() if isinstance(v, list)}
+        finally:
+            metric.load_state_tree(saved)
+            metric._update_count = saved_count
+            metric._computed = saved_computed
+
+    shapes = jax.eval_shape(probe, *example_batch)
+    layout = {}
+    for key, appended in shapes.items():
+        if not appended:
+            raise ValueError(f"list state {key!r} received no append for the example batch")
+        elem = appended[0].shape[1:]
+        if any(a.shape[1:] != elem or a.dtype != appended[0].dtype for a in appended):
+            raise ValueError(f"list state {key!r} appends inconsistent shapes/dtypes per update")
+        layout[key] = (elem, appended[0].dtype)
+    return layout

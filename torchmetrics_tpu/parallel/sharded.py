@@ -33,8 +33,25 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu.parallel.cat_buffer import (
+    CatBuffer,
+    cat_buffer_append,
+    cat_buffer_init,
+    cat_buffer_merge,
+    cat_buffer_values,
+    infer_cat_layout,
+)
+
+try:  # jax >= 0.7 top-level export; the experimental path is deprecated
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_rep)
+
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
 
 Array = jax.Array
 
@@ -67,10 +84,16 @@ def metric_merge(
     if reduction == "min":
         return jnp.minimum(a, b)
     if reduction == "cat":
+        if isinstance(a, CatBuffer):
+            return cat_buffer_merge(a, b)
         if isinstance(a, list):
             return list(a) + list(b)
         return jnp.concatenate([jnp.atleast_1d(a), jnp.atleast_1d(b)])
     if reduction is None:
+        if isinstance(a, list):
+            # list states under a None reduction extend across parts (the
+            # reference's rank-extend, metric.py:356)
+            return list(a) + list(b)
         return jnp.stack([a, b])
     if callable(reduction):
         return reduction(jnp.stack([a, b]))
@@ -97,10 +120,18 @@ def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_nam
 
     Must be called inside ``shard_map``/``pmap`` with ``axis_name`` bound.
     """
+    def gather_flat(v: Array) -> Array:
+        return jax.lax.all_gather(v, axis_name).reshape((-1,) + tuple(v.shape[1:]))
+
     out: Dict[str, Any] = {}
     for key, value in state.items():
         reduction = reductions[key]
-        if reduction == "sum":
+        if isinstance(value, list) and reduction in ("cat", None):
+            # rank-extend semantics (reference metric.py:356): each appended
+            # tensor gathers across devices and flattens, so the host list
+            # receives one device-ordered tensor per append
+            out[key] = [gather_flat(v) for v in value]
+        elif reduction == "sum":
             out[key] = jax.lax.psum(value, axis_name)
         elif reduction == "mean":
             out[key] = jax.lax.pmean(value, axis_name)
@@ -109,12 +140,7 @@ def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_nam
         elif reduction == "min":
             out[key] = jax.lax.pmin(value, axis_name)
         elif reduction == "cat":
-            if isinstance(value, list):
-                out[key] = [
-                    jax.lax.all_gather(v, axis_name).reshape((-1,) + tuple(v.shape[1:])) for v in value
-                ]
-            else:
-                out[key] = jax.lax.all_gather(value, axis_name).reshape((-1,) + tuple(value.shape[1:]))
+            out[key] = gather_flat(value)
         elif reduction is None:
             out[key] = jax.lax.all_gather(value, axis_name)
         elif callable(reduction):
@@ -127,41 +153,93 @@ def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_nam
 # --------------------------------------------------------------- jitted update
 
 
-def make_jit_update(metric: "Any") -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
+def make_jit_update(
+    metric: "Any",
+    cat_capacity: Optional[int] = None,
+    example_batch: Optional[Tuple[Any, ...]] = None,
+) -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
     """Build ``(step, init_state)`` where ``step(state, *batch) -> state`` is jitted.
 
     The entire update — validation-free kernel plus merge into the running
     state — compiles to one XLA program, so a metric-evaluation loop runs at
-    device speed with no per-op dispatch. Array states only (``cat``/list
-    states are inherently dynamic; use binned variants).
+    device speed with no per-op dispatch.
+
+    List ("cat") states — exact curves, Spearman/Kendall, retrieval — are
+    dynamic-shape and cannot live in a compiled program directly; pass
+    ``cat_capacity`` (max TOTAL rows to retain) plus an ``example_batch``
+    (used under ``jax.eval_shape``, no compute, to learn each state's row
+    shape) and they become fixed-capacity :class:`CatBuffer` states: append
+    under jit/scan, overflow latched, never corrupting. Fold the final state
+    back with :func:`fold_jit_state`, which converts buffers to the metric's
+    list states (raising on overflow so callers can enlarge the capacity or
+    fall back to host accumulation).
 
     The state pytree carries the update count under the reserved key
     ``"_update_count"`` so ``"mean"`` states merge as a correctly weighted
     running average (reference ``metric.py:317``) instead of decaying
-    pairwise means. Fold the final state back with
-    ``metric.load_state_tree(state)`` — the count is restored too.
+    pairwise means.
     """
     reductions = dict(metric._reductions)
     list_state_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
-    if list_state_keys:
+    if list_state_keys and cat_capacity is None:
         raise ValueError(
-            f"Metric {type(metric).__name__} has list ('cat') states {list_state_keys};"
-            " jitted accumulation requires fixed-shape array states."
+            f"Metric {type(metric).__name__} has list ('cat') states {list_state_keys}; jitted"
+            " accumulation needs a fixed capacity — pass cat_capacity (max total rows) and an"
+            " example_batch."
         )
-    init_state = {k: jnp.asarray(v) for k, v in metric._defaults.items()}
+    init_state = {k: jnp.asarray(v) for k, v in metric._defaults.items() if k not in list_state_keys}
+    if list_state_keys:
+        if example_batch is None:
+            raise ValueError("cat_capacity requires example_batch to infer per-state row shapes")
+        layout = infer_cat_layout(metric, example_batch)
+        for k in list_state_keys:
+            elem, dtype = layout[k]
+            init_state[k] = cat_buffer_init(cat_capacity, elem, dtype)
     init_state["_update_count"] = jnp.asarray(0, jnp.int32)
 
     def step(state: Dict[str, Any], *batch: Any) -> Dict[str, Any]:
         state = dict(state)
         count = state.pop("_update_count")
         fresh = _batch_update_state(metric, batch, {})
+        for k in list_state_keys:
+            rows = jnp.concatenate([jnp.atleast_1d(x) for x in fresh.pop(k)])
+            state[k] = cat_buffer_append(state[k], rows)
+        array_keys = [k for k in fresh]
         # mean states: weighted running average; count==0 degenerates to the
         # fresh state exactly ((0*a + 1*b)/1 == b), so no special first step
-        merged = tree_merge(reductions, state, fresh, weight_a=count, weight_b=1)
+        merged = tree_merge(
+            {k: reductions[k] for k in array_keys},
+            {k: state[k] for k in array_keys},
+            fresh,
+            weight_a=count,
+            weight_b=1,
+        )
+        for k in list_state_keys:
+            merged[k] = state[k]
         merged["_update_count"] = count + 1
         return merged
 
     return jax.jit(step), init_state
+
+
+def fold_jit_state(metric: "Any", state: Dict[str, Any]) -> None:
+    """Load a :func:`make_jit_update` final state back into the metric.
+
+    Converts :class:`CatBuffer` states to the metric's host-side list states
+    (raising if any buffer overflowed) and restores the update count.
+    """
+    state = dict(state)
+    count = state.pop("_update_count", None)
+    tree = {}
+    for k, v in state.items():
+        if isinstance(v, CatBuffer):
+            tree[k] = [cat_buffer_values(v)]
+        else:
+            tree[k] = v
+    metric.load_state_tree(tree)
+    if count is not None:
+        metric._update_count = int(count)
+    metric._computed = None
 
 
 # ------------------------------------------------------------- sharded update
@@ -200,15 +278,15 @@ def make_sharded_update(
     :func:`mesh_reduce_tree`. The result is a fully-replicated state pytree
     ready to be merged into the host-side metric with
     :meth:`Metric.load_state_tree` / :func:`tree_merge`.
+
+    List ("cat"/None) states work too: within one update step the per-shard
+    appended rows have static shapes, so each append ``all_gather``s and
+    flattens device-ordered — exact curves, Spearman/Kendall, and retrieval
+    metrics run in this regime with no capacity bound (the buffer-capacity
+    machinery of :func:`make_jit_update` is only needed when the whole
+    streaming loop lives inside one compiled program).
     """
     reductions = dict(metric._reductions)
-    list_state_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
-    if list_state_keys:
-        raise ValueError(
-            f"Metric {type(metric).__name__} has list ('cat') states {list_state_keys}; sharded in-step"
-            " execution requires fixed-shape array states. Use binned/static-capacity variants, or"
-            " per-shard host accumulation."
-        )
 
     def per_device(*args: Any, **kwargs: Any) -> Dict[str, Any]:
         partial_state = _batch_update_state(metric, args, kwargs)
