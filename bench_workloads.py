@@ -1,15 +1,27 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Extra benchmark workloads used by ``bench.py``: SSIM, retrieval NDCG, COCO mAP, FID inception.
+"""Extra benchmark workloads used by ``bench.py``: SSIM, retrieval NDCG, COCO
+mAP (small + val2017-scale), FID-50k feature pass, BERTScore.
 
-Each returns (ours_throughput, baseline_throughput_or_None, unit). Baselines
-run the reference TorchMetrics on torch — the CPU build shipped in this image
-(labelled as such in the output; swap in CUDA numbers by re-running the same
-functions on a GPU host)."""
+Each workload returns a dict::
+
+    {"runs": [throughput, ...],   # one entry per timed repeat (median is the headline)
+     "unit": str,
+     "baseline": float | None,    # reference TorchMetrics on torch-CPU (this image
+                                  # has no CUDA build; labelled as such in bench.py)
+     ...extra fields}
+
+Timing discipline (BASELINE.md "remote-tunnel dispatch note"): every timed
+region ends in a forced materialization (``float(...)``/``np.asarray``) —
+``block_until_ready`` returns early through the axon tunnel, so it must never
+bound a measurement. Streaming loops run inside ONE compiled program
+(``lax.scan``) so the measurement is device throughput, not per-dispatch
+latency.
+"""
 from __future__ import annotations
 
 import time
-from typing import Optional, Tuple
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -20,17 +32,24 @@ NDCG_DOCS = 64
 MAP_IMAGES = 64
 MAP_DETS = 64
 MAP_GTS = 32
+# val2017-scale point behind BASELINE.md's mAP claim: COCO val2017 is 5k
+# images averaging ~7 gts; 1024 images x 100 dets x 80 classes stresses the
+# same matching dimensions per compiled program.
+MAP_SCALE_IMAGES = 1024
+MAP_SCALE_DETS = 100
+MAP_SCALE_GTS = 32
+MAP_SCALE_CLASSES = 80
+FID_BATCH = 64
+FID50K_BATCHES = 782  # 782 * 64 = 50,048 images ~ the FID-50k protocol
 
 
-def bench_ssim(n_batches: int) -> Tuple[float, Optional[float], str]:
+def bench_ssim(n_batches: int, repeats: int = 3) -> Dict:
     """Images/sec of streaming SSIM accumulation."""
     import jax
     import jax.numpy as jnp
 
     from torchmetrics_tpu.functional.image.ssim import _ssim_update
 
-    # stream the batches inside ONE compiled program (lax.scan): measures
-    # device throughput of the accumulation loop, not host dispatch latency
     @jax.jit
     def run(preds_stream, target_stream):
         def step(total, batch):
@@ -45,9 +64,11 @@ def bench_ssim(n_batches: int) -> Tuple[float, Optional[float], str]:
     preds = jax.random.uniform(kp, (n_batches, SSIM_BATCH, *SSIM_SHAPE), jnp.float32)
     target = jax.random.uniform(kt, (n_batches, SSIM_BATCH, *SSIM_SHAPE), jnp.float32)
     float(run(preds, target))  # compile + warm
-    t0 = time.perf_counter()
-    float(run(preds, target))  # forced materialization bounds the timing
-    ours = n_batches * SSIM_BATCH / (time.perf_counter() - t0)
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(run(preds, target))  # forced materialization bounds the timing
+        runs.append(n_batches * SSIM_BATCH / (time.perf_counter() - t0))
 
     baseline = None
     try:
@@ -64,10 +85,10 @@ def bench_ssim(n_batches: int) -> Tuple[float, Optional[float], str]:
         baseline = iters * SSIM_BATCH / (time.perf_counter() - t0)
     except Exception:
         pass
-    return ours, baseline, "images/s"
+    return {"runs": runs, "unit": "images/s", "baseline": baseline}
 
 
-def bench_retrieval_ndcg(n_repeats: int) -> Tuple[float, Optional[float], str]:
+def bench_retrieval_ndcg(n_repeats: int, repeats: int = 3) -> Dict:
     """Queries/sec of corpus NDCG evaluation."""
     import jax
     import jax.numpy as jnp
@@ -88,9 +109,11 @@ def bench_retrieval_ndcg(n_repeats: int) -> Tuple[float, Optional[float], str]:
         return total
 
     float(eval_repeated(preds, target))  # compile + warm
-    t0 = time.perf_counter()
-    float(eval_repeated(preds, target))
-    ours = n_repeats * NDCG_QUERIES / (time.perf_counter() - t0)
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(eval_repeated(preds, target))
+        runs.append(n_repeats * NDCG_QUERIES / (time.perf_counter() - t0))
 
     baseline = None
     try:
@@ -107,10 +130,31 @@ def bench_retrieval_ndcg(n_repeats: int) -> Tuple[float, Optional[float], str]:
         baseline = n_q / (time.perf_counter() - t0)
     except Exception:
         pass
-    return ours, baseline, "queries/s"
+    return {"runs": runs, "unit": "queries/s", "baseline": baseline}
 
 
-def bench_coco_map() -> Tuple[float, Optional[float], str]:
+def _synth_detections(n_images, n_dets, n_gts, n_classes, seed=0):
+    rng = np.random.default_rng(seed)
+    preds, target = [], []
+    for _ in range(n_images):
+        xy = rng.random((n_dets, 2)) * 400
+        wh = rng.random((n_dets, 2)) * 100 + 2
+        preds.append(
+            {
+                "boxes": np.concatenate([xy, xy + wh], 1),
+                "scores": rng.random(n_dets),
+                "labels": rng.integers(0, n_classes, n_dets),
+            }
+        )
+        xy = rng.random((n_gts, 2)) * 400
+        wh = rng.random((n_gts, 2)) * 100 + 2
+        target.append(
+            {"boxes": np.concatenate([xy, xy + wh], 1), "labels": rng.integers(0, n_classes, n_gts)}
+        )
+    return preds, target
+
+
+def bench_coco_map(repeats: int = 3) -> Dict:
     """Images/sec of full COCO-style mAP evaluation (vectorized JAX matching).
 
     The reference backend (pycocotools C/CPU) is not installed in this image,
@@ -119,37 +163,51 @@ def bench_coco_map() -> Tuple[float, Optional[float], str]:
     """
     from torchmetrics_tpu.functional.detection.map import coco_mean_average_precision
 
-    rng = np.random.default_rng(0)
-    preds, target = [], []
-    for _ in range(MAP_IMAGES):
-        xy = rng.random((MAP_DETS, 2)) * 400
-        wh = rng.random((MAP_DETS, 2)) * 100 + 2
-        preds.append(
-            {
-                "boxes": np.concatenate([xy, xy + wh], 1),
-                "scores": rng.random(MAP_DETS),
-                "labels": rng.integers(0, 40, MAP_DETS),
-            }
-        )
-        xy = rng.random((MAP_GTS, 2)) * 400
-        wh = rng.random((MAP_GTS, 2)) * 100 + 2
-        target.append(
-            {"boxes": np.concatenate([xy, xy + wh], 1), "labels": rng.integers(0, 40, MAP_GTS)}
-        )
+    preds, target = _synth_detections(MAP_IMAGES, MAP_DETS, MAP_GTS, 40)
     coco_mean_average_precision(preds, target)  # compile at the real shapes
-    t0 = time.perf_counter()
-    coco_mean_average_precision(preds, target)
-    ours = MAP_IMAGES / (time.perf_counter() - t0)
-    return ours, None, "images/s"
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        coco_mean_average_precision(preds, target)
+        runs.append(MAP_IMAGES / (time.perf_counter() - t0))
+    return {"runs": runs, "unit": "images/s", "baseline": None}
 
 
-def bench_bertscore(n_pairs: int = 128) -> Tuple[float, Optional[float], str]:
+def bench_coco_map_scale(repeats: int = 3) -> Dict:
+    """The val2017-scale point behind BASELINE.md's mAP claim, measured
+    first-class: 1024 images x 100 detections x 80 classes per evaluation."""
+    from torchmetrics_tpu.functional.detection.map import coco_mean_average_precision
+
+    preds, target = _synth_detections(
+        MAP_SCALE_IMAGES, MAP_SCALE_DETS, MAP_SCALE_GTS, MAP_SCALE_CLASSES, seed=1
+    )
+    coco_mean_average_precision(preds, target)  # compile at the real shapes
+    runs, elapsed = [], []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        coco_mean_average_precision(preds, target)
+        dt = time.perf_counter() - t0
+        elapsed.append(round(dt, 2))
+        runs.append(MAP_SCALE_IMAGES / dt)
+    return {
+        "runs": runs,
+        "unit": "images/s",
+        "baseline": None,
+        "images": MAP_SCALE_IMAGES,
+        "dets_per_image": MAP_SCALE_DETS,
+        "classes": MAP_SCALE_CLASSES,
+        "eval_seconds": elapsed,
+    }
+
+
+def bench_bertscore(n_pairs: int = 128, repeats: int = 2) -> Dict:
     """Sentence-pairs/sec of BERTScore end to end on pre-tokenized inputs
     (reference ``functional/text/bert.py:69-257``: transformer forward is the
     hot loop, then pairwise cosine + greedy match). A BERT-base-sized encoder
     with random weights — FLOP-identical to a trained bert-base checkpoint;
     the torch-CPU baseline runs the reference pipeline on the same shapes."""
     import jax
+
     from transformers import BertConfig, FlaxBertModel
 
     from torchmetrics_tpu.functional.text.bert import bert_score
@@ -168,10 +226,12 @@ def bench_bertscore(n_pairs: int = 128) -> Tuple[float, Optional[float], str]:
         model = FlaxBertModel(BertConfig(), seed=0)
         jax.block_until_ready(model.params)
     bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)  # compile + warm
-    t0 = time.perf_counter()
-    out = bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)
-    np.asarray(out["f1"])  # forced materialization
-    ours = n_pairs / (time.perf_counter() - t0)
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)
+        np.asarray(out["f1"])  # forced materialization
+        runs.append(n_pairs / (time.perf_counter() - t0))
 
     baseline = None
     try:
@@ -189,42 +249,79 @@ def bench_bertscore(n_pairs: int = 128) -> Tuple[float, Optional[float], str]:
         baseline = n_b / (time.perf_counter() - t0)
     except Exception:
         pass
-    return ours, baseline, "pairs/s"
+    return {"runs": runs, "unit": "pairs/s", "baseline": baseline}
 
 
-def bench_fid(n_batches: int = 8) -> Tuple[float, Optional[float], str]:
-    """Images/sec of the FID pipeline: Flax InceptionV3 feature extraction
-    (the FLOP-dominant part of FID-50k) + streaming sum/cov updates on device.
-    The final d×d trace-sqrt runs once per evaluation on host (~seconds at
-    d=2048) and is excluded like pycocotools excludes dataset loading."""
+def _program_flops(jitted, *args) -> Optional[float]:
+    """XLA's own FLOP estimate for the compiled program, if obtainable.
+
+    Caveat (measured r03): XLA's HLO cost analysis counts a ``while``-loop
+    body ONCE — it does not multiply by the trip count — so callers must
+    lower the per-step program and scale by the number of steps themselves
+    rather than lowering a whole ``lax.scan``.
+    """
+    try:
+        analysis = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+            analysis = analysis[0]
+        return float(analysis["flops"])
+    except Exception:
+        return None
+
+
+def bench_fid50k(n_batches: int = FID50K_BATCHES) -> Dict:
+    """The actual FID-50k feature pass, timed end to end: 50,048 images
+    through Flax InceptionV3 (the FLOP-dominant part of FID) + streaming
+    sum/cov moment updates on device, as ONE compiled program. The final
+    2048x2048 trace-sqrt runs once per evaluation on host (~seconds) and is
+    excluded like pycocotools excludes dataset loading.
+
+    Also reports XLA's FLOP estimate for the program so bench.py can derive
+    an MFU figure against the v5e-1 bf16 peak.
+    """
     import jax
     import jax.numpy as jnp
 
     from torchmetrics_tpu.image.backbones.inception import FIDInceptionV3
 
-    batch = 16
     module = FIDInceptionV3(features_list=("2048",))
-    imgs0 = (jax.random.uniform(jax.random.key(0), (batch, 3, 299, 299)) * 255).astype(jnp.uint8)
+    imgs0 = (jax.random.uniform(jax.random.key(0), (FID_BATCH, 3, 299, 299)) * 255).astype(jnp.uint8)
     variables = jax.jit(module.init)(jax.random.PRNGKey(0), imgs0)  # one program, not per-op dispatches
 
-    @jax.jit
-    def run(variables, key):
+    def run_fn(variables, key, batches):
         def step(carry, k):
             s, c, n = carry
             # generate the batch ON DEVICE: uploading a (B, 3, 299, 299)
             # stream over a remote-TPU link would swamp the measurement
-            imgs = (jax.random.uniform(k, (batch, 3, 299, 299)) * 255).astype(jnp.uint8)
+            imgs = (jax.random.uniform(k, (FID_BATCH, 3, 299, 299)) * 255).astype(jnp.uint8)
             feats = module.apply(variables, imgs)["2048"]
             return (s + feats.sum(0), c + feats.T @ feats, n + feats.shape[0]), None
 
         init = (jnp.zeros(2048), jnp.zeros((2048, 2048)), jnp.asarray(0))
-        (s, c, n), _ = jax.lax.scan(step, init, jax.random.split(key, n_batches))
+        (s, c, n), _ = jax.lax.scan(step, init, jax.random.split(key, batches))
         return s, c, n
 
-    out = run(variables, jax.random.key(1))
-    float(out[2])  # true sync: block_until_ready returns early through the remote tunnel
+    run = jax.jit(run_fn, static_argnums=2)
+    # device warmup on a short scan; AOT-compile the full-length program so
+    # the (one) timed execution of the 50k pass isn't paid twice
+    float(run(variables, jax.random.key(1), 8)[2])
+    compiled = run.lower(variables, jax.random.key(2), n_batches).compile()
+    # FLOPs from the SINGLE-BATCH extractor program × batches: XLA's cost
+    # analysis counts a scan body once, so lowering the full scan undercounts
+    # by the trip count (see _program_flops)
+    single = jax.jit(lambda v, imgs: module.apply(v, imgs)["2048"])
+    per_batch = _program_flops(single, variables, imgs0)
+    flops = per_batch * n_batches if per_batch else None
     t0 = time.perf_counter()
-    out = run(variables, jax.random.key(2))
+    out = compiled(variables, jax.random.key(2))
     float(out[2])  # forced materialization
-    ours = n_batches * batch / (time.perf_counter() - t0)
-    return ours, None, "images/s"
+    dt = time.perf_counter() - t0
+    n_images = n_batches * FID_BATCH
+    return {
+        "runs": [n_images / dt],
+        "unit": "images/s",
+        "baseline": None,
+        "images": n_images,
+        "elapsed_s": round(dt, 1),
+        "program_flops": flops,
+    }

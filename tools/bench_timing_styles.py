@@ -1,0 +1,93 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Root-cause experiment for the r01->r02 headline bench delta (VERDICT round-2
+weak #1): time the SAME classification-suite workload three ways on the real
+TPU and print all three.
+
+  A. r01 style: per-batch jit dispatch loop, timing bounded by
+     ``jax.block_until_ready`` — which returns EARLY through the axon remote
+     tunnel (BASELINE.md dispatch note), so this style can report enqueue
+     rate, not execution rate.
+  B. r01 dispatch loop, timing bounded by forced ``float()`` materialization.
+  C. r02 style: whole stream in one ``lax.scan`` program, forced
+     materialization (what bench.py ships).
+
+If A >> B ~= C, the r01 number was timing-artifact inflation, not a real
+regression.
+"""
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bench import BATCH, NUM_CLASSES, build_suite  # noqa: E402
+
+
+def main(n_batches: int = 16, repeats: int = 3) -> None:
+    # the EXACT programs bench.py measures — shared builder, zero drift
+    init_state, step, finalize = build_suite()
+
+    @jax.jit
+    def make_batch(key):
+        kp, kt = jax.random.split(key)
+        preds = jax.random.normal(kp, (BATCH, NUM_CLASSES), jnp.float32)
+        target = jax.random.randint(kt, (BATCH,), 0, NUM_CLASSES, jnp.int32)
+        return preds, target
+
+    keys = jax.random.split(jax.random.key(0), n_batches)
+    batches = [make_batch(k) for k in keys]
+    for p, t in batches:
+        float(p[0, 0])  # truly materialize inputs
+
+    # warm/compile the per-batch path
+    state = init_state()
+    for i in range(2):
+        state = step(state, *batches[i])
+    [float(v) for v in finalize(state)]
+
+    def style_a():
+        state = init_state()
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            state = step(state, *batches[i])
+        vals = finalize(state)
+        jax.block_until_ready(vals)
+        return n_batches * BATCH / (time.perf_counter() - t0)
+
+    def style_b():
+        state = init_state()
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            state = step(state, *batches[i])
+        vals = finalize(state)
+        [float(v) for v in vals]
+        return n_batches * BATCH / (time.perf_counter() - t0)
+
+    @jax.jit
+    def run_scan(preds_stream, target_stream):
+        def scan_step(state, batch):
+            return step(state, *batch), None
+
+        state, _ = jax.lax.scan(scan_step, init_state(), (preds_stream, target_stream))
+        return finalize(state)
+
+    preds_stream = jnp.stack([b[0] for b in batches])
+    target_stream = jnp.stack([b[1] for b in batches])
+    [float(v) for v in run_scan(preds_stream, target_stream)]  # compile + warm
+
+    def style_c():
+        t0 = time.perf_counter()
+        vals = run_scan(preds_stream, target_stream)
+        [float(v) for v in vals]
+        return n_batches * BATCH / (time.perf_counter() - t0)
+
+    for name, fn in (("A_r01_block_until_ready", style_a), ("B_dispatch_forced", style_b), ("C_r02_scan_forced", style_c)):
+        sps = [fn() / 1e6 for _ in range(repeats)]
+        print(f"{name}: " + ", ".join(f"{s:.3f}" for s in sps) + " Msamples/s")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 16)
