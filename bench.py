@@ -19,6 +19,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -37,11 +38,7 @@ RECORDED_BASELINE_SPS = 4.0e3
 # divide XLA's own FLOP estimate for the compiled program by this.
 V5E1_PEAK_BF16_FLOPS = 197e12
 
-
-def _median(xs):
-    xs = sorted(xs)
-    n = len(xs)
-    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+_median = statistics.median
 
 
 def _make_batches(n_batches: int, seed: int = 0):
@@ -279,7 +276,7 @@ def main() -> None:
         pass
 
     n_batches = int(sys.argv[1]) if len(sys.argv) > 1 else 16
-    repeats = int(os.environ.get("TM_TPU_BENCH_REPEATS", "5"))
+    repeats = max(1, int(os.environ.get("TM_TPU_BENCH_REPEATS", "5")))
     runs, cls_flops = bench_ours(n_batches, repeats=repeats)
     ours_sps = _median(runs)
     baseline_live = True

@@ -1,0 +1,294 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Torch oracles for cross-framework tower parity tests.
+
+These are torch transliterations of THIS repo's Flax towers
+(``torchmetrics_tpu/image/backbones/inception.py``, ``image/lpip.py``) — not
+copies of the reference — built so that ONE set of randomly-initialized torch
+weights can flow through the repo's offline weight converters
+(``tools/convert_inception_weights.py``, ``tools/convert_lpips_weights.py``)
+and the resulting Flax outputs can be checked against the torch forward.
+Their ``state_dict`` layouts deliberately match what the converters expect
+from the published checkpoints (torch-fidelity FID inception;
+torchvision ``features`` + richzhang linear heads), so the tests validate the
+exact conversion path a user runs offline with the real files.
+"""
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+
+class BasicConv2d(nn.Module):
+    """Conv(bias=False) + BatchNorm(eps=1e-3, eval) + ReLU."""
+
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2d(in_ch, out_ch, kernel, stride, padding, bias=False)
+        self.bn = nn.BatchNorm2d(out_ch, eps=1e-3)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg_pool(x):
+    return F.avg_pool2d(x, 3, 1, 1, count_include_pad=False)
+
+
+class InceptionA(nn.Module):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 64, 1)
+        self.branch5x5_1 = BasicConv2d(in_ch, 48, 1)
+        self.branch5x5_2 = BasicConv2d(48, 64, 5, padding=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, 1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, 3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, 3, padding=1)
+        self.branch_pool = BasicConv2d(in_ch, pool_features, 1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(_avg_pool(x))
+        return torch.cat([b1, b5, bd, bp], 1)
+
+
+class InceptionB(nn.Module):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch3x3 = BasicConv2d(in_ch, 384, 3, stride=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, 1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, 3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, 3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = F.max_pool2d(x, 3, 2)
+        return torch.cat([b3, bd, bp], 1)
+
+
+class InceptionC(nn.Module):
+    def __init__(self, in_ch, c7):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 192, 1)
+        self.branch7x7_1 = BasicConv2d(in_ch, c7, 1)
+        self.branch7x7_2 = BasicConv2d(c7, c7, (1, 7), padding=(0, 3))
+        self.branch7x7_3 = BasicConv2d(c7, 192, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = BasicConv2d(in_ch, c7, 1)
+        self.branch7x7dbl_2 = BasicConv2d(c7, c7, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = BasicConv2d(c7, c7, (1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = BasicConv2d(c7, c7, (7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = BasicConv2d(c7, 192, (1, 7), padding=(0, 3))
+        self.branch_pool = BasicConv2d(in_ch, 192, 1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+        )
+        bp = self.branch_pool(_avg_pool(x))
+        return torch.cat([b1, b7, bd, bp], 1)
+
+
+class InceptionD(nn.Module):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.branch3x3_1 = BasicConv2d(in_ch, 192, 1)
+        self.branch3x3_2 = BasicConv2d(192, 320, 3, stride=2)
+        self.branch7x7x3_1 = BasicConv2d(in_ch, 192, 1)
+        self.branch7x7x3_2 = BasicConv2d(192, 192, (1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = BasicConv2d(192, 192, (7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = BasicConv2d(192, 192, 3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        bp = F.max_pool2d(x, 3, 2)
+        return torch.cat([b3, b7, bp], 1)
+
+
+class InceptionE(nn.Module):
+    def __init__(self, in_ch, pool_mode="avg"):
+        super().__init__()
+        self.pool_mode = pool_mode
+        self.branch1x1 = BasicConv2d(in_ch, 320, 1)
+        self.branch3x3_1 = BasicConv2d(in_ch, 384, 1)
+        self.branch3x3_2a = BasicConv2d(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3_2b = BasicConv2d(384, 384, (3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 448, 1)
+        self.branch3x3dbl_2 = BasicConv2d(448, 384, 3, padding=1)
+        self.branch3x3dbl_3a = BasicConv2d(384, 384, (1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = BasicConv2d(384, 384, (3, 1), padding=(1, 0))
+        self.branch_pool = BasicConv2d(in_ch, 192, 1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool_mode == "avg":
+            bp = _avg_pool(x)
+        else:
+            bp = F.max_pool2d(x, 3, 1, 1)
+        bp = self.branch_pool(bp)
+        return torch.cat([b1, b3, bd, bp], 1)
+
+
+class TorchFIDInception(nn.Module):
+    """Torch mirror of ``FIDInceptionV3`` with torch-fidelity key names.
+
+    ``state_dict()`` keys are exactly what
+    ``tools/convert_inception_weights.convert_state_dict`` expects
+    (``Mixed_5b.branch1x1.conv.weight``, ``...bn.running_mean``, ``fc.weight``).
+    """
+
+    def __init__(self, num_classes=1008):
+        super().__init__()
+        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, 3, stride=2)
+        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, 3)
+        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, 3, padding=1)
+        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, 1)
+        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, 3)
+        self.Mixed_5b = InceptionA(192, 32)
+        self.Mixed_5c = InceptionA(256, 64)
+        self.Mixed_5d = InceptionA(288, 64)
+        self.Mixed_6a = InceptionB(288)
+        self.Mixed_6b = InceptionC(768, 128)
+        self.Mixed_6c = InceptionC(768, 160)
+        self.Mixed_6d = InceptionC(768, 160)
+        self.Mixed_6e = InceptionC(768, 192)
+        self.Mixed_7a = InceptionD(768)
+        self.Mixed_7b = InceptionE(1280, "avg")
+        self.Mixed_7c = InceptionE(2048, "max")
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, imgs_uint8):
+        """uint8 NCHW 299x299 -> dict of feature taps (mirrors the Flax taps)."""
+        x = imgs_uint8.float()
+        x = (x - 128.0) / 128.0
+        out = {}
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, 3, 2)
+        out["64"] = x.mean((2, 3))
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, 3, 2)
+        out["192"] = x.mean((2, 3))
+        x = self.Mixed_5b(x)
+        x = self.Mixed_5c(x)
+        x = self.Mixed_5d(x)
+        x = self.Mixed_6a(x)
+        x = self.Mixed_6b(x)
+        x = self.Mixed_6c(x)
+        x = self.Mixed_6d(x)
+        x = self.Mixed_6e(x)
+        out["768"] = x.mean((2, 3))
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = self.Mixed_7c(x)
+        pooled = x.mean((2, 3))
+        out["2048"] = pooled
+        logits = self.fc(pooled)
+        out["logits_unbiased"] = logits - self.fc.bias
+        out["logits"] = logits
+        return out
+
+
+def randomize_bn_stats(model: nn.Module, seed: int = 0) -> None:
+    """Give every BatchNorm non-trivial running stats so the parity check
+    actually exercises the mean/var conversion (fresh init is 0/1)."""
+    gen = torch.Generator().manual_seed(seed)
+    for mod in model.modules():
+        if isinstance(mod, nn.BatchNorm2d):
+            mod.running_mean.copy_(torch.randn(mod.running_mean.shape, generator=gen) * 0.1)
+            mod.running_var.copy_(torch.rand(mod.running_var.shape, generator=gen) * 0.5 + 0.75)
+
+
+# ---------------------------------------------------------------------- LPIPS
+
+_ALEX_FEATURES = (
+    # (index, module) following the torchvision alexnet.features layout
+    lambda: nn.Conv2d(3, 64, 11, 4, 2),
+    lambda: nn.ReLU(),
+    lambda: nn.MaxPool2d(3, 2),
+    lambda: nn.Conv2d(64, 192, 5, 1, 2),
+    lambda: nn.ReLU(),
+    lambda: nn.MaxPool2d(3, 2),
+    lambda: nn.Conv2d(192, 384, 3, 1, 1),
+    lambda: nn.ReLU(),
+    lambda: nn.Conv2d(384, 256, 3, 1, 1),
+    lambda: nn.ReLU(),
+    lambda: nn.Conv2d(256, 256, 3, 1, 1),
+    lambda: nn.ReLU(),
+    lambda: nn.MaxPool2d(3, 2),
+)
+_ALEX_TAPS = (1, 4, 7, 9, 11)
+
+_VGG_CONV_PLAN = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+
+def _vgg_features():
+    layers, taps, in_ch = [], [], 3
+    for stage, (width, convs) in enumerate(_VGG_CONV_PLAN):
+        for _ in range(convs):
+            layers.append(nn.Conv2d(in_ch, width, 3, 1, 1))
+            layers.append(nn.ReLU())
+            in_ch = width
+        taps.append(len(layers) - 1)
+        if stage < len(_VGG_CONV_PLAN) - 1:
+            layers.append(nn.MaxPool2d(2, 2))
+    return layers, tuple(taps)
+
+
+class TorchLPIPS(nn.Module):
+    """Torch mirror of ``_LPIPSNet``: torchvision-layout trunk + richzhang
+    1x1 linear heads; ``trunk.state_dict()`` keys are the ``"0.weight"``-style
+    indices ``tools/convert_lpips_weights.convert_lpips_params`` expects."""
+
+    SHIFT = torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1)
+    SCALE = torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1)
+
+    def __init__(self, net_type="alex", seed=0):
+        super().__init__()
+        torch.manual_seed(seed)
+        if net_type == "alex":
+            layers, self.taps = [f() for f in _ALEX_FEATURES], _ALEX_TAPS
+        else:
+            layers, self.taps = _vgg_features()
+        self.trunk = nn.Sequential(*layers)
+        widths = {"alex": (64, 192, 384, 256, 256), "vgg": (64, 128, 256, 512, 512)}[net_type]
+        self.heads = nn.ParameterList(
+            [nn.Parameter(torch.rand(1, c, 1, 1) * 0.1) for c in widths]
+        )
+
+    def heads_state_dict(self):
+        return {f"lin{i}.model.1.weight": p.detach() for i, p in enumerate(self.heads)}
+
+    def forward(self, img1, img2, normalize=False):
+        if normalize:
+            img1, img2 = 2 * img1 - 1, 2 * img2 - 1
+        img1 = (img1 - self.SHIFT) / self.SCALE
+        img2 = (img2 - self.SHIFT) / self.SCALE
+
+        def taps_of(x):
+            feats = []
+            for i, layer in enumerate(self.trunk):
+                x = layer(x)
+                if i in self.taps:
+                    feats.append(x)
+            return feats
+
+        total = 0.0
+        for head, f1, f2 in zip(self.heads, taps_of(img1), taps_of(img2)):
+            f1 = f1 / torch.sqrt((f1**2).sum(1, keepdim=True) + 1e-10)
+            f2 = f2 / torch.sqrt((f2**2).sum(1, keepdim=True) + 1e-10)
+            diff = (f1 - f2) ** 2
+            total = total + (diff * head).sum(1, keepdim=True).mean((2, 3))[:, 0]
+        return total

@@ -1,0 +1,348 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Cross-framework tower parity with SHARED weights (round-3; VERDICT #2).
+
+Round 2 tested tower metrics only on random weights — shapes and streaming,
+never the numbers. These tests load IDENTICAL weights into the torch tower
+(the reference's compute substrate) and the Flax tower (ours) and demand
+feature- and metric-level agreement:
+
+- BERT / CLIP: a randomly-initialized torch checkpoint saved locally and
+  loaded into Flax via transformers' torch->Flax conversion; then
+  BERTScore/InfoLM/CLIPScore/CLIP-IQA computed on both sides.
+- InceptionV3 / LPIPS: torch transliterations of our Flax towers
+  (``tests/unittests/_helpers/torch_towers.py``) whose state dicts match the
+  published-checkpoint layouts, fed through the repo's OFFLINE WEIGHT
+  CONVERTERS (``tools/convert_inception_weights.py``,
+  ``tools/convert_lpips_weights.py``) — validating the exact path a user runs
+  with the real ``pt_inception-2015-12-05.pth`` / torchvision + richzhang
+  files.
+
+Everything here is offline: random weights, local checkpoints, no hub access.
+Agreement on random weights + layout-exact converters implies the calibrated
+checkpoints load correctly too (same code path, same shapes).
+"""
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[3] / "tools"))
+
+from tests.unittests._helpers.reference_oracle import reference_functional  # noqa: E402
+
+ref_f = reference_functional()
+
+TOL = 2e-4  # feature-level agreement; fp32 cross-framework accumulation order
+
+
+# ------------------------------------------------------------------ fixtures
+
+
+@pytest.fixture(scope="module")
+def tiny_bert(tmp_path_factory):
+    """(torch BertModel, Flax twin, config) sharing one random checkpoint."""
+    from transformers import BertConfig, BertModel, FlaxBertModel
+
+    cfg = BertConfig(
+        vocab_size=500,
+        hidden_size=64,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        intermediate_size=128,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    tmodel = BertModel(cfg).eval()
+    path = tmp_path_factory.mktemp("bert")
+    tmodel.save_pretrained(path)
+    fmodel = FlaxBertModel.from_pretrained(path, from_pt=True)
+    return tmodel, fmodel, cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_clip(tmp_path_factory):
+    from transformers import CLIPConfig, CLIPModel, CLIPTextConfig, CLIPVisionConfig, FlaxCLIPModel
+
+    cfg = CLIPConfig(
+        text_config=CLIPTextConfig(
+            vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=64, max_position_embeddings=32,
+        ).to_dict(),
+        vision_config=CLIPVisionConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=64, image_size=32, patch_size=8,
+        ).to_dict(),
+        projection_dim=24,
+    )
+    torch.manual_seed(0)
+    tmodel = CLIPModel(cfg).eval()
+    path = tmp_path_factory.mktemp("clip")
+    tmodel.save_pretrained(path)
+    fmodel = FlaxCLIPModel.from_pretrained(path, from_pt=True)
+    return tmodel, fmodel, cfg
+
+
+class _FakeCLIPProcessor:
+    """Deterministic stand-in for CLIPProcessor: identical token ids and
+    pixel values on both frameworks, so processing cancels out of the
+    comparison."""
+
+    def __init__(self, vocab=99, seq=12, image_size=32):
+        self.vocab, self.seq, self.image_size = vocab, seq, image_size
+
+    def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+        out = {}
+        if text is not None:
+            ids = np.zeros((len(text), self.seq), np.int64)
+            for i, t in enumerate(text):
+                for j, word in enumerate(t.split()[: self.seq]):
+                    ids[i, j] = sum(ord(c) for c in word) % (self.vocab - 2) + 1
+            out["input_ids"] = ids
+            out["attention_mask"] = (ids != 0).astype(np.int64)
+        if images is not None:
+            pix = np.stack([np.asarray(im, np.float32) for im in images])
+            if pix.shape[-1] == 3:
+                pix = pix.transpose(0, 3, 1, 2)
+            out["pixel_values"] = pix / np.maximum(pix.max(), 1.0)
+        return out
+
+
+# ------------------------------------------------------- BERT: feature + metric
+
+
+def test_bert_tower_feature_parity(tiny_bert):
+    tmodel, fmodel, cfg = tiny_bert
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (4, 24))
+    mask = (np.arange(24)[None, :] < rng.integers(12, 25, (4, 1))).astype(np.int64)
+    with torch.no_grad():
+        t_out = tmodel(torch.tensor(ids), attention_mask=torch.tensor(mask)).last_hidden_state.numpy()
+    f_out = np.asarray(fmodel(ids, attention_mask=mask).last_hidden_state)
+    np.testing.assert_allclose(f_out, t_out, atol=TOL)
+
+
+def test_bertscore_metric_parity_shared_weights(tiny_bert):
+    """Our Flax BERTScore equals the reference's torch BERTScore to <=1e-4
+    when both run the same weights on the same pre-tokenized inputs."""
+    if ref_f is None:
+        pytest.skip("reference torchmetrics not importable")
+    from torchmetrics.functional.text.bert import bert_score as ref_bert_score
+
+    from torchmetrics_tpu.functional.text.bert import bert_score
+
+    tmodel, fmodel, cfg = tiny_bert
+    rng = np.random.default_rng(1)
+    n_pairs, seq = 8, 24
+    lens = rng.permutation(np.arange(seq - n_pairs, seq))  # distinct: unambiguous argsort
+    mask = (np.arange(seq)[None, :] < lens[:, None]).astype(np.int64)
+    preds = {"input_ids": rng.integers(5, cfg.vocab_size, (n_pairs, seq)), "attention_mask": mask}
+    target = {"input_ids": rng.integers(5, cfg.vocab_size, (n_pairs, seq)), "attention_mask": mask}
+
+    ours = bert_score(preds, target, model=fmodel, batch_size=4, num_layers=cfg.num_hidden_layers)
+    tp = {k: torch.tensor(np.asarray(v)) for k, v in preds.items()}
+    tt = {k: torch.tensor(np.asarray(v)) for k, v in target.items()}
+    with torch.no_grad():
+        ref = ref_bert_score(tp, tt, model=tmodel, batch_size=4, num_layers=cfg.num_hidden_layers)
+    # Deliberate divergence: ours returns scores in INPUT order. The reference
+    # sorts inputs by length (helper_embedding_metric.py:79-84, perm p) and
+    # "restores" with emb[p] instead of the inverse permutation
+    # (bert.py:444-448), so its output order is p∘p of the input order
+    # whenever lengths aren't pre-sorted. Emulate that to compare values.
+    p = np.argsort(mask.sum(1))
+    q = p[p]
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(
+            np.asarray(ours[key], np.float64)[q], np.asarray(ref[key], np.float64), atol=1e-4,
+            err_msg=f"BERTScore {key} diverged on shared weights",
+        )
+
+
+def test_infolm_metric_parity_shared_weights(tiny_bert, tmp_path):
+    """Our Flax InfoLM equals the reference's torch InfoLM on a shared local
+    MLM checkpoint + shared wordpiece tokenizer."""
+    if ref_f is None:
+        pytest.skip("reference torchmetrics not importable")
+    from transformers import BertConfig, BertForMaskedLM, BertTokenizer, FlaxBertForMaskedLM
+
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    words = ["the", "cat", "dog", "sat", "ran", "on", "mat", "rug", "a", "fast", "slow", "big"]
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + words
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(vocab))
+    tokenizer = BertTokenizer(str(vocab_file))
+
+    cfg = BertConfig(
+        vocab_size=len(vocab), hidden_size=48, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=96, max_position_embeddings=32,
+    )
+    torch.manual_seed(0)
+    tmodel = BertForMaskedLM(cfg).eval()
+    ckpt = tmp_path / "mlm"
+    tmodel.save_pretrained(ckpt)
+    tokenizer.save_pretrained(ckpt)
+    fmodel = FlaxBertForMaskedLM.from_pretrained(ckpt, from_pt=True)
+
+    preds = ["the cat sat on the mat", "a fast dog ran"]
+    target = ["the big cat sat on a rug", "a slow dog ran"]
+    ours = infolm(
+        preds, target, model=fmodel, user_tokenizer=tokenizer, temperature=0.5,
+        information_measure="kl_divergence", idf=False,
+    )
+    from torchmetrics.functional.text.infolm import infolm as ref_infolm
+
+    with torch.no_grad():
+        ref = ref_infolm(
+            preds, target, model_name_or_path=str(ckpt), temperature=0.5,
+            information_measure="kl_divergence", idf=False, verbose=False,
+        )
+    np.testing.assert_allclose(float(ours), float(ref), atol=1e-4)
+
+
+# ------------------------------------------------------- CLIP: feature + metric
+
+
+def test_clip_tower_feature_parity(tiny_clip):
+    tmodel, fmodel, cfg = tiny_clip
+    rng = np.random.default_rng(0)
+    pix = rng.standard_normal((3, 3, 32, 32)).astype(np.float32)
+    ids = rng.integers(1, 99, (3, 12))
+    mask = np.ones((3, 12), np.int64)
+    with torch.no_grad():
+        t_img = tmodel.get_image_features(torch.tensor(pix)).numpy()
+        t_txt = tmodel.get_text_features(torch.tensor(ids), attention_mask=torch.tensor(mask)).numpy()
+    f_img = np.asarray(fmodel.get_image_features(pix))
+    f_txt = np.asarray(fmodel.get_text_features(ids, attention_mask=mask))
+    np.testing.assert_allclose(f_img, t_img, atol=TOL)
+    np.testing.assert_allclose(f_txt, t_txt, atol=TOL)
+
+
+def test_clip_score_metric_parity_shared_weights(tiny_clip):
+    """Our CLIPScore (Flax towers) equals the score formula evaluated with
+    the torch towers on identical processed inputs."""
+    from torchmetrics_tpu.multimodal import CLIPScore
+
+    tmodel, fmodel, _ = tiny_clip
+    proc = _FakeCLIPProcessor()
+    rng = np.random.default_rng(2)
+    images = [rng.integers(0, 255, (3, 32, 32)).astype(np.uint8) for _ in range(4)]
+    text = ["a cat on a mat", "dog photo", "blue sky above hills", "city at night"]
+
+    metric = CLIPScore(model=fmodel, processor=proc)
+    metric.update([jnp.asarray(i) for i in images], text)
+    ours = float(metric.compute())
+
+    processed = proc(text=text, images=images)
+    with torch.no_grad():
+        img_f = tmodel.get_image_features(torch.tensor(processed["pixel_values"]))
+        txt_f = tmodel.get_text_features(
+            torch.tensor(processed["input_ids"]), attention_mask=torch.tensor(processed["attention_mask"])
+        )
+    img_f = img_f / img_f.norm(dim=-1, keepdim=True)
+    txt_f = txt_f / txt_f.norm(dim=-1, keepdim=True)
+    ref = float(torch.clamp(100 * (img_f * txt_f).sum(-1).mean(), min=0))
+    np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+def test_clip_iqa_metric_parity_shared_weights(tiny_clip):
+    """Our CLIP-IQA (Flax towers) equals the prompt-pair softmax computed
+    with the torch towers on identical processed inputs."""
+    from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+
+    tmodel, fmodel, _ = tiny_clip
+    proc = _FakeCLIPProcessor()
+    rng = np.random.default_rng(3)
+    images = jnp.asarray(rng.random((3, 3, 32, 32), dtype=np.float32))
+
+    metric = CLIPImageQualityAssessment(model=fmodel, processor=proc, prompts=("quality",), data_range=1.0)
+    metric.update(images)
+    ours = np.asarray(metric.compute(), np.float64)
+
+    prompts = ["Good photo.", "Bad photo."]
+    processed = proc(text=prompts)
+    # mirror _clip_iqa_update's processing: scale by data_range, feed raw
+    # pixel values (the fake processor normalizes by max)
+    pix = proc(images=[np.asarray(i) for i in (images * 255).astype(np.uint8)])["pixel_values"]
+    with torch.no_grad():
+        img_f = tmodel.get_image_features(torch.tensor(pix))
+        txt_f = tmodel.get_text_features(
+            torch.tensor(processed["input_ids"]), attention_mask=torch.tensor(processed["attention_mask"])
+        )
+    img_f = img_f / img_f.norm(dim=-1, keepdim=True)
+    txt_f = txt_f / txt_f.norm(dim=-1, keepdim=True)
+    logits = 100 * img_f @ txt_f.T
+    ref = torch.softmax(logits, dim=-1)[:, 0].numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+
+# ------------------------------------------- Inception: converter-chain parity
+
+
+def test_inception_converter_chain_parity(tmp_path):
+    """Torch FID-Inception state dict -> convert_inception_weights ->
+    load_inception_weights -> Flax features match the torch forward at every
+    tap. Validates the exact offline conversion path for the published
+    ``pt_inception-2015-12-05.pth``."""
+    from convert_inception_weights import convert_state_dict
+
+    from tests.unittests._helpers.torch_towers import TorchFIDInception, randomize_bn_stats
+    from torchmetrics_tpu.image.backbones.inception import load_inception_weights
+
+    torch.manual_seed(0)
+    tmodel = TorchFIDInception().eval()
+    with torch.no_grad():
+        randomize_bn_stats(tmodel, seed=1)
+
+    npz_path = tmp_path / "inception.npz"
+    np.savez(npz_path, **convert_state_dict({k: v.numpy() for k, v in tmodel.state_dict().items()}))
+
+    feats = ("64", "192", "768", "2048", "logits_unbiased")
+    extractor = load_inception_weights(str(npz_path), features_list=feats)
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (2, 3, 299, 299)).astype(np.uint8)
+    ours = extractor(jnp.asarray(imgs))
+    with torch.no_grad():
+        ref = tmodel(torch.tensor(imgs))
+    for name, f_ours in zip(feats, ours):
+        f_ref = ref[name].numpy()
+        np.testing.assert_allclose(
+            np.asarray(f_ours), f_ref, atol=5e-3, rtol=1e-3,
+            err_msg=f"Inception tap {name} diverged through the converter chain",
+        )
+
+
+# ------------------------------------------------ LPIPS: converter-chain parity
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+def test_lpips_converter_chain_parity(net_type, tmp_path):
+    """Torch LPIPS (torchvision-layout trunk + richzhang-layout heads) ->
+    convert_lpips_weights -> Flax LPIPS matches per-pair scores."""
+    from convert_lpips_weights import convert_lpips_params
+
+    from tests.unittests._helpers.torch_towers import TorchLPIPS
+    from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+    tmodel = TorchLPIPS(net_type=net_type, seed=0).eval()
+    trunk_state = {k: v.numpy() for k, v in tmodel.trunk.state_dict().items()}
+    heads_state = {k: v.numpy() for k, v in tmodel.heads_state_dict().items()}
+    tree = convert_lpips_params(net_type, trunk_state, heads_state)
+
+    metric = LearnedPerceptualImagePatchSimilarity(net_type=net_type, net_params=tree)
+    rng = np.random.default_rng(0)
+    img1 = (rng.random((2, 3, 64, 64), dtype=np.float32) * 2 - 1)
+    img2 = (rng.random((2, 3, 64, 64), dtype=np.float32) * 2 - 1)
+    metric.update(jnp.asarray(img1), jnp.asarray(img2))
+    ours = float(metric.compute())
+    with torch.no_grad():
+        ref = float(tmodel(torch.tensor(img1), torch.tensor(img2)).mean())
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-4)

@@ -8,6 +8,13 @@ greedy max-matching are one batched einsum + max-reduce. ``model``/
 ``user_tokenizer``/``user_forward_fn`` are injectable exactly like the
 reference's user-model path (``bert.py:259-…``), which keeps the metric
 usable offline and with custom towers.
+
+Deliberate divergence: scores return in INPUT order. The reference sorts
+inputs by length (``helper_embedding_metric.py:79-84``, permutation ``p``)
+and "restores" with ``emb[p]`` instead of the inverse permutation
+(``bert.py:444-448``), so its per-sentence outputs are permuted whenever
+input lengths aren't pre-sorted; corpus means agree. Verified with shared
+weights in ``tests/unittests/tower_parity/test_shared_weight_parity.py``.
 """
 from __future__ import annotations
 
