@@ -114,17 +114,23 @@ def _match_one_image(
     thr = jnp.minimum(iou_thrs, 1 - 1e-10)[None, :]  # (1, T) broadcast over (A, T)
     gt_ig_full = jnp.broadcast_to(gt_ig[:, None, :], (num_a, num_t, num_g))
 
+    def _last_argmax(vals: Array) -> Array:
+        # pycocotools' match loop updates on `iou >= best`, so among equal
+        # IoUs the LAST ground truth in iteration order wins — first-argmax
+        # silently diverges on exact ties (symmetric/grid boxes)
+        return num_g - 1 - jnp.argmax(vals[..., ::-1], axis=-1)
+
     def step(gt_matched: Array, inputs: Tuple[Array, Array]) -> Tuple[Array, Array]:
         iou_d, ok_d = inputs  # (G,), (G,)
         # stage 1: regular (non-ignored, unmatched) ground truths
         cand1 = ok_d[None, None, :] & (~gt_ig[:, None, :]) & (~gt_matched)  # (A, T, G)
         vals1 = jnp.where(cand1, iou_d[None, None, :], -1.0)
-        best1 = jnp.argmax(vals1, axis=-1)  # (A, T); first max ties like pycocotools
+        best1 = _last_argmax(vals1)  # (A, T)
         ok1 = jnp.max(vals1, axis=-1) >= thr
         # stage 2: ignored ground truths — crowds matchable repeatedly
         cand2 = ok_d[None, None, :] & gt_ig[:, None, :] & (gt_crowd[None, None, :] | ~gt_matched)
         vals2 = jnp.where(cand2, iou_d[None, None, :], -1.0)
-        best2 = jnp.argmax(vals2, axis=-1)
+        best2 = _last_argmax(vals2)
         ok2 = jnp.max(vals2, axis=-1) >= thr
 
         matched = ok1 | ok2  # (A, T)
