@@ -113,7 +113,8 @@ def case_maxdet_truncation():
         np.arange(n_gt) * 20.0 + 15.0, np.full(n_gt, 15.0),
     ], axis=1)
     # 30 dets: the 12 perfect hits have LOW scores, the 18 misses HIGH scores
-    det_boxes = np.concatenate([gt_boxes, rng.rand(18, 2).repeat(2, 1) * 300 + [[0, 0, 5, 5]] * 18])
+    miss_xy = rng.rand(18, 2) * 300
+    det_boxes = np.concatenate([gt_boxes, np.concatenate([miss_xy, miss_xy + 5.0], axis=1)])
     scores = np.concatenate([np.linspace(0.4, 0.2, n_gt), np.linspace(0.95, 0.5, 18)])
     preds = [{"boxes": det_boxes, "scores": scores, "labels": np.zeros(30, np.int64)}]
     target = [{"boxes": gt_boxes, "labels": np.zeros(n_gt, np.int64)}]

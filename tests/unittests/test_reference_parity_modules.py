@@ -81,6 +81,161 @@ _CASES = [
     ]),
 ]
 
+# ---- round-3 expansion (VERDICT #5): stateful-nontrivial classes across all
+# domains — streaming accumulation parity, not just kernels
+
+
+def _pos_stream():
+    return [((_RNG.rand(N) + 0.1).astype(np.float32), (_RNG.rand(N) + 0.1).astype(np.float32)) for _ in range(BATCHES)]
+
+
+def _label_stream(c=4):
+    return [(_RNG.randint(0, c, N), _RNG.randint(0, c, N)) for _ in range(BATCHES)]
+
+
+def _ml_stream(n_labels=4):
+    return [(_RNG.rand(N, n_labels).astype(np.float32), _RNG.randint(0, 2, (N, n_labels))) for _ in range(BATCHES)]
+
+
+def _audio_stream():
+    return [(_RNG.randn(2, 256).astype(np.float32), _RNG.randn(2, 256).astype(np.float32)) for _ in range(BATCHES)]
+
+
+def _retrieval_stream():
+    out = []
+    for _ in range(BATCHES):
+        idx = np.repeat(np.arange(4), 8)
+        t = _RNG.randint(0, 2, 32)
+        t[::8] = 1  # every query has a relevant doc
+        out.append((_RNG.rand(32).astype(np.float32), t, idx.astype(np.int64)))
+    return out
+
+
+def _text_stream():
+    return [
+        (["the cat sat on a mat"], ["the cat sat on the mat"]),
+        (["hello there general"], ["hello there general kenobi"]),
+        (["completely different"], ["totally different phrase"]),
+    ]
+
+
+def _bleu_stream():
+    return [
+        (["the cat is on the mat"], [["the cat sat on the mat"]]),
+        (["hello there"], [["hello there general"]]),
+        (["one two three four"], [["one two three four"]]),
+    ]
+
+
+_CASES += [
+    # classification — stat-scores family variants
+    ("binary_precision_m", "BinaryPrecision", {}, _bin_stream),
+    ("binary_recall_m", "BinaryRecall", {}, _bin_stream),
+    ("binary_specificity_m", "BinarySpecificity", {}, _bin_stream),
+    ("binary_stat_scores_m", "BinaryStatScores", {}, _bin_stream),
+    ("binary_f1_m", "BinaryF1Score", {}, _bin_stream),
+    ("binary_fbeta_m", "BinaryFBetaScore", {"beta": 2.0}, _bin_stream),
+    ("binary_cohen_kappa_m", "BinaryCohenKappa", {}, _bin_stream),
+    ("binary_mcc_m", "BinaryMatthewsCorrCoef", {}, _bin_stream),
+    ("binary_hamming_m", "BinaryHammingDistance", {}, _bin_stream),
+    ("binary_jaccard_m", "BinaryJaccardIndex", {}, _bin_stream),
+    ("binary_calibration_m", "BinaryCalibrationError", {"n_bins": 10}, _bin_stream),
+    ("binary_ap_exact_m", "BinaryAveragePrecision", {}, _bin_stream),
+    ("multiclass_precision_none", "MulticlassPrecision", {"num_classes": 5, "average": "none"}, _cls_stream),
+    ("multiclass_recall_weighted", "MulticlassRecall", {"num_classes": 5, "average": "weighted"}, _cls_stream),
+    ("multiclass_specificity_m", "MulticlassSpecificity", {"num_classes": 5}, _cls_stream),
+    ("multiclass_stat_scores_m", "MulticlassStatScores", {"num_classes": 5}, _cls_stream),
+    ("multiclass_kappa_m", "MulticlassCohenKappa", {"num_classes": 5}, _cls_stream),
+    ("multiclass_jaccard_m", "MulticlassJaccardIndex", {"num_classes": 5}, _cls_stream),
+    ("multiclass_auroc_exact_m", "MulticlassAUROC", {"num_classes": 5}, _cls_stream),
+    ("multiclass_exact_match", "MulticlassExactMatch", {"num_classes": 5}, lambda: [
+        (_RNG.randint(0, 5, (8, 6)), _RNG.randint(0, 5, (8, 6))) for _ in range(BATCHES)
+    ]),
+    ("multilabel_accuracy_m", "MultilabelAccuracy", {"num_labels": 4}, _ml_stream),
+    ("multilabel_f1_m", "MultilabelF1Score", {"num_labels": 4}, _ml_stream),
+    ("multilabel_precision_m", "MultilabelPrecision", {"num_labels": 4}, _ml_stream),
+    ("multilabel_hamming_m", "MultilabelHammingDistance", {"num_labels": 4}, _ml_stream),
+    ("multilabel_ranking_ap_m", "MultilabelRankingAveragePrecision", {"num_labels": 4}, _ml_stream),
+    ("multilabel_coverage_m", "MultilabelCoverageError", {"num_labels": 4}, _ml_stream),
+    # regression
+    ("mape_m", "MeanAbsolutePercentageError", {}, _pos_stream),
+    ("smape_m", "SymmetricMeanAbsolutePercentageError", {}, _pos_stream),
+    ("wmape_m", "WeightedMeanAbsolutePercentageError", {}, _pos_stream),
+    ("msle_m", "MeanSquaredLogError", {}, _pos_stream),
+    ("minkowski_m", "MinkowskiDistance", {"p": 3}, _reg_stream),
+    ("log_cosh_m", "LogCoshError", {}, _reg_stream),
+    ("cosine_sim_m", "CosineSimilarity", {"reduction": "mean"}, lambda: [
+        (_RNG.randn(8, 6).astype(np.float32), _RNG.randn(8, 6).astype(np.float32)) for _ in range(BATCHES)
+    ]),
+    ("kendall_m", "KendallRankCorrCoef", {}, _reg_stream),
+    ("concordance_m", "ConcordanceCorrCoef", {}, _reg_stream),
+    ("tweedie_m", "TweedieDevianceScore", {"power": 1.5}, _pos_stream),
+    ("kl_div_m", "KLDivergence", {}, lambda: [
+        tuple((lambda p: p / p.sum(1, keepdims=True))(_RNG.rand(8, 5).astype(np.float32) + 0.1) for _ in range(2))
+        for _ in range(BATCHES)
+    ]),
+    ("rse_m", "RelativeSquaredError", {}, _reg_stream),
+    # aggregation
+    ("min_metric", "MinMetric", {}, lambda: [(_RNG.randn(N).astype(np.float32),) for _ in range(BATCHES)]),
+    ("cat_metric", "CatMetric", {}, lambda: [(_RNG.randn(N).astype(np.float32),) for _ in range(BATCHES)]),
+    ("running_mean", "RunningMean", {"window": 2}, lambda: [(_RNG.randn(N).astype(np.float32),) for _ in range(BATCHES)]),
+    ("running_sum", "RunningSum", {"window": 2}, lambda: [(_RNG.randn(N).astype(np.float32),) for _ in range(BATCHES)]),
+    # retrieval (indexes as third positional arg; list states + None reduction)
+    ("retrieval_map_m", "RetrievalMAP", {}, _retrieval_stream),
+    ("retrieval_mrr_m", "RetrievalMRR", {}, _retrieval_stream),
+    ("retrieval_ndcg_m", "RetrievalNormalizedDCG", {}, _retrieval_stream),
+    ("retrieval_precision_m", "RetrievalPrecision", {"top_k": 5}, _retrieval_stream),
+    ("retrieval_recall_m", "RetrievalRecall", {"top_k": 5}, _retrieval_stream),
+    ("retrieval_fallout_m", "RetrievalFallOut", {"top_k": 5}, _retrieval_stream),
+    ("retrieval_hitrate_m", "RetrievalHitRate", {"top_k": 5}, _retrieval_stream),
+    ("retrieval_rprec_m", "RetrievalRPrecision", {}, _retrieval_stream),
+    # clustering / nominal
+    ("mutual_info_m", "MutualInfoScore", {}, _label_stream),
+    ("adjusted_rand_m", "AdjustedRandScore", {}, _label_stream),
+    ("rand_m", "RandScore", {}, _label_stream),
+    ("normalized_mi_m", "NormalizedMutualInfoScore", {}, _label_stream),
+    ("fowlkes_mallows_m", "FowlkesMallowsIndex", {}, _label_stream),
+    ("homogeneity_m", "HomogeneityScore", {}, _label_stream),
+    ("completeness_m", "CompletenessScore", {}, _label_stream),
+    ("cramers_m", "CramersV", {"num_classes": 4}, _label_stream),
+    ("theils_u_m", "TheilsU", {"num_classes": 4}, _label_stream),
+    # text
+    ("cer_m", "CharErrorRate", {}, _text_stream),
+    ("mer_m", "MatchErrorRate", {}, _text_stream),
+    ("wil_m", "WordInfoLost", {}, _text_stream),
+    ("wip_m", "WordInfoPreserved", {}, _text_stream),
+    ("edit_distance_m", "EditDistance", {"reduction": "mean"}, _text_stream),
+    ("chrf_m", "CHRFScore", {}, _bleu_stream),
+    ("sacre_bleu_m", "SacreBLEUScore", {}, _bleu_stream),
+    ("ter_m", "TranslationEditRate", {}, _bleu_stream),
+    # image
+    ("total_variation_m", "TotalVariation", {}, lambda: [(_RNG.rand(2, 3, 24, 24).astype(np.float32),) for _ in range(BATCHES)]),
+    ("sam_m", "SpectralAngleMapper", {}, _img_stream),
+    ("ergas_m", "ErrorRelativeGlobalDimensionlessSynthesis", {}, lambda: [
+        (_RNG.rand(2, 3, 24, 24).astype(np.float32) + 0.1, _RNG.rand(2, 3, 24, 24).astype(np.float32) + 0.1)
+        for _ in range(BATCHES)
+    ]),
+    ("rmse_sw_m", "RootMeanSquaredErrorUsingSlidingWindow", {"window_size": 8}, _img_stream),
+    ("msssim_m", "MultiScaleStructuralSimilarityIndexMeasure", {"data_range": 1.0, "kernel_size": 3, "betas": (0.3, 0.7)}, lambda: [
+        (_RNG.rand(2, 3, 48, 48).astype(np.float32), _RNG.rand(2, 3, 48, 48).astype(np.float32))
+        for _ in range(BATCHES)
+    ]),
+    # audio
+    ("snr_m", "SignalNoiseRatio", {}, _audio_stream),
+    ("si_sdr_m", "ScaleInvariantSignalDistortionRatio", {}, _audio_stream),
+    ("si_snr_m", "ScaleInvariantSignalNoiseRatio", {}, _audio_stream),
+    ("sdr_m", "SignalDistortionRatio", {}, lambda: [
+        (_RNG.randn(2, 512).astype(np.float64), _RNG.randn(2, 512).astype(np.float64)) for _ in range(BATCHES)
+    ]),
+    # detection / segmentation
+    ("panoptic_m", "PanopticQuality", {"things": {0, 1}, "stuffs": {2}, "allow_unknown_preds_category": True}, lambda: [
+        (_RNG.randint(0, 3, (1, 16, 16, 2)), _RNG.randint(0, 3, (1, 16, 16, 2))) for _ in range(BATCHES)
+    ]),
+    ("mean_iou_m", "MeanIoU", {"num_classes": 3, "input_format": "index"}, lambda: [
+        (_RNG.randint(0, 3, (2, 16, 16)), _RNG.randint(0, 3, (2, 16, 16))) for _ in range(BATCHES)
+    ]),
+]
+
 
 def _resolve(ns, name):
     cls = getattr(ns, name, None)
@@ -89,17 +244,36 @@ def _resolve(ns, name):
     return cls
 
 
+_SUBS = (
+    "classification", "clustering", "nominal", "detection", "segmentation",
+    "image", "audio", "text", "retrieval", "regression", "wrappers", "aggregation", "multimodal",
+)
+
+
+def _find(root_pkg, root_mod, cls_name):
+    """Resolve a metric class from the top-level namespace or any domain
+    sub-package — one lookup path for both frameworks."""
+    import importlib
+
+    cls = getattr(root_mod, cls_name, None)
+    if cls is not None:
+        return cls
+    for sub in _SUBS:
+        try:
+            mod = importlib.import_module(f"{root_pkg}.{sub}")
+        except Exception:
+            continue
+        cls = getattr(mod, cls_name, None)
+        if cls is not None:
+            return cls
+    return None
+
+
 @pytest.mark.parametrize("name,cls_name,kwargs,make_stream", _CASES, ids=[c[0] for c in _CASES])
 def test_module_streaming_parity_with_reference(name, cls_name, kwargs, make_stream):
-    ours_cls = getattr(our_tm, cls_name, None)
-    ref_cls = getattr(ref_tm, cls_name, None)
-    if ours_cls is None or ref_cls is None:
-        import torchmetrics.classification as ref_cl
-
-        import torchmetrics_tpu.classification as our_cl
-
-        ours_cls = ours_cls or _walk(our_cl, cls_name)
-        ref_cls = ref_cls or getattr(ref_cl, cls_name)
+    ours_cls = _find("torchmetrics_tpu", our_tm, cls_name)
+    ref_cls = _find("torchmetrics", ref_tm, cls_name)
+    assert ours_cls is not None and ref_cls is not None, f"class {cls_name} unresolved"
     ours = ours_cls(**kwargs)
     ref = ref_cls(**kwargs)
     for batch in make_stream():

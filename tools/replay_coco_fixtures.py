@@ -17,6 +17,8 @@ Usage::
 """
 from __future__ import annotations
 
+import contextlib
+import io
 import json
 import sys
 from pathlib import Path
@@ -84,8 +86,6 @@ def main() -> int:
     failures = 0
     for case in fixtures["cases"]:
         gt_dict, det_results = _to_coco_datasets(case)
-        import contextlib, io
-
         with contextlib.redirect_stdout(io.StringIO()):
             coco_gt = COCO()
             coco_gt.dataset = gt_dict
