@@ -179,6 +179,14 @@ class Metric:
             if not isinstance(default, (jnp.ndarray, np.ndarray, jax.Array)):
                 raise ValueError("state variable must be an array or any empty list (where you can append arrays)")
             default = jnp.asarray(default)
+            if getattr(default, "weak_type", False):
+                # Strengthen the dtype: a weak-typed f32 accumulator (e.g.
+                # `jnp.asarray(0.0)`) silently DEGRADES to bf16 on its first
+                # `state + bf16_value` update (weak types defer to the other
+                # operand), and every later batch then accumulates in ~3
+                # decimal digits. A committed dtype makes f32 accumulation a
+                # hard boundary for low-precision inputs.
+                default = jnp.asarray(default, dtype=default.dtype)
         if dist_reduce_fx is not None and not (dist_reduce_fx in _REDUCTION_MAP or callable(dist_reduce_fx)):
             raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
         if name in ("update", "compute", "forward", "reset"):
