@@ -1,0 +1,61 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Doctest runner over the whole package — the analogue of the reference's
+``pytest --doctest-plus src/torchmetrics`` (reference ``Makefile:28-31``).
+
+Walks every ``torchmetrics_tpu`` module, collects ``>>>`` examples from
+module/class/function docstrings, and executes them. Any example added to any
+docstring anywhere in the package is automatically enforced from then on.
+"""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import torchmetrics_tpu
+
+# modules whose import needs optional deps or whose examples need heavy towers
+_SKIP_PREFIXES = ("torchmetrics_tpu.native",)
+
+
+def _iter_modules():
+    yield "torchmetrics_tpu"
+    for info in pkgutil.walk_packages(torchmetrics_tpu.__path__, prefix="torchmetrics_tpu."):
+        if info.name.startswith(_SKIP_PREFIXES):
+            continue
+        yield info.name
+
+
+_MODULES = sorted(set(_iter_modules()))
+
+
+@pytest.mark.parametrize("module_name", _MODULES)
+def test_module_doctests(module_name):
+    try:
+        module = importlib.import_module(module_name)
+    except Exception as err:  # optional-dep gated modules
+        pytest.skip(f"{module_name} not importable here: {err}")
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS)
+    tests = [t for t in finder.find(module, module_name) if t.examples]
+    failures = 0
+    for test in tests:
+        result = runner.run(test)
+        failures += result.failed
+    assert failures == 0, f"{failures} doctest failure(s) in {module_name}"
+
+
+def test_doctest_example_count_grows():
+    """Keep a floor under the number of executable docstring examples so the
+    doctest surface only grows (round-3 start: 0; target: every public
+    class)."""
+    total = 0
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    for module_name in _MODULES:
+        try:
+            module = importlib.import_module(module_name)
+        except Exception:
+            continue
+        total += sum(1 for t in finder.find(module, module_name) if t.examples)
+    assert total >= 60, f"only {total} docstring examples found"

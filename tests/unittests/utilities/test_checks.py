@@ -1,0 +1,54 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Input-validation helper coverage (reference ``utilities/checks.py``)."""
+import jax.numpy as jnp
+import pytest
+
+
+def test_check_for_empty_tensors_and_input_squeeze():
+    from torchmetrics_tpu.utilities.checks import _check_for_empty_tensors, _input_squeeze
+
+    assert _check_for_empty_tensors(jnp.zeros((0,)), jnp.zeros((0,)))
+    assert not _check_for_empty_tensors(jnp.zeros((2,)), jnp.zeros((2,)))
+    # reference semantics: True only when BOTH are empty (checks.py:33)
+    assert not _check_for_empty_tensors(jnp.zeros((0,)), jnp.zeros((2,)))
+    p, t = _input_squeeze(jnp.zeros((1, 4, 1)), jnp.zeros((1, 4, 1)))
+    assert p.shape == (1, 4) and t.shape == (1, 4)
+    p, t = _input_squeeze(jnp.zeros((3, 4, 1)), jnp.zeros((3, 4, 1)))
+    assert p.shape == (3, 4)
+
+
+def test_is_overridden():
+    from torchmetrics_tpu import Metric, SumMetric
+    from torchmetrics_tpu.utilities.checks import is_overridden
+
+    assert is_overridden("update", SumMetric(), Metric)
+    assert not is_overridden("reset", SumMetric(), Metric)
+
+
+def test_retrieval_checks_reject_empty_and_bad_dtypes():
+    from torchmetrics_tpu.utilities.checks import (
+        _check_retrieval_functional_inputs,
+        _check_retrieval_inputs,
+    )
+
+    with pytest.raises(ValueError, match="non-empty"):
+        _check_retrieval_functional_inputs(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32))
+    with pytest.raises(ValueError, match="floats"):
+        _check_retrieval_functional_inputs(jnp.zeros(3, jnp.int32), jnp.zeros(3, jnp.int32))
+    with pytest.raises(ValueError, match="binary"):
+        _check_retrieval_functional_inputs(jnp.ones(3), jnp.asarray([0, 1, 2]))
+    with pytest.raises(ValueError, match="integers"):
+        _check_retrieval_inputs(jnp.zeros(3), jnp.ones(3), jnp.asarray([0, 1, 1]))
+    idx, p, t = _check_retrieval_inputs(
+        jnp.asarray([0, 0, 1]), jnp.asarray([0.5, 0.2, 0.9]), jnp.asarray([0, 1, 1])
+    )
+    assert idx.dtype == jnp.int32 and p.dtype == jnp.float32
+    # fractional relevance in [0, 1] is accepted (reference checks.py:610 is a
+    # range check, not exact-{0,1})
+    _check_retrieval_functional_inputs(jnp.ones(3), jnp.asarray([0.0, 0.5, 1.0]))
+    # an all-ignored batch raises AFTER filtering (reference checks.py:575)
+    with pytest.raises(ValueError, match="non-empty"):
+        _check_retrieval_inputs(
+            jnp.asarray([0, 0]), jnp.asarray([0.1, 0.2]), jnp.asarray([-1, -1]), ignore_index=-1
+        )

@@ -1,0 +1,340 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Runnable usage examples attached to metric class docstrings.
+
+The reference embeds a doctest example in every metric docstring and enforces
+them via ``--doctest-plus`` (reference ``Makefile:28-31``). Here the examples
+for non-factory classes live in ONE table and are appended to each class's
+docstring at import time; ``tests/unittests/test_doctests.py`` walks every
+module and executes whatever ``>>>`` blocks it finds, so each entry below is
+a continuously-verified usage contract (values are analytic where possible:
+perfect predictions, constant offsets, exact ranks).
+"""
+from __future__ import annotations
+
+_EXAMPLES = {
+    # --------------------------------------------------------- classification
+    "classification.f_beta.MulticlassF1Score": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassF1Score
+    >>> metric = MulticlassF1Score(num_classes=3, average='macro')
+    >>> metric.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 1]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "classification.f_beta.BinaryFBetaScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryFBetaScore
+    >>> metric = BinaryFBetaScore(beta=2.0)
+    >>> metric.update(np.array([0.2, 0.8, 0.9]), np.array([0, 1, 1]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "classification.auroc.BinaryAUROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryAUROC
+    >>> metric = BinaryAUROC()
+    >>> metric.update(np.array([0.1, 0.4, 0.35, 0.8]), np.array([0, 0, 1, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.75
+    """,
+    "classification.confusion_matrix.MulticlassConfusionMatrix": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    >>> metric = MulticlassConfusionMatrix(num_classes=2)
+    >>> metric.update(np.array([0, 1, 1]), np.array([0, 1, 0]))
+    >>> np.asarray(metric.compute()).tolist()
+    [[1, 1], [0, 1]]
+    """,
+    "classification.matthews_corrcoef.BinaryMatthewsCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryMatthewsCorrCoef
+    >>> metric = BinaryMatthewsCorrCoef()
+    >>> metric.update(np.array([0, 1, 1, 0]), np.array([0, 1, 1, 0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "classification.cohen_kappa.BinaryCohenKappa": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryCohenKappa
+    >>> metric = BinaryCohenKappa()
+    >>> metric.update(np.array([0, 1, 1, 0]), np.array([0, 1, 1, 0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "classification.jaccard.MulticlassJaccardIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassJaccardIndex
+    >>> metric = MulticlassJaccardIndex(num_classes=3)
+    >>> metric.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 1]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # -------------------------------------------------------------- regression
+    "regression.mse.MeanSquaredError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MeanSquaredError
+    >>> metric = MeanSquaredError()
+    >>> metric.update(np.array([2.5, 0.0, 2.0, 8.0]), np.array([3.0, -0.5, 2.0, 7.0]))
+    >>> round(float(metric.compute()), 4)
+    0.375
+    """,
+    "regression.mae.MeanAbsoluteError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MeanAbsoluteError
+    >>> metric = MeanAbsoluteError()
+    >>> metric.update(np.array([2.5, 0.0, 2.0, 8.0]), np.array([3.0, -0.5, 2.0, 7.0]))
+    >>> round(float(metric.compute()), 4)
+    0.5
+    """,
+    "regression.pearson.PearsonCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import PearsonCorrCoef
+    >>> metric = PearsonCorrCoef()
+    >>> metric.update(np.array([1.0, 2.0, 3.0, 4.0]), np.array([2.0, 4.0, 6.0, 8.0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "regression.r2.R2Score": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import R2Score
+    >>> metric = R2Score()
+    >>> metric.update(np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0, 3.0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "regression.spearman.SpearmanCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import SpearmanCorrCoef
+    >>> metric = SpearmanCorrCoef()
+    >>> metric.update(np.array([1.0, 2.0, 3.0, 4.0]), np.array([10.0, 20.0, 30.0, 40.0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # ------------------------------------------------------------- aggregation
+    "aggregation.MeanMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MeanMetric
+    >>> metric = MeanMetric()
+    >>> metric.update(np.array([1.0, 2.0, 3.0]))
+    >>> round(float(metric.compute()), 4)
+    2.0
+    """,
+    "aggregation.SumMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import SumMetric
+    >>> metric = SumMetric()
+    >>> metric.update(np.array([1.0, 2.0, 3.0]))
+    >>> round(float(metric.compute()), 4)
+    6.0
+    """,
+    "aggregation.MaxMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MaxMetric
+    >>> metric = MaxMetric()
+    >>> metric.update(np.array([1.0, 3.0, 2.0]))
+    >>> round(float(metric.compute()), 4)
+    3.0
+    """,
+    "aggregation.MinMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MinMetric
+    >>> metric = MinMetric()
+    >>> metric.update(np.array([1.0, 3.0, 2.0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "aggregation.CatMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import CatMetric
+    >>> metric = CatMetric()
+    >>> metric.update(np.array([1.0, 2.0]))
+    >>> metric.update(np.array([3.0]))
+    >>> np.asarray(metric.compute()).tolist()
+    [1.0, 2.0, 3.0]
+    """,
+    # -------------------------------------------------------------------- text
+    "text.metrics.WordErrorRate": """
+    >>> from torchmetrics_tpu import WordErrorRate
+    >>> metric = WordErrorRate()
+    >>> metric.update(["the cat sat"], ["the cat sat down"])
+    >>> round(float(metric.compute()), 4)
+    0.25
+    """,
+    "text.metrics.CharErrorRate": """
+    >>> from torchmetrics_tpu import CharErrorRate
+    >>> metric = CharErrorRate()
+    >>> metric.update(["abc"], ["abcd"])
+    >>> round(float(metric.compute()), 4)
+    0.25
+    """,
+    "text.metrics.BLEUScore": """
+    >>> from torchmetrics_tpu import BLEUScore
+    >>> metric = BLEUScore()
+    >>> metric.update(["the cat is on the mat"], [["the cat sat on the mat", "a cat is on the mat"]])
+    >>> round(float(metric.compute()), 4)
+    0.8409
+    """,
+    "text.metrics.EditDistance": """
+    >>> from torchmetrics_tpu import EditDistance
+    >>> metric = EditDistance(reduction='mean')
+    >>> metric.update(["abc"], ["abcd"])
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # ------------------------------------------------------------------- image
+    "image.metrics.PeakSignalNoiseRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import PeakSignalNoiseRatio
+    >>> metric = PeakSignalNoiseRatio(data_range=1.0)
+    >>> metric.update(np.full((1, 1, 8, 8), 0.5), np.full((1, 1, 8, 8), 0.75))
+    >>> round(float(metric.compute()), 4)
+    12.0412
+    """,
+    "image.metrics.TotalVariation": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import TotalVariation
+    >>> metric = TotalVariation()
+    >>> metric.update(np.ones((1, 1, 8, 8), np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "image.metrics.StructuralSimilarityIndexMeasure": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import StructuralSimilarityIndexMeasure
+    >>> rng = np.random.RandomState(0)
+    >>> img = rng.rand(1, 1, 16, 16).astype(np.float32)
+    >>> metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+    >>> metric.update(img, img)
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # ------------------------------------------------------------------- audio
+    "audio.metrics.SignalNoiseRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import SignalNoiseRatio
+    >>> metric = SignalNoiseRatio()
+    >>> target = np.ones(4, np.float32)
+    >>> metric.update(target + 1.0, target)  # noise power == signal power
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "audio.metrics.ScaleInvariantSignalDistortionRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import ScaleInvariantSignalDistortionRatio
+    >>> metric = ScaleInvariantSignalDistortionRatio()
+    >>> target = np.array([1.0, -1.0, 1.0, -1.0])
+    >>> metric.update(2.0 * target, target)  # scaling leaves SI-SDR unchanged
+    >>> float(metric.compute()) > 30
+    True
+    """,
+    # --------------------------------------------------------------- retrieval
+    "retrieval.metrics.RetrievalMAP": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import RetrievalMAP
+    >>> metric = RetrievalMAP()
+    >>> metric.update(np.array([0.9, 0.2, 0.7]), np.array([1, 0, 1]), indexes=np.array([0, 0, 0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "retrieval.metrics.RetrievalNormalizedDCG": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import RetrievalNormalizedDCG
+    >>> metric = RetrievalNormalizedDCG()
+    >>> metric.update(np.array([0.9, 0.2, 0.7]), np.array([1, 0, 1]), indexes=np.array([0, 0, 0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # -------------------------------------------------------------- clustering
+    "clustering.metrics.MutualInfoScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MutualInfoScore
+    >>> metric = MutualInfoScore()
+    >>> metric.update(np.array([0, 1, 0, 1]), np.array([0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.6931
+    """,
+    "clustering.metrics.AdjustedRandScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import AdjustedRandScore
+    >>> metric = AdjustedRandScore()
+    >>> metric.update(np.array([0, 0, 1, 1]), np.array([1, 1, 0, 0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # ------------------------------------------------------------ segmentation
+    "segmentation.metrics.MeanIoU": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MeanIoU
+    >>> metric = MeanIoU(num_classes=2, input_format='index')
+    >>> seg = np.array([[[0, 1], [1, 0]]])
+    >>> metric.update(seg, seg)
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "classification.exact_match.MulticlassExactMatch": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassExactMatch
+    >>> metric = MulticlassExactMatch(num_classes=3)
+    >>> metric.update(np.array([[0, 1], [2, 1]]), np.array([[0, 1], [2, 1]]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "regression.explained_variance.ExplainedVariance": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import ExplainedVariance
+    >>> metric = ExplainedVariance()
+    >>> metric.update(np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0, 3.0]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "regression.cosine_similarity.CosineSimilarity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import CosineSimilarity
+    >>> metric = CosineSimilarity(reduction='mean')
+    >>> v = np.array([[1.0, 2.0, 3.0]])
+    >>> metric.update(2.0 * v, v)  # cosine ignores magnitude
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "regression.mape.MeanAbsolutePercentageError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MeanAbsolutePercentageError
+    >>> metric = MeanAbsolutePercentageError()
+    >>> metric.update(np.array([1.0, 2.0, 4.0]), np.array([1.0, 2.0, 4.0]))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "image.metrics.UniversalImageQualityIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import UniversalImageQualityIndex
+    >>> rng = np.random.RandomState(0)
+    >>> img = rng.rand(1, 1, 16, 16).astype(np.float32)
+    >>> metric = UniversalImageQualityIndex()
+    >>> metric.update(img, img)
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    # ------------------------------------------------------------- collections
+    "collections.MetricCollection": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MetricCollection, MeanSquaredError, MeanAbsoluteError
+    >>> col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    >>> col.update(np.array([2.5, 0.0, 2.0, 8.0]), np.array([3.0, -0.5, 2.0, 7.0]))
+    >>> {k: round(float(v), 4) for k, v in sorted(col.compute().items())}
+    {'MeanAbsoluteError': 0.5, 'MeanSquaredError': 0.375}
+    """,
+}
+
+
+def attach_examples() -> None:
+    """Append each example to its class docstring (idempotent)."""
+    import importlib
+
+    for path, example in _EXAMPLES.items():
+        module_path, _, cls_name = path.rpartition(".")
+        module = importlib.import_module(f"torchmetrics_tpu.{module_path}")
+        cls = getattr(module, cls_name)
+        if cls.__doc__ and ">>>" in cls.__doc__:
+            continue
+        cls.__doc__ = (cls.__doc__ or "") + "\n\n    Example:" + example

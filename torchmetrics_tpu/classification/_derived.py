@@ -93,16 +93,52 @@ def make_stat_scores_family(
     # pickling works (pickle looks classes up by __module__ + __qualname__)
     caller_module = sys._getframe(1).f_globals.get("__name__", __name__)
     doc = f"Module metric (reference ``{reference}``)."
+    # perfect predictions give the family's best value analytically, so every
+    # derived class carries a runnable, doctest-enforced usage example
+    # (reference doctest discipline, Makefile:28-31; runner:
+    # tests/unittests/test_doctests.py)
+    perfect = "1.0" if higher_is_better else "0.0"
+    _EXAMPLES = {
+        "Binary": (
+            ">>> metric = Binary{name}()\n"
+            "    >>> metric.update(np.array([0, 1, 1, 0]), np.array([0, 1, 1, 0]))\n"
+        ),
+        "Multiclass": (
+            ">>> metric = Multiclass{name}(num_classes=3, average='macro')\n"
+            "    >>> metric.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 1]))\n"
+        ),
+        "Multilabel": (
+            ">>> metric = Multilabel{name}(num_labels=2)\n"
+            "    >>> metric.update(np.array([[1, 0], [0, 1]]), np.array([[1, 0], [0, 1]]))\n"
+        ),
+    }
     for klass, prefix in ((_Binary, "Binary"), (_Multiclass, "Multiclass"), (_Multilabel, "Multilabel")):
         klass.__name__ = f"{prefix}{name}"
         klass.__qualname__ = f"{prefix}{name}"
         klass.__module__ = caller_module
-        klass.__doc__ = doc
+        klass.__doc__ = (
+            f"{doc}\n\n"
+            "    Example:\n"
+            "    >>> import numpy as np\n"
+            f"    >>> from {caller_module} import {prefix}{name}\n"
+            f"    {_EXAMPLES[prefix].format(name=name)}"
+            "    >>> round(float(metric.compute()), 4)\n"
+            f"    {perfect}\n"
+        )
         klass.higher_is_better = higher_is_better
         klass.plot_lower_bound = plot_lower_bound
         klass.plot_upper_bound = plot_upper_bound
     _Wrapper.__name__ = name
     _Wrapper.__qualname__ = name
     _Wrapper.__module__ = caller_module
-    _Wrapper.__doc__ = f"Task-dispatching {name} (reference ``{reference}``)."
+    _Wrapper.__doc__ = (
+        f"Task-dispatching {name} (reference ``{reference}``).\n\n"
+        "    Example:\n"
+        "    >>> import numpy as np\n"
+        f"    >>> from {caller_module} import {name}\n"
+        f"    >>> metric = {name}(task='multiclass', num_classes=3, average='macro')\n"
+        "    >>> metric.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 1]))\n"
+        "    >>> round(float(metric.compute()), 4)\n"
+        f"    {perfect}\n"
+    )
     return _Binary, _Multiclass, _Multilabel, _Wrapper

@@ -415,22 +415,22 @@ def coco_mean_average_precision(
                     fps = ~dtm & ~dt_ig
                     tp_sum = np.cumsum(tps, axis=1).astype(np.float64)
                     fp_sum = np.cumsum(fps, axis=1).astype(np.float64)
+                    nd = tp_sum.shape[1]
+                    # all thresholds at once: the per-T python loop was the
+                    # host-side hot spot at val2017 scale (K·A·M·T ~ 10k
+                    # small-vector iterations)
+                    rc = tp_sum / npig  # (T, nd)
+                    pr = tp_sum / (fp_sum + tp_sum + eps)
+                    recall[:, ki, ai, mi] = rc[:, -1] if nd else 0
+                    # precision envelope: non-increasing from the right
+                    pr = np.maximum.accumulate(pr[:, ::-1], axis=1)[:, ::-1]
+                    precision[:, :, ki, ai, mi] = 0.0
+                    scores_tbl[:, :, ki, ai, mi] = 0.0
                     for ti in range(num_t):
-                        tp, fp = tp_sum[ti], fp_sum[ti]
-                        nd = len(tp)
-                        rc = tp / npig
-                        pr = tp / (fp + tp + eps)
-                        recall[ti, ki, ai, mi] = rc[-1] if nd else 0
-                        q = np.zeros(num_r)
-                        ss = np.zeros(num_r)
-                        # precision envelope: make pr non-increasing from the right
-                        pr = np.maximum.accumulate(pr[::-1])[::-1]
-                        inds = np.searchsorted(rc, rec_thrs, side="left")
+                        inds = np.searchsorted(rc[ti], rec_thrs, side="left")
                         valid_inds = inds < nd
-                        q[valid_inds] = pr[inds[valid_inds]]
-                        ss[valid_inds] = dt_scores_sorted[inds[valid_inds]]
-                        precision[ti, :, ki, ai, mi] = q
-                        scores_tbl[ti, :, ki, ai, mi] = ss
+                        precision[ti, valid_inds, ki, ai, mi] = pr[ti][inds[valid_inds]]
+                        scores_tbl[ti, valid_inds, ki, ai, mi] = dt_scores_sorted[inds[valid_inds]]
 
     def _summarize(ap: bool, iou_thr: Optional[float] = None, area: str = "all", mdet: int = maxdet_last) -> float:
         ai = list(DEFAULT_AREA_RANGES).index(area)

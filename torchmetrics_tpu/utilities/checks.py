@@ -31,20 +31,65 @@ def _check_same_shape(preds: Array, target: Array) -> None:
         )
 
 
+def _check_for_empty_tensors(preds: Array, target: Array) -> bool:
+    """True when BOTH inputs are empty (reference ``checks.py:33``)."""
+    return preds.size == 0 and target.size == 0
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop a singleton trailing/batch axis pair (reference ``checks.py:301``)."""
+    if preds.shape[0] == 1:
+        preds = jnp.expand_dims(preds.squeeze(), 0)
+        target = jnp.expand_dims(target.squeeze(), 0)
+    else:
+        preds, target = preds.squeeze(), target.squeeze()
+    return preds, target
+
+
+def is_overridden(method_name: str, instance: object, parent: type) -> bool:
+    """True when ``instance``'s class overrides ``parent.method_name``
+    (reference ``checks.py:739``)."""
+    instance_attr = getattr(type(instance), method_name, None)
+    parent_attr = getattr(parent, method_name, None)
+    if instance_attr is None or parent_attr is None:
+        return False
+    return instance_attr is not parent_attr
+
+
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Dtype/value checks shared by the retrieval input validators
+    (reference ``checks.py:587``): float preds, bool/int/float target,
+    binary target values unless explicitly allowed."""
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a array of floats")
+    if not (
+        jnp.issubdtype(target.dtype, jnp.integer)
+        or jnp.issubdtype(target.dtype, jnp.bool_)
+        or jnp.issubdtype(target.dtype, jnp.floating)
+    ):
+        raise ValueError("`target` must be a array of booleans, integers or floats")
+    if (
+        not allow_non_binary_target
+        and _is_concrete(target)
+        and target.size
+        and bool((target.max() > 1) | (target.min() < 0))
+    ):
+        # range semantics, not exact-{0,1}: the reference accepts fractional
+        # relevance in [0, 1] (checks.py:610)
+        raise ValueError("`target` must contain `binary` values")
+    dtype = jnp.float32 if not allow_non_binary_target else target.dtype
+    return preds.reshape(-1).astype(jnp.float32), target.reshape(-1).astype(dtype)
+
+
 def _check_retrieval_functional_inputs(
     preds: Array, target: Array, allow_non_binary_target: bool = False
 ) -> Tuple[Array, Array]:
     """Check and format retrieval inputs (reference ``checks.py:507``)."""
-    if preds.shape != target.shape or preds.ndim == 0:
+    if preds.shape != target.shape or preds.ndim == 0 or preds.size == 0:
         raise ValueError("`preds` and `target` must be non-empty and of the same shape")
-    if not jnp.issubdtype(preds.dtype, jnp.floating):
-        raise ValueError("`preds` must be a array of floats")
-    if not (jnp.issubdtype(target.dtype, jnp.integer) or jnp.issubdtype(target.dtype, jnp.bool_) or jnp.issubdtype(target.dtype, jnp.floating)):
-        raise ValueError("`target` must be a array of booleans, integers or floats")
-    if not allow_non_binary_target and _is_concrete(target) and bool(((target != 0) & (target != 1)).any()):
-        raise ValueError("`target` must contain `binary` values")
-    dtype = jnp.float32 if not allow_non_binary_target else target.dtype
-    return preds.reshape(-1).astype(jnp.float32), target.reshape(-1).astype(dtype)
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
 
 
 def _check_retrieval_inputs(
@@ -59,13 +104,12 @@ def _check_retrieval_inputs(
         valid = np.asarray(target) != ignore_index
         indexes, preds, target = (np.asarray(indexes)[valid], np.asarray(preds)[valid], np.asarray(target)[valid])
         indexes, preds, target = jnp.asarray(indexes), jnp.asarray(preds), jnp.asarray(target)
-    if not allow_non_binary_target and _is_concrete(target) and bool(((target != 0) & (target != 1)).any()):
-        raise ValueError("`target` must contain `binary` values")
-    return (
-        indexes.reshape(-1).astype(jnp.int32),
-        preds.reshape(-1).astype(jnp.float32),
-        target.reshape(-1).astype(jnp.float32 if not allow_non_binary_target else target.dtype),
-    )
+    # emptiness is checked AFTER ignore_index filtering (reference
+    # checks.py:575): an all-ignored batch must raise, not return empties
+    if preds.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and of the same shape")
+    preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+    return (indexes.reshape(-1).astype(jnp.int32), preds, target)
 
 
 def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
