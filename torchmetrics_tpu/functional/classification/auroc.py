@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve_padded,
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_format,
     _binary_precision_recall_curve_tensor_validation,
@@ -118,35 +119,32 @@ def _binary_auroc_compute(
 
 
 def _binary_auroc_exact_device(preds: Array, target: Array) -> Array:
-    """Exact (unbinned) AUROC on device via the rank statistic.
+    """Exact (unbinned) AUROC fully on device, static shapes.
 
-    AUROC equals the Mann-Whitney U statistic ``(Σ ranks⁺ - P(P+1)/2)/(P·N)``
-    with midranks for ties — a sort + two cumsums with static shapes, so the
-    exact mode runs at device speed for any N instead of the host-NumPy
-    unique-threshold path (the curve itself still needs dynamic compaction).
-    Targets masked negative (ignore_index sentinel) are excluded.
+    Trapezoid-integrates the PADDED unique-threshold curve from
+    ``_binary_clf_curve_padded`` (one shared kernel with exact AP and the
+    curve tuple): ``mask`` marks tie-group ends, the previous group-end
+    (tp, fp) pair comes from a shifted cumulative max, and the area is
+    ``Σ_g ½·(tp_g + tp_prev)·(fp_g − fp_prev) / (P·N)`` — equivalent to the
+    Mann-Whitney midrank statistic, jittable and grad-able. Entries with
+    ``target < 0`` (ignore sentinel / CatBuffer padding) carry zero weight.
+    f32 products bound exactness to P·N < 2^24-scale; matches the f32
+    precision class of the reference's torch curve path.
     """
     preds = preds.reshape(-1)
     target = target.reshape(-1)
-    valid = target >= 0
-    # push invalid entries to the end of the sort and zero their weight
-    order = jnp.argsort(jnp.where(valid, preds, jnp.inf))
-    p_sorted = preds[order]
-    t_sorted = jnp.where(valid[order], target[order], 0).astype(jnp.float32)
-    w_sorted = valid[order].astype(jnp.float32)
-    n = preds.shape[0]
-    # midranks: for each tie group, the average of its 1-based positions
-    pos = jnp.arange(1, n + 1, dtype=jnp.float32)
-    is_new = jnp.concatenate([jnp.ones(1, bool), p_sorted[1:] != p_sorted[:-1]])
-    group_id = jnp.cumsum(is_new) - 1
-    group_start = jax.ops.segment_max(jnp.where(is_new, pos, 0.0), group_id, num_segments=n)
-    group_end = jax.ops.segment_max(pos, group_id, num_segments=n)
-    midrank = ((group_start + group_end) / 2)[group_id]
-    n_pos = (t_sorted * w_sorted).sum()
-    n_neg = w_sorted.sum() - n_pos
-    rank_sum_pos = (midrank * t_sorted * w_sorted).sum()
-    u_stat = rank_sum_pos - n_pos * (n_pos + 1) / 2
-    return jnp.where((n_pos > 0) & (n_neg > 0), u_stat / jnp.maximum(n_pos * n_neg, 1.0), 0.0)
+    if preds.shape[0] == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    fps, tps, _, mask = _binary_clf_curve_padded(preds, target)
+    end_tps = jnp.where(mask, tps, 0)
+    end_fps = jnp.where(mask, fps, 0)
+    prev_tps = jnp.concatenate([jnp.zeros(1, tps.dtype), jax.lax.cummax(end_tps)[:-1]]).astype(jnp.float32)
+    prev_fps = jnp.concatenate([jnp.zeros(1, fps.dtype), jax.lax.cummax(end_fps)[:-1]]).astype(jnp.float32)
+    tps_f, fps_f = tps.astype(jnp.float32), fps.astype(jnp.float32)
+    area = jnp.where(mask, 0.5 * (tps_f + prev_tps) * (fps_f - prev_fps), 0.0).sum()
+    n_pos = tps[-1].astype(jnp.float32)
+    n_neg = fps[-1].astype(jnp.float32)
+    return jnp.where((n_pos > 0) & (n_neg > 0), area / jnp.maximum(n_pos * n_neg, 1.0), 0.0)
 
 
 def binary_auroc(
@@ -248,10 +246,9 @@ def _multilabel_auroc_compute(
     """Per-label AUROC + reduction (reference ``auroc.py:291-326``)."""
     if average == "micro":
         if thresholds is None and isinstance(state, tuple):
-            preds = np.asarray(state[0]).flatten()
-            target = np.asarray(state[1]).flatten()
-            keep = target >= 0
-            return _binary_auroc_compute((jnp.asarray(preds[keep]), jnp.asarray(target[keep])), thresholds, max_fpr=None)
+            # the flatten is static-shape; -1 entries carry zero weight in the
+            # rank-statistic kernel, so micro-exact stays fully on device
+            return _binary_auroc_exact_device(jnp.asarray(state[0]).reshape(-1), jnp.asarray(state[1]).reshape(-1))
         summed = state.sum(1)
         return _binary_auroc_compute(summed, thresholds, max_fpr=None)
     if thresholds is None and isinstance(state, tuple):

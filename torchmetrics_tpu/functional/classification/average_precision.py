@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve_padded,
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_compute,
     _binary_precision_recall_curve_format,
@@ -26,11 +27,40 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from torchmetrics_tpu.functional.classification.auroc import _reduce_auroc_values
 from torchmetrics_tpu.utilities.compute import _safe_divide
 from torchmetrics_tpu.utilities.enums import ClassificationTask
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
+
+
+def _binary_average_precision_exact_device(preds: Array, target: Array, pos_label: int = 1) -> Array:
+    """Exact (unbinned) average precision fully on device, static shapes.
+
+    Integrates AP = Σ_g ΔTP_g·P_g / n_pos over the PADDED unique-threshold
+    curve from ``_binary_clf_curve_padded`` (the reference computes the same
+    sum from the compacted curve, reference ``average_precision.py:72-80``
+    over ``precision_recall_curve.py:29-83``): ``mask`` marks tie-group
+    ends, per-group ΔTP comes from a shifted cumulative max over masked tp
+    counts, so no dynamic-shape compaction is needed and the whole thing is
+    one jittable, grad-able program (zero pred-gradient, matching the
+    reference's counts-based curve). Entries with ``target < 0`` (ignore
+    sentinel / CatBuffer padding) carry zero weight and sort to the end.
+    """
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    if preds.shape[0] == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    fps, tps, _, mask = _binary_clf_curve_padded(preds, target, pos_label)
+    # previous group-end tp count at each masked position (0 before the first)
+    end_tps = jnp.where(mask, tps, 0)
+    prev_end = jnp.concatenate([jnp.zeros(1, tps.dtype), jax.lax.cummax(end_tps)[:-1]])
+    delta_tp = jnp.where(mask, tps - prev_end, 0).astype(jnp.float32)
+    precision = _safe_divide(tps.astype(jnp.float32), (tps + fps).astype(jnp.float32))
+    n_pos = tps[-1].astype(jnp.float32)
+    ap = (delta_tp * precision).sum() / jnp.maximum(n_pos, 1.0)
+    return jnp.where(n_pos > 0, ap, 0.0)
 
 
 def _reduce_average_precision(
@@ -68,6 +98,9 @@ def _binary_average_precision_compute(
     pos_label: int = 1,
 ) -> Array:
     """Binary AP from the pr-curve (reference ``average_precision.py:72-80``)."""
+    if thresholds is None and isinstance(state, tuple):
+        # exact mode integrates over the padded curve fully on device
+        return _binary_average_precision_exact_device(jnp.asarray(state[0]), jnp.asarray(state[1]), pos_label)
     precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
     return -jnp.sum(jnp.diff(recall) * precision[:-1])
 
@@ -108,13 +141,20 @@ def _multiclass_average_precision_compute(
     thresholds: Optional[Array] = None,
 ) -> Array:
     """Per-class AP + reduction (reference ``average_precision.py:167-180``)."""
-    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
     if thresholds is None and isinstance(state, tuple):
-        target = np.asarray(state[1])
-        target = target[target >= 0]
-        weights = jnp.asarray(np.bincount(target, minlength=num_classes), dtype=jnp.float32)
-    else:
-        weights = state[0, :, 1, :].sum(-1).astype(jnp.float32)
+        # exact mode: one-vs-rest device AP per class, no host compaction
+        preds2d, target = jnp.asarray(state[0]), jnp.asarray(state[1])
+        valid = target >= 0
+
+        def per_class(c: Array) -> Array:
+            tgt = jnp.where(valid, (target == c).astype(jnp.int32), -1)
+            return _binary_average_precision_exact_device(jnp.take(preds2d, c, axis=1), tgt)
+
+        res = jax.vmap(per_class)(jnp.arange(num_classes))
+        weights = (jax.nn.one_hot(jnp.where(valid, target, 0), num_classes) * valid[:, None]).sum(0)
+        return _reduce_auroc_values(res, average, weights=weights.astype(jnp.float32))
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    weights = state[0, :, 1, :].sum(-1).astype(jnp.float32)
     return _reduce_average_precision(precision, recall, average, weights=weights)
 
 
@@ -149,17 +189,18 @@ def _multilabel_average_precision_compute(
     """Per-label AP + reduction (reference ``average_precision.py:265-293``)."""
     if average == "micro":
         if thresholds is None and isinstance(state, tuple):
-            preds = np.asarray(state[0]).flatten()
-            target = np.asarray(state[1]).flatten()
-            keep = target >= 0
-            return _binary_average_precision_compute((jnp.asarray(preds[keep]), jnp.asarray(target[keep])), thresholds)
+            # the flatten is static-shape; -1 entries carry zero weight on device
+            return _binary_average_precision_exact_device(
+                jnp.asarray(state[0]).reshape(-1), jnp.asarray(state[1]).reshape(-1)
+            )
         return _binary_average_precision_compute(state.sum(1), thresholds)
-    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
     if thresholds is None and isinstance(state, tuple):
-        target = np.asarray(state[1])
-        weights = jnp.asarray((target == 1).sum(0), dtype=jnp.float32)
-    else:
-        weights = state[0, :, 1, :].sum(-1).astype(jnp.float32)
+        preds2d, target2d = jnp.asarray(state[0]), jnp.asarray(state[1])
+        res = jax.vmap(_binary_average_precision_exact_device, in_axes=(1, 1))(preds2d, target2d)
+        weights = (target2d == 1).sum(0).astype(jnp.float32)
+        return _reduce_auroc_values(res, average, weights=weights)
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    weights = state[0, :, 1, :].sum(-1).astype(jnp.float32)
     return _reduce_average_precision(precision, recall, average, weights=weights)
 
 

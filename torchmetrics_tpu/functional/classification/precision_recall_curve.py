@@ -12,10 +12,17 @@ Capability parity with reference
   scatter-add bincount (the reference's vectorized path, ``:211-226``). No
   50k-sample crossover loop is needed: XLA tiles the (N, T) compare onto the
   VPU and the bincount onto a single scatter; memory stays at N*T int1.
-- **Exact mode** (``thresholds=None``) is inherently dynamic-shape
-  (sklearn-style unique-threshold curve, reference ``:29-83``) and runs on
-  host via NumPy at ``compute`` time — the states are the raw (preds, target)
-  streams, exactly like the reference's list-``cat`` states.
+- **Exact mode** (``thresholds=None``) runs the sklearn-style
+  unique-threshold curve (reference ``:29-83``) as a STATIC-SHAPE device
+  program: descending sort with invalid entries keyed to ``-inf``, int32
+  tp/fp cumulative sums, and a tie-group-end mask
+  (``_binary_clf_curve_padded``). Scalar reductions over the curve — exact
+  AUROC (rank statistic in ``auroc.py``) and exact average precision
+  (``_binary_average_precision_exact_device`` in ``average_precision.py``) —
+  integrate over the padded curve fully on device, jittable and grad-able.
+  Only the user-facing curve TUPLE needs dynamic-shape unique-threshold
+  compaction, which happens on host at presentation time
+  (``_binary_clf_curve_host`` = device padded program + boolean-index).
 - ``ignore_index`` is handled by masking into a trash bin — static shapes,
   jit-safe — instead of the reference's boolean-index filtering.
 """
@@ -47,22 +54,58 @@ def _adjust_threshold_arg(thresholds: Optional[Union[int, List[float], Array]] =
     return None
 
 
+def _binary_clf_curve_padded(
+    preds: Array, target: Array, pos_label: int = 1
+) -> Tuple[Array, Array, Array, Array]:
+    """Static-shape unique-threshold fps/tps curve on device (jittable).
+
+    The dynamic-shape half of the sklearn-style curve (reference ``:29-83``)
+    is only the final unique-threshold compaction; everything else — the
+    descending sort, validity masking, tp/fp cumulative sums, tie-group-end
+    detection — is static-shape and runs as one compiled program. Entries
+    with ``target < 0`` (the ignore sentinel) sort to the end via a ``-inf``
+    key and carry zero weight, so a ``CatBuffer``-padded state evaluates
+    without host round-trips.
+
+    Returns ``(fps, tps, thresholds, mask)``, each shape ``(N,)`` in
+    descending-threshold order. ``mask[i]`` is True iff position ``i``
+    survives unique-threshold compaction (last member of its pred tie group
+    among valid entries); scalar reductions (AUROC/AP) integrate over the
+    padded arrays directly using ``mask``, while the user-facing curve tuple
+    boolean-indexes on host.
+    """
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    valid = target >= 0
+    key = jnp.where(valid, preds, -jnp.inf)
+    order = jnp.argsort(-key)  # descending; invalid entries land at the end
+    k_sorted = key[order]
+    v_sorted = valid[order]
+    y_sorted = ((target[order] == pos_label) & v_sorted).astype(jnp.int32)
+    tps = jnp.cumsum(y_sorted)
+    fps = jnp.cumsum(v_sorted.astype(jnp.int32)) - tps
+    n = preds.shape[0]
+    nxt = jnp.concatenate([k_sorted[1:], jnp.full((1,), -jnp.inf, k_sorted.dtype)])
+    is_end = (k_sorted != nxt) | (jnp.arange(n) == n - 1)
+    return fps, tps, k_sorted, is_end & v_sorted
+
+
+_jitted_clf_curve_padded = jax.jit(_binary_clf_curve_padded, static_argnums=2)
+
+
 def _binary_clf_curve_host(
     preds: np.ndarray, target: np.ndarray, pos_label: int = 1
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Host (NumPy) unique-threshold fps/tps curve, sklearn-style
-    (reference ``:29-83``). Dynamic output shape => host-side only."""
-    preds = np.asarray(preds).reshape(-1)
-    target = np.asarray(target).reshape(-1)
-    order = np.argsort(-preds, kind="stable")
-    preds = preds[order]
-    target = target[order]
-    distinct_value_indices = np.nonzero(np.diff(preds))[0]
-    threshold_idxs = np.concatenate([distinct_value_indices, [target.size - 1]])
-    target_bin = (target == pos_label).astype(np.int64)
-    tps = np.cumsum(target_bin)[threshold_idxs]
-    fps = 1 + threshold_idxs - tps
-    return fps, tps, preds[threshold_idxs]
+    """Unique-threshold fps/tps curve: device padded program + host compaction.
+
+    Presentation-only — the sort and cumsums run compiled on device via
+    ``_binary_clf_curve_padded``; the host's only job is the dynamic-shape
+    boolean-index that drops tie-group-interior positions. Assumes inputs are
+    already filtered of ignored entries (callers pass ``target ∈ {0..C-1}``).
+    """
+    fps, tps, thres, mask = _jitted_clf_curve_padded(jnp.asarray(preds), jnp.asarray(target), pos_label)
+    m = np.asarray(mask)
+    return np.asarray(fps)[m], np.asarray(tps)[m], np.asarray(thres)[m]
 
 
 # ---------------------------------------------------------------------- binary

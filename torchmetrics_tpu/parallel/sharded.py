@@ -119,6 +119,13 @@ def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_nam
     """Reduce a per-device partial-state pytree across a mesh axis.
 
     Must be called inside ``shard_map``/``pmap`` with ``axis_name`` bound.
+
+    List ("cat") states require UNIFORM appends: under SPMD every device
+    traces the same program, so each device's list must hold the same number
+    of same-shaped tensors — that is what makes the per-append ``all_gather``
+    below well-defined. Calling this from a non-SPMD context where devices
+    appended different counts/shapes would silently miscombine; pad to a
+    common shape (see ``CatBuffer``) before reducing.
     """
     def gather_flat(v: Array) -> Array:
         return jax.lax.all_gather(v, axis_name).reshape((-1,) + tuple(v.shape[1:]))

@@ -16,14 +16,18 @@ import jax
 
 _CACHE: Dict[Tuple[int, str], Callable] = {}
 _PARAMS_ON_DEVICE: Dict[int, Tuple[Any, Any]] = {}  # id(obj) -> (source params, device copy)
-_FINALIZED: set = set()  # ids with a registered auto-evict finalizer
+_FINALIZERS: Dict[int, Any] = {}  # id(obj) -> weakref.finalize handle
 
 
 def _evict_id(obj_id: int) -> None:
     for key in [k for k in _CACHE if k[0] == obj_id]:
         del _CACHE[key]
     _PARAMS_ON_DEVICE.pop(obj_id, None)
-    _FINALIZED.discard(obj_id)
+    # detach the finalizer so a manual evict followed by a re-jit of the same
+    # live object doesn't accumulate duplicate (idempotent but untracked) ones
+    fin = _FINALIZERS.pop(obj_id, None)
+    if fin is not None:
+        fin.detach()
 
 
 def _device_params(obj: Any, params_attr: str) -> Any:
@@ -82,10 +86,9 @@ def jitted_forward(
                 return unbound(target, *args, params=params)
 
         fn = _CACHE[key] = jax.jit(inner)
-        if id(obj) not in _FINALIZED:
+        if id(obj) not in _FINALIZERS:
             try:
-                weakref.finalize(obj, _evict_id, id(obj))
-                _FINALIZED.add(id(obj))
+                _FINALIZERS[id(obj)] = weakref.finalize(obj, _evict_id, id(obj))
             except TypeError:
                 pass  # not weakref-able; manual evict() remains the relief
 
@@ -105,6 +108,8 @@ def evict(obj: Any = None) -> None:
     if obj is None:
         _CACHE.clear()
         _PARAMS_ON_DEVICE.clear()
-        _FINALIZED.clear()
+        for fin in _FINALIZERS.values():
+            fin.detach()
+        _FINALIZERS.clear()
         return
     _evict_id(id(obj))
