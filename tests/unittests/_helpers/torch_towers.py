@@ -234,6 +234,41 @@ _ALEX_TAPS = (1, 4, 7, 9, 11)
 _VGG_CONV_PLAN = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
 
 
+class _Fire(nn.Module):
+    """torchvision SqueezeNet Fire module (state_dict keys squeeze/expand1x1/expand3x3)."""
+
+    def __init__(self, in_ch, squeeze_ch, expand_ch):
+        super().__init__()
+        self.squeeze = nn.Conv2d(in_ch, squeeze_ch, 1)
+        self.expand1x1 = nn.Conv2d(squeeze_ch, expand_ch, 1)
+        self.expand3x3 = nn.Conv2d(squeeze_ch, expand_ch, 3, padding=1)
+
+    def forward(self, x):
+        x = torch.relu(self.squeeze(x))
+        return torch.cat([torch.relu(self.expand1x1(x)), torch.relu(self.expand3x3(x))], 1)
+
+
+def _squeeze_features():
+    # torchvision squeezenet1_1.features layout; taps follow the reference's
+    # 7-slice plan (reference functional/image/lpips.py:65-102)
+    layers = [
+        nn.Conv2d(3, 64, 3, 2),
+        nn.ReLU(),
+        nn.MaxPool2d(3, 2, ceil_mode=True),
+        _Fire(64, 16, 64),
+        _Fire(128, 16, 64),
+        nn.MaxPool2d(3, 2, ceil_mode=True),
+        _Fire(128, 32, 128),
+        _Fire(256, 32, 128),
+        nn.MaxPool2d(3, 2, ceil_mode=True),
+        _Fire(256, 48, 192),
+        _Fire(384, 48, 192),
+        _Fire(384, 64, 256),
+        _Fire(512, 64, 256),
+    ]
+    return layers, (1, 4, 7, 9, 10, 11, 12)
+
+
 def _vgg_features():
     layers, taps, in_ch = [], [], 3
     for stage, (width, convs) in enumerate(_VGG_CONV_PLAN):
@@ -260,10 +295,16 @@ class TorchLPIPS(nn.Module):
         torch.manual_seed(seed)
         if net_type == "alex":
             layers, self.taps = [f() for f in _ALEX_FEATURES], _ALEX_TAPS
+        elif net_type == "squeeze":
+            layers, self.taps = _squeeze_features()
         else:
             layers, self.taps = _vgg_features()
         self.trunk = nn.Sequential(*layers)
-        widths = {"alex": (64, 192, 384, 256, 256), "vgg": (64, 128, 256, 512, 512)}[net_type]
+        widths = {
+            "alex": (64, 192, 384, 256, 256),
+            "vgg": (64, 128, 256, 512, 512),
+            "squeeze": (64, 128, 256, 384, 384, 512, 512),
+        }[net_type]
         self.heads = nn.ParameterList(
             [nn.Parameter(torch.rand(1, c, 1, 1) * 0.1) for c in widths]
         )

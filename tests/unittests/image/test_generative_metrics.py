@@ -215,6 +215,29 @@ def test_lpips_zero_for_identical_and_positive_for_different():
         metric2.update(np.zeros((2, 1, 8, 8)), np.zeros((2, 1, 8, 8)))
 
 
+def test_lpips_builtin_heads_and_functional():
+    """Default construction loads the calibrated in-repo head weights for all
+    three net types, and the functional wrapper agrees with the module."""
+    from torchmetrics_tpu.functional.image import learned_perceptual_image_patch_similarity
+    from torchmetrics_tpu.image.lpip import _builtin_head_params
+
+    rng = _rng(13)
+    img1 = (rng.rand(2, 3, 35, 35).astype(np.float32) * 2) - 1  # odd dims hit ceil-mode pooling
+    img2 = np.clip(img1 + 0.3 * rng.randn(*img1.shape).astype(np.float32), -1, 1)
+    for net_type in ("alex", "vgg", "squeeze"):
+        heads = _builtin_head_params(net_type)
+        assert heads is not None and all(k.startswith("lin") for k in heads)
+        metric = LearnedPerceptualImagePatchSimilarity(net_type=net_type)
+        # the module's params must be the calibrated heads, not random init
+        np.testing.assert_array_equal(
+            np.asarray(metric.net_params["params"]["lin0"]["kernel"]), np.asarray(heads["lin0"]["kernel"])
+        )
+        metric.update(img1, img2)
+        mod_val = float(metric.compute())
+        fn_val = float(learned_perceptual_image_patch_similarity(img1, img2, net_type=net_type))
+        np.testing.assert_allclose(fn_val, mod_val, rtol=1e-5, atol=1e-6)
+
+
 def test_perceptual_path_length_with_dummy_generator():
     import jax
 

@@ -22,6 +22,7 @@ Everything here is offline: random weights, local checkpoints, no hub access.
 Agreement on random weights + layout-exact converters implies the calibrated
 checkpoints load correctly too (same code path, same shapes).
 """
+import os
 import sys
 from pathlib import Path
 
@@ -323,7 +324,7 @@ def test_inception_converter_chain_parity(tmp_path):
 # ------------------------------------------------ LPIPS: converter-chain parity
 
 
-@pytest.mark.parametrize("net_type", ["alex", "vgg"])
+@pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
 def test_lpips_converter_chain_parity(net_type, tmp_path):
     """Torch LPIPS (torchvision-layout trunk + richzhang-layout heads) ->
     convert_lpips_weights -> Flax LPIPS matches per-pair scores."""
@@ -341,6 +342,52 @@ def test_lpips_converter_chain_parity(net_type, tmp_path):
     rng = np.random.default_rng(0)
     img1 = (rng.random((2, 3, 64, 64), dtype=np.float32) * 2 - 1)
     img2 = (rng.random((2, 3, 64, 64), dtype=np.float32) * 2 - 1)
+    metric.update(jnp.asarray(img1), jnp.asarray(img2))
+    ours = float(metric.compute())
+    with torch.no_grad():
+        ref = float(tmodel(torch.tensor(img1), torch.tensor(img2)).mean())
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+def test_lpips_real_head_weights_parity(net_type):
+    """The CALIBRATED linear heads shipped in-repo (converted from the
+    reference's own ``functional/image/lpips_models/{net}.pth`` artifacts)
+    load by default and reproduce the reference head projection: both sides
+    share one random trunk, ours loads the committed npz, torch loads the
+    actual ``.pth``, and per-pair scores must match."""
+    from convert_lpips_weights import convert_lpips_params
+
+    from tests.unittests._helpers.torch_towers import TorchLPIPS
+    from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+    from torchmetrics_tpu.image.lpip import _builtin_head_params
+
+    pth = f"/root/reference/src/torchmetrics/functional/image/lpips_models/{net_type}.pth"
+    if not os.path.exists(pth):
+        pytest.skip("reference checkpoint not available")
+    real_heads = {k: v for k, v in torch.load(pth, map_location="cpu").items()}
+
+    tmodel = TorchLPIPS(net_type=net_type, seed=3).eval()
+    with torch.no_grad():
+        for i, p in enumerate(tmodel.heads):
+            p.copy_(real_heads[f"lin{i}.model.1.weight"])
+    trunk_state = {k: v.numpy() for k, v in tmodel.trunk.state_dict().items()}
+
+    # our side: same trunk via the converter, heads from the COMMITTED npz
+    builtin = _builtin_head_params(net_type)
+    assert builtin is not None, "committed lpips_heads npz missing"
+    tree = convert_lpips_params(net_type, trunk_state, {k: v.numpy() for k, v in real_heads.items()})
+    for i in range(len(builtin)):
+        np.testing.assert_array_equal(
+            np.asarray(builtin[f"lin{i}"]["kernel"]), tree["params"][f"lin{i}"]["kernel"],
+            err_msg="committed npz drifted from the reference .pth",
+        )
+    tree["params"].update(builtin)
+
+    metric = LearnedPerceptualImagePatchSimilarity(net_type=net_type, net_params=tree)
+    rng = np.random.default_rng(11)
+    img1 = rng.random((2, 3, 64, 64), dtype=np.float32) * 2 - 1
+    img2 = rng.random((2, 3, 64, 64), dtype=np.float32) * 2 - 1
     metric.update(jnp.asarray(img1), jnp.asarray(img2))
     ours = float(metric.compute())
     with torch.no_grad():

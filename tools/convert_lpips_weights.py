@@ -31,27 +31,49 @@ _TRUNK_CONV_INDICES = {
     "alex": {0: "conv1", 3: "conv2", 6: "conv3", 8: "conv4", 10: "conv5"},
     "vgg": {i: f"conv{n}" for n, i in enumerate((0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28))},
 }
-_NUM_HEADS = 5
+# squeezenet1_1: one stem conv + Fire modules (squeeze/expand1x1/expand3x3)
+_SQUEEZE_FIRE_INDICES = (3, 4, 6, 7, 9, 10, 11, 12)
+_HEAD_COUNT = {"alex": 5, "vgg": 5, "squeeze": 7}
+
+
+def _conv_entry(state: Mapping[str, np.ndarray], key: str) -> Dict[str, np.ndarray]:
+    weight = np.asarray(state[f"{key}.weight"], np.float32)  # OIHW
+    bias = np.asarray(state[f"{key}.bias"], np.float32)
+    return {"kernel": weight.transpose(2, 3, 1, 0), "bias": bias}  # HWIO
+
+
+def convert_lpips_heads(net_type: str, heads_state: Mapping[str, np.ndarray]) -> Dict[str, Dict]:
+    """Convert the richzhang linear heads alone (``lin{i}.model.1.weight``,
+    shape ``(1, C, 1, 1)``) to Flax ``lin{i}`` 1x1-conv kernels."""
+    if net_type not in _HEAD_COUNT:
+        raise ValueError(f"net_type must be one of {sorted(_HEAD_COUNT)}, got {net_type}")
+    heads: Dict[str, Dict] = {}
+    for i in range(_HEAD_COUNT[net_type]):
+        key = f"lin{i}.model.1.weight"
+        if key not in heads_state:  # some exports drop the Sequential wrapper
+            key = f"lin{i}.weight"
+        weight = np.asarray(heads_state[key], np.float32)  # (1, C, 1, 1)
+        heads[f"lin{i}"] = {"kernel": weight.transpose(2, 3, 1, 0)}  # (1, 1, C, 1)
+    return heads
 
 
 def convert_lpips_params(
     net_type: str, trunk_state: Mapping[str, np.ndarray], heads_state: Mapping[str, np.ndarray]
 ) -> Dict:
     """Build the Flax params tree for ``_LPIPSNet`` from torch-layout arrays."""
-    if net_type not in _TRUNK_CONV_INDICES:
-        raise ValueError(f"net_type must be one of {sorted(_TRUNK_CONV_INDICES)}, got {net_type}")
+    if net_type not in _HEAD_COUNT:
+        raise ValueError(f"net_type must be one of {sorted(_HEAD_COUNT)}, got {net_type}")
     trunk: Dict[str, Dict[str, np.ndarray]] = {}
-    for idx, name in _TRUNK_CONV_INDICES[net_type].items():
-        weight = np.asarray(trunk_state[f"{idx}.weight"], np.float32)  # OIHW
-        bias = np.asarray(trunk_state[f"{idx}.bias"], np.float32)
-        trunk[name] = {"kernel": weight.transpose(2, 3, 1, 0), "bias": bias}  # HWIO
-    params: Dict[str, Dict] = {"trunk": trunk}
-    for i in range(_NUM_HEADS):
-        key = f"lin{i}.model.1.weight"
-        if key not in heads_state:  # some exports drop the Sequential wrapper
-            key = f"lin{i}.weight"
-        weight = np.asarray(heads_state[key], np.float32)  # (1, C, 1, 1)
-        params[f"lin{i}"] = {"kernel": weight.transpose(2, 3, 1, 0)}  # (1, 1, C, 1)
+    if net_type == "squeeze":
+        trunk["conv0"] = _conv_entry(trunk_state, "0")
+        for idx in _SQUEEZE_FIRE_INDICES:
+            trunk[f"fire{idx}_squeeze"] = _conv_entry(trunk_state, f"{idx}.squeeze")
+            trunk[f"fire{idx}_e1"] = _conv_entry(trunk_state, f"{idx}.expand1x1")
+            trunk[f"fire{idx}_e3"] = _conv_entry(trunk_state, f"{idx}.expand3x3")
+    else:
+        for idx, name in _TRUNK_CONV_INDICES[net_type].items():
+            trunk[name] = _conv_entry(trunk_state, str(idx))
+    params: Dict[str, Dict] = {"trunk": trunk, **convert_lpips_heads(net_type, heads_state)}
     return {"params": params}
 
 

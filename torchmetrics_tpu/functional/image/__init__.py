@@ -19,6 +19,7 @@ from torchmetrics_tpu.functional.image.metrics import (
     universal_image_quality_index,
     visual_information_fidelity,
 )
+from torchmetrics_tpu.image.lpip import learned_perceptual_image_patch_similarity
 from torchmetrics_tpu.image.perceptual_path_length import perceptual_path_length
 from torchmetrics_tpu.functional.image.ssim import (
     multiscale_structural_similarity_index_measure,
@@ -28,6 +29,7 @@ from torchmetrics_tpu.functional.image.ssim import (
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
+    "learned_perceptual_image_patch_similarity",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "perceptual_path_length",

@@ -4,16 +4,22 @@
 the vendored richzhang/PerceptualSimilarity port at
 ``functional/image/lpips.py:15-50``).
 
-Structure: a Flax feature trunk (AlexNet or VGG16 feature stages), per-layer
-unit-normalization, squared differences projected through 1×1 linear heads,
-spatial averaging, summed over layers — the published LPIPS pipeline. Weights
-for the trunk and the linear heads load from a ``.npz`` (converted offline
-from the published checkpoints); without them the trunk is deterministically
+Structure: a Flax feature trunk (AlexNet, VGG16, or SqueezeNet1_1 feature
+stages), per-layer unit-normalization, squared differences projected through
+1×1 linear heads, spatial averaging, summed over layers — the published LPIPS
+pipeline. The CALIBRATED linear-head weights ship with this repo
+(``image/weights/lpips_heads_{alex,vgg,squeeze}.npz``, converted from the
+reference's in-repo ``functional/image/lpips_models/*.pth`` via
+``tools/convert_lpips_weights.py``) and load by default. The trunk weights
+are torchvision-gated: convert them offline with the same tool
+(``alexnet(weights=...).features.state_dict()`` etc.) and pass the full tree
+as ``net_params``; without them the trunk is deterministically
 random-initialized, which exercises shapes/throughput but not the calibrated
 scores.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -24,6 +30,8 @@ import numpy as np
 from torchmetrics_tpu.metric import Metric
 
 Array = jax.Array
+
+_WEIGHTS_DIR = os.path.join(os.path.dirname(__file__), "weights")
 
 # ImageNet normalization used by LPIPS's scaling layer
 _SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
@@ -70,7 +78,61 @@ class _VGG16Trunk(nn.Module):
         return taps
 
 
-_TRUNKS = {"alex": (_AlexTrunk, (64, 192, 384, 256, 256)), "vgg": (_VGG16Trunk, (64, 128, 256, 512, 512))}
+def _max_pool_ceil(x: Array, k: int, s: int) -> Array:
+    """Torch ``MaxPool2d(ceil_mode=True)`` on NHWC: pad right/bottom with
+    ``-inf`` so windows may overhang the edge (max over the valid part)."""
+    h, w = x.shape[1], x.shape[2]
+    pad_h = (-(-(h - k) // s)) * s + k - h
+    pad_w = (-(-(w - k) // s)) * s + k - w
+    if pad_h or pad_w:
+        x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)), constant_values=-jnp.inf)
+    return nn.max_pool(x, (k, k), (s, s))
+
+
+class _SqueezeTrunk(nn.Module):
+    """SqueezeNet1_1 feature stages (7 taps, reference
+    ``functional/image/lpips.py:65-102`` slice plan), NHWC."""
+
+    # (torchvision features index, squeeze_ch, expand_ch) per Fire module
+    _FIRES = ((3, 16, 64), (4, 16, 64), (6, 32, 128), (7, 32, 128), (9, 48, 192), (10, 48, 192), (11, 64, 256), (12, 64, 256))
+
+    def _fire(self, x: Array, idx: int, squeeze_ch: int, expand_ch: int) -> Array:
+        s = nn.relu(nn.Conv(squeeze_ch, (1, 1), name=f"fire{idx}_squeeze")(x))
+        e1 = nn.relu(nn.Conv(expand_ch, (1, 1), name=f"fire{idx}_e1")(s))
+        e3 = nn.relu(nn.Conv(expand_ch, (3, 3), padding=[(1, 1), (1, 1)], name=f"fire{idx}_e3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        fires = dict((i, (sq, ex)) for i, sq, ex in self._FIRES)
+        taps = []
+        x = nn.relu(nn.Conv(64, (3, 3), (2, 2), padding="VALID", name="conv0")(x))
+        taps.append(x)
+        x = _max_pool_ceil(x, 3, 2)
+        x = self._fire(x, 3, *fires[3])
+        x = self._fire(x, 4, *fires[4])
+        taps.append(x)
+        x = _max_pool_ceil(x, 3, 2)
+        x = self._fire(x, 6, *fires[6])
+        x = self._fire(x, 7, *fires[7])
+        taps.append(x)
+        x = _max_pool_ceil(x, 3, 2)
+        x = self._fire(x, 9, *fires[9])
+        taps.append(x)
+        x = self._fire(x, 10, *fires[10])
+        taps.append(x)
+        x = self._fire(x, 11, *fires[11])
+        taps.append(x)
+        x = self._fire(x, 12, *fires[12])
+        taps.append(x)
+        return taps
+
+
+_TRUNKS = {
+    "alex": (_AlexTrunk, (64, 192, 384, 256, 256)),
+    "vgg": (_VGG16Trunk, (64, 128, 256, 512, 512)),
+    "squeeze": (_SqueezeTrunk, (64, 128, 256, 384, 384, 512, 512)),
+}
 
 
 class _LPIPSNet(nn.Module):
@@ -102,6 +164,53 @@ class _LPIPSNet(nn.Module):
         return total
 
 
+def _validate_lpips_inputs(img1: Array, img2: Array, normalize: bool) -> None:
+    """Shape/layout and value-range checks shared by the module and the
+    functional entry point (reference ``functional/image/lpips.py:352-366``).
+    Range checks only run on concrete values — jit-traced calls skip them."""
+    if img1.ndim != 4 or img2.ndim != 4 or img1.shape[1] != 3 or img2.shape[1] != 3:
+        raise ValueError(
+            f"Expected both inputs to be 4d tensors with 3 channels in the NCHW format,"
+            f" but got {img1.shape} and {img2.shape}"
+        )
+    if isinstance(img1, jax.core.Tracer) or isinstance(img2, jax.core.Tracer):
+        return
+    lo, hi = (0.0, 1.0) if normalize else (-1.0, 1.0)
+    for img in (img1, img2):
+        if bool(jnp.min(img) < lo) or bool(jnp.max(img) > hi):
+            raise ValueError(
+                f"Expected both input arguments to be normalized tensors with values in the range [{lo}, {hi}]."
+                f" Found values outside this range - set `normalize=True` if inputs are in [0, 1]."
+            )
+
+
+def _builtin_head_params(net_type: str) -> Optional[Dict[str, Dict[str, Array]]]:
+    """The calibrated richzhang linear heads shipped in-repo (converted from
+    the reference's ``functional/image/lpips_models/{net}.pth``)."""
+    path = os.path.join(_WEIGHTS_DIR, f"lpips_heads_{net_type}.npz")
+    if not os.path.exists(path):
+        return None
+    heads: Dict[str, Dict[str, Array]] = {}
+    with np.load(path) as data:
+        for key in data.files:  # "lin{i}/kernel"
+            lin, leaf = key.split("/")
+            heads.setdefault(lin, {})[leaf] = jnp.asarray(data[key])
+    return heads
+
+
+def _init_lpips_params(net: "_LPIPSNet", net_type: str) -> dict:
+    """Deterministic trunk init + the shipped calibrated heads."""
+    dummy = jnp.zeros((1, 16, 16, 3), jnp.float32)
+    params = jax.tree_util.tree_map(lambda x: x, dict(net.init(jax.random.PRNGKey(0), dummy, dummy, False)))
+    heads = _builtin_head_params(net_type)
+    if heads is not None:
+        inner = dict(params["params"])
+        for lin, tree in heads.items():
+            inner[lin] = tree
+        params["params"] = inner
+    return params
+
+
 class LearnedPerceptualImagePatchSimilarity(Metric):
     """LPIPS (reference ``image/lpip.py:30-165``).
 
@@ -122,7 +231,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        valid_net_type = ("vgg", "alex")
+        valid_net_type = tuple(_TRUNKS)
         if net_type not in valid_net_type:
             raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
         valid_reduction = ("mean", "sum")
@@ -136,8 +245,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
 
         self.net = _LPIPSNet(net_type=net_type)
         if net_params is None:
-            dummy = jnp.zeros((1, 16, 16, 3), jnp.float32)
-            net_params = self.net.init(jax.random.PRNGKey(0), dummy, dummy, False)
+            net_params = _init_lpips_params(self.net, net_type)
         self.net_params = net_params
         self._apply_fn = jax.jit(
             lambda params, a, b: self.net.apply(params, a, b, self.normalize), static_argnums=()
@@ -149,12 +257,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     def update(self, img1: Array, img2: Array) -> None:
         """Fold per-pair LPIPS distances (reference ``lpip.py:139-145``)."""
         img1, img2 = jnp.asarray(img1), jnp.asarray(img2)
-        if img1.ndim != 4 or img2.ndim != 4 or img1.shape[1] != 3 or img2.shape[1] != 3:
-            raise ValueError(
-                f"Expected both inputs to be 4d tensors with 3 channels in the NCHW format,"
-                f" but got {img1.shape} and {img2.shape}"
-            )
-        rng_ok = (img1.min() >= -1 and img1.max() <= 1) if not self.normalize else (img1.min() >= 0 and img1.max() <= 1)
+        _validate_lpips_inputs(img1, img2, self.normalize)
         img1 = jnp.transpose(img1, (0, 2, 3, 1))
         img2 = jnp.transpose(img2, (0, 2, 3, 1))
         loss = self._apply_fn(self.net_params, img1.astype(jnp.float32), img2.astype(jnp.float32))
@@ -168,3 +271,42 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
 
     def plot(self, val=None, ax=None):
         return self._plot(val, ax)
+
+
+# per-net caches: default params and the jitted apply (params enter as jit
+# arguments, so one compiled program serves any weight tree of that net_type)
+_FUNCTIONAL_PARAMS: Dict[str, dict] = {}
+_FUNCTIONAL_APPLY: Dict[str, Callable] = {}
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net_type: str = "alex",
+    reduction: str = "mean",
+    normalize: bool = False,
+    net_params: Optional[dict] = None,
+) -> Array:
+    """Functional LPIPS (reference ``functional/image/lpips.py:394-444``).
+
+    Inputs NCHW in ``[-1, 1]`` (or ``[0, 1]`` with ``normalize=True``). Uses
+    the shipped calibrated heads; pass ``net_params`` for calibrated trunk
+    weights (see ``tools/convert_lpips_weights.py``).
+    """
+    if net_type not in _TRUNKS:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(_TRUNKS)}, but got {net_type}.")
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum'), but got {reduction}")
+    img1, img2 = jnp.asarray(img1), jnp.asarray(img2)
+    _validate_lpips_inputs(img1, img2, normalize)
+    if net_type not in _FUNCTIONAL_APPLY:
+        net = _LPIPSNet(net_type=net_type)
+        _FUNCTIONAL_APPLY[net_type] = jax.jit(net.apply, static_argnums=3)
+    if net_params is None:
+        if net_type not in _FUNCTIONAL_PARAMS:
+            _FUNCTIONAL_PARAMS[net_type] = _init_lpips_params(_LPIPSNet(net_type=net_type), net_type)
+        net_params = _FUNCTIONAL_PARAMS[net_type]
+    img1 = jnp.transpose(img1, (0, 2, 3, 1)).astype(jnp.float32)
+    img2 = jnp.transpose(img2, (0, 2, 3, 1)).astype(jnp.float32)
+    loss = _FUNCTIONAL_APPLY[net_type](net_params, img1, img2, normalize)
+    return loss.mean() if reduction == "mean" else loss.sum()
