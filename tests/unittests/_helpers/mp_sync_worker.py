@@ -1,0 +1,114 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Worker executed by ``tests/unittests/bases/test_multiprocess_sync.py``.
+
+Runs under a REAL 2-process ``jax.distributed`` group (localhost CPU) — the
+analogue of the reference's 2-process Gloo pool
+(reference ``tests/unittests/conftest.py:26-68``) — and exercises every
+multi-host replica-sync code path with actual cross-process collectives:
+
+- sum-state reduction across processes (``Metric.sync``)
+- cat-state gather with UNEVEN per-process sizes (pad/trim protocol,
+  ``utilities/distributed.py:gather_all_arrays``)
+- an empty-rank cat state (zero-row contribution)
+- object (bytes) gather for RLE-tuple payloads
+  (``utilities/distributed.py:_gather_objects_via_bytes``)
+- ``sync_context`` round-trip: compute under sync, local state restored after
+
+Each check asserts the synced value equals the single-process result on the
+concatenated data (both ranks hold the full dataset; each updates with its
+slice). Exits non-zero on any mismatch; the parent test checks exit codes.
+
+Usage: ``python mp_sync_worker.py <process_id> <num_processes> <coord_addr>``
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # before any backend use (axon!)
+
+
+def main() -> None:
+    pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, f"process_count={jax.process_count()}"
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryAveragePrecision
+    from torchmetrics_tpu.utilities.distributed import (
+        _gather_objects_via_bytes,
+        gather_all_arrays,
+        gather_all_objects,
+    )
+
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 48
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    # uneven split: rank0 gets 37 rows, rank1 gets 11
+    bounds = [0, 37, n_total]
+    lo, hi = bounds[pid], bounds[pid + 1]
+
+    # single-process expected values: compute with distribution disabled
+    def expected(metric_cls, p, t):
+        m = metric_cls(distributed_available_fn=lambda: False)
+        if len(p):
+            m.update(p, t)
+        return float(m.compute())
+
+    # 1) sum states: BinaryAccuracy (tp/fp/... scalars, dist_reduce_fx="sum");
+    # compute() auto-syncs across the process group (reference metric.py:306)
+    acc = BinaryAccuracy()
+    acc.update(preds[lo:hi], target[lo:hi])
+    got = float(acc.compute())
+    want = expected(BinaryAccuracy, preds, target)
+    assert abs(got - want) < 1e-6, f"sum-state sync: {got} != {want}"
+
+    # 2) cat states, uneven shards: exact-mode average precision
+    ap = BinaryAveragePrecision()
+    ap.update(preds[lo:hi], target[lo:hi])
+    got = float(ap.compute())
+    want = expected(BinaryAveragePrecision, preds, target)
+    assert abs(got - want) < 1e-6, f"cat-state sync: {got} != {want}"
+    # explicit sync/unsync round-trip restores the LOCAL shard state
+    ap.sync()
+    n_synced = sum(int(v.shape[0]) for v in ap.preds) if isinstance(ap.preds, list) else int(ap.preds.shape[0])
+    assert n_synced == n_total, f"synced cat state holds {n_synced} rows != {n_total}"
+    ap.unsync()
+    n_local = sum(int(v.shape[0]) for v in ap.preds) if isinstance(ap.preds, list) else int(ap.preds.shape[0])
+    assert n_local == hi - lo, f"unsync restore: {n_local} rows != {hi - lo}"
+
+    # 3) empty rank: rank 1 contributes an EMPTY update (the reference's
+    # empty-tensor DDP case, test_ddp.py:34-49 — a rank with NO update at all
+    # short-circuits compute() before the collective, there as here)
+    ap2 = BinaryAveragePrecision()
+    cut = 20 if pid == 0 else 0
+    ap2.update(preds[:cut], target[:cut])
+    got = float(ap2.compute())
+    want = expected(BinaryAveragePrecision, preds[:20], target[:20])
+    assert abs(got - want) < 1e-6, f"empty-rank sync: {got} != {want}"
+
+    # 4) uneven-shape array gather (pad/trim protocol)
+    local_arr = jnp.arange(3 + 4 * pid, dtype=jnp.float32).reshape(1, -1) + 10 * pid
+    gathered = gather_all_arrays(local_arr)
+    assert len(gathered) == nproc
+    assert gathered[0].shape == (1, 3) and gathered[1].shape == (1, 7), [g.shape for g in gathered]
+    np.testing.assert_allclose(np.asarray(gathered[1]), np.arange(7, dtype=np.float32).reshape(1, -1) + 10)
+
+    # 5) object gather: RLE-style tuples with size-dependent payloads
+    rle = {"size": [7 + pid, 9], "counts": bytes(range(5 + 3 * pid))}
+    objs = gather_all_objects([rle, pid])
+    assert len(objs) == nproc and objs[pid][1] == pid, objs
+    assert objs[1][0]["size"] == [8, 9] and len(objs[1][0]["counts"]) == 8, objs
+    objs2 = _gather_objects_via_bytes(("payload", pid, b"x" * (1 + 100 * pid)))
+    assert len(objs2) == nproc and objs2[1][2] == b"x" * 101, objs2
+
+    print(f"rank {pid}: all multi-process sync checks passed")
+
+
+if __name__ == "__main__":
+    main()

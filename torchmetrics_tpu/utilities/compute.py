@@ -109,6 +109,8 @@ def normalize_logits_if_needed(tensor: Array, normalization: str = "sigmoid") ->
     — a data-dependent branch. Under jit we compute both and select, which XLA
     fuses into a single elementwise kernel.
     """
+    if tensor.size == 0:  # empty update (e.g. a data-less rank) — nothing to normalize
+        return tensor
     if normalization == "sigmoid":
         in_range = (tensor.min() >= 0) & (tensor.max() <= 1)
         return jnp.where(in_range, tensor, jax.nn.sigmoid(tensor))
