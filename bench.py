@@ -332,8 +332,8 @@ def main() -> None:
                         "max": round(max(wruns), 1),
                         "vs_torch_cpu": round(_median(wruns) / baseline, 2) if baseline else None,
                     }
-                    if name == "fid50k" and flops:
-                        # MFU of the whole feature pass vs v5e-1 bf16 peak
+                    if name in ("fid50k", "bertscore") and flops:
+                        # MFU of the whole pass vs v5e-1 bf16 peak
                         entry["mfu_pct"] = round(
                             100.0 * flops / (res["elapsed_s"] * V5E1_PEAK_BF16_FLOPS), 2
                         )

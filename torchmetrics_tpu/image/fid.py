@@ -41,7 +41,10 @@ class FrechetInceptionDistance(Metric):
 
     ``feature`` is a tap dimension of the built-in Flax InceptionV3 or any
     callable mapping an image batch to ``(N, d)`` features (the reference
-    accepts an ``nn.Module`` the same way).
+    accepts an ``nn.Module`` the same way). ``tower_dtype`` sets the
+    Inception conv compute dtype: ``None`` picks bf16 on TPU (2x MXU rate;
+    drift vs f32 pinned <=1e-3 by the dtype suite) and f32 elsewhere — pass
+    ``jnp.float32`` to force the f32 tower everywhere.
     """
 
     is_differentiable = False
@@ -57,6 +60,7 @@ class FrechetInceptionDistance(Metric):
         normalize: bool = False,
         input_img_size: Any = None,
         feature_extractor_params: Optional[dict] = None,
+        tower_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -67,7 +71,7 @@ class FrechetInceptionDistance(Metric):
                     f"Integer input to argument `feature` must be one of {_ALLOWED_FEATURE_DIMS}, but got {feature}."
                 )
             num_features = feature
-            self.inception = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+            self.inception = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params, dtype=tower_dtype)
         elif callable(feature):
             self.inception = feature
             self.used_custom_model = True

@@ -32,6 +32,7 @@ class InceptionScore(Metric):
         splits: int = 10,
         normalize: bool = False,
         feature_extractor_params: Optional[dict] = None,
+        tower_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -47,7 +48,7 @@ class InceptionScore(Metric):
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
                 )
-            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params, dtype=tower_dtype)
         elif callable(feature):
             self.inception = feature
             self.used_custom_model = True

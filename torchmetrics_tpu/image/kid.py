@@ -66,12 +66,13 @@ class KernelInceptionDistance(Metric):
         normalize: bool = False,
         seed: int = 42,
         feature_extractor_params: Optional[dict] = None,
+        tower_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.used_custom_model = False
         if isinstance(feature, int):
-            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params, dtype=tower_dtype)
         elif callable(feature):
             self.inception = feature
             self.used_custom_model = True

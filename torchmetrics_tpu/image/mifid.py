@@ -53,6 +53,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
         feature_extractor_params: Optional[dict] = None,
+        tower_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -62,7 +63,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {_ALLOWED_FEATURE_DIMS}, but got {feature}."
                 )
-            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params)
+            self.inception: Callable = InceptionFeatureExtractor((str(feature),), params=feature_extractor_params, dtype=tower_dtype)
         elif callable(feature):
             self.inception = feature
             self.used_custom_model = True
