@@ -116,6 +116,25 @@ def test_map_empty_inputs():
     assert float(res["map"]) == -1.0
 
 
+def test_map_class_with_gts_but_no_dets_contributes_zero_recall():
+    # r4 device-accumulate regression: a class with ground truths but ZERO
+    # detections anywhere must contribute recall 0 (pycocotools 'rc[-1] if nd
+    # else 0'), not drop out of the mean via the segment_max identity
+    target = [
+        {
+            "boxes": np.array([[0.0, 0.0, 40.0, 40.0], [100.0, 100.0, 160.0, 160.0]]),
+            "labels": np.array([1, 2]),
+        }
+    ]
+    preds = [
+        {"boxes": np.array([[0.0, 0.0, 40.0, 40.0]]), "scores": np.array([0.9]), "labels": np.array([1])}
+    ]
+    res = coco_mean_average_precision(preds, target, class_metrics=True)
+    np.testing.assert_allclose(float(res["mar_100"]), 0.5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res["mar_100_per_class"]), [1.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(res["map_per_class"]), [1.0, 0.0], atol=1e-6)
+
+
 def test_map_missed_gt_halves_recall():
     # one gt detected perfectly, one not detected at all
     target = [
