@@ -123,9 +123,14 @@ def _psnrb_update(preds: Array, target: Array, block_size: int = 8) -> Tuple[Arr
 
 
 def _psnrb_compute(sum_squared_error: Array, bef: Array, num_obs: Array, data_range: Array) -> Array:
-    """PSNR with blocking-effect correction (reference ``psnrb.py:49-67``)."""
+    """PSNR with blocking-effect correction (reference ``psnrb.py:68-86``).
+
+    Reference quirk kept for parity: a peak of 1.0 is assumed unless the
+    data range exceeds 2 (i.e. [0,1]-ish images ignore the measured range).
+    """
     sum_squared_error = sum_squared_error / num_obs + bef
-    return 10 * jnp.log10(data_range**2 / sum_squared_error)
+    peak_sq = jnp.where(data_range > 2, data_range**2, 1.0)
+    return 10 * jnp.log10(peak_sq / sum_squared_error)
 
 
 def peak_signal_noise_ratio_with_blocked_effect(preds: Array, target: Array, block_size: int = 8) -> Array:
