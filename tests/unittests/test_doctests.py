@@ -48,8 +48,11 @@ def test_module_doctests(module_name):
 
 def test_doctest_example_count_grows():
     """Keep a floor under the number of executable docstring examples so the
-    doctest surface only grows (round-3 start: 0; target: every public
-    class)."""
+    doctest surface only grows (round-3 floor: 60; round-4: ~200 after the
+    generated per-class table). The classes still without examples are the
+    tower-weight metrics (FID/KID/BERTScore/CLIP families — their usage is
+    exercised by tower_parity), host-dep-gated audio metrics, bootstrap
+    wrappers, and abstract bases."""
     total = 0
     finder = doctest.DocTestFinder(exclude_empty=True)
     for module_name in _MODULES:
@@ -58,4 +61,27 @@ def test_doctest_example_count_grows():
         except Exception:
             continue
         total += sum(1 for t in finder.find(module, module_name) if t.examples)
-    assert total >= 60, f"only {total} docstring examples found"
+    assert total >= 190, f"only {total} docstring examples found"
+
+
+def test_most_public_classes_carry_examples():
+    """Per-class coverage gate: at least 200 of the ~224 public Metric
+    classes must carry a runnable docstring example (matches the reference's
+    example-per-class discipline, reference ``Makefile:28-31``)."""
+    import inspect
+
+    from torchmetrics_tpu.metric import Metric
+
+    subs = (
+        "classification", "clustering", "nominal", "detection", "segmentation", "image",
+        "audio", "text", "retrieval", "regression", "wrappers", "aggregation", "multimodal", "",
+    )
+    seen, have = set(), 0
+    for sub in subs:
+        module = importlib.import_module(f"torchmetrics_tpu.{sub}" if sub else "torchmetrics_tpu")
+        for name in getattr(module, "__all__", []):
+            obj = getattr(module, name, None)
+            if inspect.isclass(obj) and issubclass(obj, Metric) and name not in seen:
+                seen.add(name)
+                have += bool(obj.__doc__ and ">>>" in obj.__doc__)
+    assert have >= 200, f"only {have}/{len(seen)} public classes carry a docstring example"

@@ -315,6 +315,95 @@ _EXAMPLES = {
     >>> round(float(metric.compute()), 4)
     1.0
     """,
+    "classification.f_beta.BinaryF1Score": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryF1Score
+    >>> metric = BinaryF1Score()
+    >>> metric.update(np.array([0.2, 0.8, 0.7, 0.3]), np.array([0, 1, 1, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.8
+    """,
+    "classification.jaccard.BinaryJaccardIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryJaccardIndex
+    >>> metric = BinaryJaccardIndex()
+    >>> metric.update(np.array([0.2, 0.8, 0.7, 0.3]), np.array([0, 1, 1, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+    """,
+    "classification.stat_scores.BinaryStatScores": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryStatScores
+    >>> metric = BinaryStatScores()
+    >>> metric.update(np.array([0.2, 0.8, 0.7, 0.3]), np.array([0, 1, 1, 1]))
+    >>> np.asarray(metric.compute()).tolist()  # [tp, fp, tn, fn, support]
+    [2, 0, 1, 1, 3]
+    """,
+    "classification.stat_scores.MulticlassStatScores": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassStatScores
+    >>> metric = MulticlassStatScores(num_classes=3, average=None)
+    >>> metric.update(np.array([0, 1, 2, 1]), np.array([0, 1, 2, 2]))
+    >>> np.asarray(metric.compute()).tolist()
+    [[1, 0, 3, 0, 1], [1, 1, 2, 0, 1], [1, 0, 2, 1, 2]]
+    """,
+    "detection.mean_ap.MeanAveragePrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import MeanAveragePrecision
+    >>> metric = MeanAveragePrecision()
+    >>> metric.update(
+    ...     [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]]), "scores": np.array([0.9]), "labels": np.array([0])}],
+    ...     [{"boxes": np.array([[0.0, 0.0, 10.0, 10.0]]), "labels": np.array([0])}],
+    ... )
+    >>> round(float(metric.compute()["map"]), 4)
+    1.0
+    """,
+    "wrappers.minmax.MinMaxMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MinMaxMetric, MeanSquaredError
+    >>> metric = MinMaxMetric(MeanSquaredError())
+    >>> metric.update(np.array([1.0, 2.0]), np.array([1.0, 3.0]))
+    >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+    {'max': 0.5, 'min': 0.5, 'raw': 0.5}
+    """,
+    "wrappers.multioutput.MultioutputWrapper": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MultioutputWrapper, MeanSquaredError
+    >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    >>> metric.update(np.array([[1.0, 2.0], [2.0, 4.0]]), np.array([[1.0, 3.0], [2.0, 3.0]]))
+    >>> [round(float(v), 4) for v in np.asarray(metric.compute()).ravel()]
+    [0.0, 1.0]
+    """,
+    "wrappers.classwise.ClasswiseWrapper": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import ClasswiseWrapper
+    >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+    >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=2, average=None))
+    >>> metric.update(np.array([0, 1, 1]), np.array([0, 1, 0]))
+    >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+    {'multiclassaccuracy_0': 0.5, 'multiclassaccuracy_1': 1.0}
+    """,
+    "wrappers.multitask.MultitaskWrapper": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MultitaskWrapper, MeanSquaredError, MeanAbsoluteError
+    >>> metric = MultitaskWrapper({"mse": MeanSquaredError(), "mae": MeanAbsoluteError()})
+    >>> metric.update(
+    ...     {"mse": np.array([1.0, 2.0]), "mae": np.array([1.0, 2.0])},
+    ...     {"mse": np.array([1.0, 4.0]), "mae": np.array([1.0, 4.0])},
+    ... )
+    >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+    {'mae': 1.0, 'mse': 2.0}
+    """,
+    "audio.metrics.PermutationInvariantTraining": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import PermutationInvariantTraining
+    >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+    >>> rng = np.random.RandomState(42)
+    >>> metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio)
+    >>> metric.update(rng.randn(2, 2, 64).astype(np.float32), rng.randn(2, 2, 64).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    -14.4344
+    """,
     # ------------------------------------------------------------- collections
     "collections.MetricCollection": """
     >>> import numpy as np
@@ -328,11 +417,19 @@ _EXAMPLES = {
 
 
 def attach_examples() -> None:
-    """Append each example to its class docstring (idempotent)."""
+    """Append each example to its class docstring (idempotent).
+
+    Two tables feed one loop: the manual ``_EXAMPLES`` above (keys are
+    ``module.path.ClassName``) and the generated per-class table from
+    ``tools/gen_doctest_examples.py`` (keys are ``subpackage:ClassName``).
+    """
     import importlib
 
-    for path, example in _EXAMPLES.items():
-        module_path, _, cls_name = path.rpartition(".")
+    from torchmetrics_tpu._examples_generated import _GENERATED
+
+    pairs = [(*path.rpartition(".")[::2], example) for path, example in _EXAMPLES.items()]
+    pairs += [(*key.partition(":")[::2], example) for key, example in _GENERATED.items()]
+    for module_path, cls_name, example in pairs:
         module = importlib.import_module(f"torchmetrics_tpu.{module_path}")
         cls = getattr(module, cls_name)
         if cls.__doc__ and ">>>" in cls.__doc__:

@@ -1,0 +1,1174 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""GENERATED doctest examples (tools/gen_doctest_examples.py) — one per
+public class without a manual/factory example. Values are regression
+pins from this framework; reference-correctness is established by the
+differential parity suites."""
+
+_GENERATED = {
+    "classification:AUROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import AUROC
+    >>> metric = AUROC(task='binary')
+    >>> metric.update(np.array([0.1, 0.8, 0.3, 0.7, 0.4, 0.2], np.float32), np.array([0, 1, 0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.7778
+    """,
+    "clustering:AdjustedMutualInfoScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import AdjustedMutualInfoScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = AdjustedMutualInfoScore()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    -0.0202
+    """,
+    "classification:AveragePrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import AveragePrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = AveragePrecision(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.7857
+    """,
+    "classification:BinaryAveragePrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryAveragePrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryAveragePrecision()
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.7857
+    """,
+    "classification:BinaryCalibrationError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryCalibrationError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryCalibrationError()
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.57
+    """,
+    "classification:BinaryConfusionMatrix": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryConfusionMatrix
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryConfusionMatrix()
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> [round(float(v), 4) for v in np.asarray(metric.compute()).reshape(-1)]
+    [0.0, 1.0, 4.0, 5.0]
+    """,
+    "classification:BinaryFairness": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryFairness
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryFairness(num_groups=2)
+    >>> metric.update(rng.randint(0, 2, 12), rng.randint(0, 2, 12), rng.randint(0, 2, 12))
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'DP_0_1': 0.0, 'EO_0_1': 0.0}
+    """,
+    "classification:BinaryGroupStatRates": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryGroupStatRates
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryGroupStatRates(num_groups=2)
+    >>> metric.update(rng.randint(0, 2, 12), rng.randint(0, 2, 12), rng.randint(0, 2, 12))
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'group_0': [0.0, 0.0, 0.3333, 0.6667], 'group_1': [0.1111, 0.2222, 0.2222, 0.4444]}
+    """,
+    "classification:BinaryHingeLoss": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryHingeLoss
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryHingeLoss()
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.67
+    """,
+    "classification:BinaryPrecisionAtFixedRecall": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryPrecisionAtFixedRecall
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryPrecisionAtFixedRecall(min_recall=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "classification:BinaryPrecisionRecallCurve": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryPrecisionRecallCurve(thresholds=5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((6,), (6,), (5,))
+    """,
+    "classification:BinaryROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryROC(thresholds=5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5,), (5,), (5,))
+    """,
+    "classification:BinaryRecallAtFixedPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryRecallAtFixedPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "classification:BinarySensitivityAtSpecificity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinarySensitivityAtSpecificity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinarySensitivityAtSpecificity(min_specificity=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "classification:BinarySpecificityAtSensitivity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinarySpecificityAtSensitivity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "text:CHRFScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import CHRFScore
+    >>> metric = CHRFScore()
+    >>> metric.update(["the squirrel eats the nut"], [["the squirrel is eating the nut"]])
+    >>> round(float(metric.compute()), 4)
+    0.5833
+    """,
+    "classification:CalibrationError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import CalibrationError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = CalibrationError(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.57
+    """,
+    "clustering:CalinskiHarabaszScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import CalinskiHarabaszScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = CalinskiHarabaszScore()
+    >>> metric.update(rng.randn(12, 3).astype(np.float32), rng.randint(0, 2, 12))
+    >>> round(float(metric.compute()), 4)
+    0.9886
+    """,
+    "classification:CohenKappa": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import CohenKappa
+    >>> rng = np.random.RandomState(42)
+    >>> metric = CohenKappa(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    -0.1905
+    """,
+    "detection:CompleteIntersectionOverUnion": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import CompleteIntersectionOverUnion
+    >>> metric = CompleteIntersectionOverUnion()
+    >>> metric.update([{'boxes': np.array([[0.0, 0.0, 10.0, 10.0]]), 'scores': np.array([0.9]), 'labels': np.array([0])}], [{'boxes': np.array([[0.0, 0.0, 10.0, 12.0]]), 'labels': np.array([0])}])
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'ciou': 0.8292}
+    """,
+    "clustering:CompletenessScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import CompletenessScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = CompletenessScore()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.1535
+    """,
+    "audio:ComplexScaleInvariantSignalNoiseRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import ComplexScaleInvariantSignalNoiseRatio
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ComplexScaleInvariantSignalNoiseRatio()
+    >>> metric.update(rng.randn(2, 8, 16, 2).astype(np.float32), rng.randn(2, 8, 16, 2).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    -23.8308
+    """,
+    "regression:ConcordanceCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import ConcordanceCorrCoef
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ConcordanceCorrCoef()
+    >>> metric.update(rng.randn(10).astype(np.float32), rng.randn(10).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    -0.0459
+    """,
+    "classification:ConfusionMatrix": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import ConfusionMatrix
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ConfusionMatrix(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> [round(float(v), 4) for v in np.asarray(metric.compute()).reshape(-1)]
+    [0.0, 1.0, 4.0, 5.0]
+    """,
+    "nominal:CramersV": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.nominal import CramersV
+    >>> rng = np.random.RandomState(42)
+    >>> metric = CramersV(num_classes=3)
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "regression:CriticalSuccessIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import CriticalSuccessIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = CriticalSuccessIndex(threshold=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32) + 0.5, rng.rand(10).astype(np.float32) + 0.5)
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "clustering:DaviesBouldinScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import DaviesBouldinScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = DaviesBouldinScore()
+    >>> metric.update(rng.randn(12, 3).astype(np.float32), rng.randint(0, 2, 12))
+    >>> round(float(metric.compute()), 4)
+    1.3477
+    """,
+    "classification:Dice": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import Dice
+    >>> rng = np.random.RandomState(42)
+    >>> metric = Dice(num_classes=5, average='micro')
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "detection:DistanceIntersectionOverUnion": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import DistanceIntersectionOverUnion
+    >>> metric = DistanceIntersectionOverUnion()
+    >>> metric.update([{'boxes': np.array([[0.0, 0.0, 10.0, 10.0]]), 'scores': np.array([0.9]), 'labels': np.array([0])}], [{'boxes': np.array([[0.0, 0.0, 10.0, 12.0]]), 'labels': np.array([0])}])
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'diou': 0.8292}
+    """,
+    "clustering:DunnIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import DunnIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = DunnIndex()
+    >>> metric.update(rng.randn(12, 3).astype(np.float32), rng.randint(0, 2, 12))
+    >>> round(float(metric.compute()), 4)
+    0.5471
+    """,
+    "image:ErrorRelativeGlobalDimensionlessSynthesis": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import ErrorRelativeGlobalDimensionlessSynthesis
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ErrorRelativeGlobalDimensionlessSynthesis()
+    >>> metric.update(rng.rand(2, 3, 16, 16).astype(np.float32) + 0.1, rng.rand(2, 3, 16, 16).astype(np.float32) + 0.1)
+    >>> round(float(metric.compute()), 4)
+    17.5301
+    """,
+    "classification:ExactMatch": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import ExactMatch
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ExactMatch(task='multiclass', num_classes=5)
+    >>> metric.update(rng.randint(0, 5, (4, 6)), rng.randint(0, 5, (4, 6)))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "text:ExtendedEditDistance": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import ExtendedEditDistance
+    >>> metric = ExtendedEditDistance()
+    >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+    >>> round(float(metric.compute()), 4)
+    0.1452
+    """,
+    "classification:F1Score": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import F1Score
+    >>> rng = np.random.RandomState(42)
+    >>> metric = F1Score(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+    """,
+    "classification:FBetaScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import FBetaScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = FBetaScore(task='binary', beta=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.7576
+    """,
+    "nominal:FleissKappa": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.nominal import FleissKappa
+    >>> rng = np.random.RandomState(42)
+    >>> metric = FleissKappa(mode='counts')
+    >>> metric.update(rng.multinomial(10, [0.25] * 4, size=6))
+    >>> round(float(metric.compute()), 4)
+    0.0299
+    """,
+    "clustering:FowlkesMallowsIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import FowlkesMallowsIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = FowlkesMallowsIndex()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.3117
+    """,
+    "segmentation:GeneralizedDiceScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.segmentation import GeneralizedDiceScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = GeneralizedDiceScore(num_classes=3, input_format='index')
+    >>> metric.update(rng.randint(0, 3, (2, 8, 8)), rng.randint(0, 3, (2, 8, 8)))
+    >>> round(float(metric.compute()), 4)
+    0.426
+    """,
+    "detection:GeneralizedIntersectionOverUnion": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import GeneralizedIntersectionOverUnion
+    >>> metric = GeneralizedIntersectionOverUnion()
+    >>> metric.update([{'boxes': np.array([[0.0, 0.0, 10.0, 10.0]]), 'scores': np.array([0.9]), 'labels': np.array([0])}], [{'boxes': np.array([[0.0, 0.0, 10.0, 12.0]]), 'labels': np.array([0])}])
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'giou': 0.8333}
+    """,
+    "classification:HingeLoss": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import HingeLoss
+    >>> rng = np.random.RandomState(42)
+    >>> metric = HingeLoss(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.67
+    """,
+    "clustering:HomogeneityScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import HomogeneityScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = HomogeneityScore()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.1356
+    """,
+    "detection:IntersectionOverUnion": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+    >>> metric = IntersectionOverUnion()
+    >>> metric.update([{'boxes': np.array([[0.0, 0.0, 10.0, 10.0]]), 'scores': np.array([0.9]), 'labels': np.array([0])}], [{'boxes': np.array([[0.0, 0.0, 10.0, 12.0]]), 'labels': np.array([0])}])
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'iou': 0.8333}
+    """,
+    "classification:JaccardIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import JaccardIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = JaccardIndex(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    0.5
+    """,
+    "regression:KLDivergence": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import KLDivergence
+    >>> rng = np.random.RandomState(42)
+    >>> metric = KLDivergence()
+    >>> metric.update((lambda p: p / p.sum(1, keepdims=True))(rng.rand(4, 5).astype(np.float32) + 0.1), (lambda p: p / p.sum(1, keepdims=True))(rng.rand(4, 5).astype(np.float32) + 0.1))
+    >>> round(float(metric.compute()), 4)
+    0.4772
+    """,
+    "regression:KendallRankCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import KendallRankCorrCoef
+    >>> rng = np.random.RandomState(42)
+    >>> metric = KendallRankCorrCoef()
+    >>> metric.update(rng.randn(10).astype(np.float32), rng.randn(10).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.1556
+    """,
+    "regression:LogCoshError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import LogCoshError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = LogCoshError()
+    >>> metric.update(rng.randn(10).astype(np.float32), rng.randn(10).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.7559
+    """,
+    "text:MatchErrorRate": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import MatchErrorRate
+    >>> metric = MatchErrorRate()
+    >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+    >>> round(float(metric.compute()), 4)
+    0.1667
+    """,
+    "classification:MatthewsCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MatthewsCorrCoef
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MatthewsCorrCoef(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> round(float(metric.compute()), 4)
+    -0.2722
+    """,
+    "regression:MeanSquaredLogError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import MeanSquaredLogError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MeanSquaredLogError()
+    >>> metric.update(rng.rand(10).astype(np.float32) + 0.5, rng.rand(10).astype(np.float32) + 0.5)
+    >>> round(float(metric.compute()), 4)
+    0.0184
+    """,
+    "regression:MinkowskiDistance": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import MinkowskiDistance
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MinkowskiDistance(p=3)
+    >>> metric.update(rng.randn(10).astype(np.float32), rng.randn(10).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    4.1208
+    """,
+    "detection:ModifiedPanopticQuality": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import ModifiedPanopticQuality
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ModifiedPanopticQuality(things={0, 1}, stuffs={2}, allow_unknown_preds_category=True)
+    >>> metric.update(rng.randint(0, 3, (1, 8, 8, 2)), rng.randint(0, 3, (1, 8, 8, 2)))
+    >>> round(float(metric.compute()), 4)
+    0.1176
+    """,
+    "image:MultiScaleStructuralSimilarityIndexMeasure": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0, kernel_size=3, betas=(0.3, 0.7))
+    >>> metric.update(rng.rand(1, 3, 48, 48).astype(np.float32), rng.rand(1, 3, 48, 48).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.0197
+    """,
+    "classification:MulticlassAUROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassAUROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassAUROC(num_classes=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    0.6367
+    """,
+    "classification:MulticlassAveragePrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassAveragePrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassAveragePrecision(num_classes=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    0.4352
+    """,
+    "classification:MulticlassCalibrationError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassCalibrationError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassCalibrationError(num_classes=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    0.8103
+    """,
+    "classification:MulticlassCohenKappa": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassCohenKappa
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassCohenKappa(num_classes=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    -0.1852
+    """,
+    "classification:MulticlassFBetaScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassFBetaScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassFBetaScore(num_classes=5, beta=2.0)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "classification:MulticlassHingeLoss": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassHingeLoss
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassHingeLoss(num_classes=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    1.2926
+    """,
+    "classification:MulticlassMatthewsCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassMatthewsCorrCoef
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassMatthewsCorrCoef(num_classes=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> round(float(metric.compute()), 4)
+    -0.2128
+    """,
+    "classification:MulticlassPrecisionAtFixedRecall": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassPrecisionAtFixedRecall
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassPrecisionAtFixedRecall(num_classes=5, min_recall=0.5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5,), (5,))
+    """,
+    "classification:MulticlassPrecisionRecallCurve": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassPrecisionRecallCurve(num_classes=5, thresholds=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5, 6), (5, 6), (5,))
+    """,
+    "classification:MulticlassROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassROC(num_classes=5, thresholds=5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5, 5), (5, 5), (5,))
+    """,
+    "classification:MulticlassRecallAtFixedPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassRecallAtFixedPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassRecallAtFixedPrecision(num_classes=5, min_precision=0.5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5,), (5,))
+    """,
+    "classification:MulticlassSensitivityAtSpecificity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassSensitivityAtSpecificity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassSensitivityAtSpecificity(num_classes=5, min_specificity=0.5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5,), (5,))
+    """,
+    "classification:MulticlassSpecificityAtSensitivity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MulticlassSpecificityAtSensitivity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MulticlassSpecificityAtSensitivity(num_classes=5, min_sensitivity=0.5)
+    >>> metric.update(rng.rand(8, 5).astype(np.float32), rng.randint(0, 5, 8))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5,), (5,))
+    """,
+    "classification:MultilabelAUROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelAUROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelAUROC(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.5458
+    """,
+    "classification:MultilabelAveragePrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelAveragePrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelAveragePrecision(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.6543
+    """,
+    "classification:MultilabelConfusionMatrix": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelConfusionMatrix
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelConfusionMatrix(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> [round(float(v), 4) for v in np.asarray(metric.compute()).reshape(-1)]
+    [2.0, 2.0, 3.0, 1.0, 5.0, 0.0, 1.0, 2.0, 1.0, 2.0, 2.0, 3.0]
+    """,
+    "classification:MultilabelCoverageError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelCoverageError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelCoverageError(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    1.75
+    """,
+    "classification:MultilabelExactMatch": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelExactMatch
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelExactMatch(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.25
+    """,
+    "classification:MultilabelF1Score": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelF1Score
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelF1Score(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.5619
+    """,
+    "classification:MultilabelFBetaScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelFBetaScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelFBetaScore(num_labels=3, beta=2.0)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.5258
+    """,
+    "classification:MultilabelJaccardIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelJaccardIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelJaccardIndex(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.4206
+    """,
+    "classification:MultilabelMatthewsCorrCoef": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelMatthewsCorrCoef
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelMatthewsCorrCoef(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.169
+    """,
+    "classification:MultilabelPrecisionAtFixedRecall": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelPrecisionAtFixedRecall
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelPrecisionAtFixedRecall(num_labels=3, min_recall=0.5)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((3,), (3,))
+    """,
+    "classification:MultilabelPrecisionRecallCurve": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelPrecisionRecallCurve
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelPrecisionRecallCurve(num_labels=3, thresholds=5)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((3, 6), (3, 6), (5,))
+    """,
+    "classification:MultilabelROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelROC(num_labels=3, thresholds=5)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((3, 5), (3, 5), (5,))
+    """,
+    "classification:MultilabelRankingAveragePrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelRankingAveragePrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.9583
+    """,
+    "classification:MultilabelRankingLoss": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelRankingLoss
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelRankingLoss(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> round(float(metric.compute()), 4)
+    0.125
+    """,
+    "classification:MultilabelRecallAtFixedPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelRecallAtFixedPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.5)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((3,), (3,))
+    """,
+    "classification:MultilabelSensitivityAtSpecificity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelSensitivityAtSpecificity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelSensitivityAtSpecificity(num_labels=3, min_specificity=0.5)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((3,), (3,))
+    """,
+    "classification:MultilabelSpecificityAtSensitivity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelSpecificityAtSensitivity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelSpecificityAtSensitivity(num_labels=3, min_sensitivity=0.5)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((3,), (3,))
+    """,
+    "classification:MultilabelStatScores": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelStatScores
+    >>> rng = np.random.RandomState(42)
+    >>> metric = MultilabelStatScores(num_labels=3)
+    >>> metric.update(rng.rand(8, 3).astype(np.float32), rng.randint(0, 2, (8, 3)))
+    >>> [round(float(v), 4) for v in np.asarray(metric.compute()).reshape(-1)]
+    [2.0, 1.3333, 2.6667, 2.0, 4.0]
+    """,
+    "clustering:NormalizedMutualInfoScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import NormalizedMutualInfoScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = NormalizedMutualInfoScore()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.144
+    """,
+    "detection:PanopticQuality": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import PanopticQuality
+    >>> rng = np.random.RandomState(42)
+    >>> metric = PanopticQuality(things={0, 1}, stuffs={2}, allow_unknown_preds_category=True)
+    >>> metric.update(rng.randint(0, 3, (1, 8, 8, 2)), rng.randint(0, 3, (1, 8, 8, 2)))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "image:PeakSignalNoiseRatioWithBlockedEffect": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect
+    >>> rng = np.random.RandomState(42)
+    >>> metric = PeakSignalNoiseRatioWithBlockedEffect()
+    >>> metric.update(rng.rand(1, 1, 16, 16).astype(np.float32), rng.rand(1, 1, 16, 16).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    7.0466
+    """,
+    "nominal:PearsonsContingencyCoefficient": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.nominal import PearsonsContingencyCoefficient
+    >>> rng = np.random.RandomState(42)
+    >>> metric = PearsonsContingencyCoefficient(num_classes=3)
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.4395
+    """,
+    "text:Perplexity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import Perplexity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = Perplexity()
+    >>> metric.update(rng.randn(2, 6, 8).astype(np.float32), rng.randint(0, 8, (2, 6)))
+    >>> round(float(metric.compute()), 4)
+    11.8709
+    """,
+    "classification:PrecisionAtFixedRecall": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import PrecisionAtFixedRecall
+    >>> rng = np.random.RandomState(42)
+    >>> metric = PrecisionAtFixedRecall(task='binary', min_recall=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "classification:PrecisionRecallCurve": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import PrecisionRecallCurve
+    >>> rng = np.random.RandomState(42)
+    >>> metric = PrecisionRecallCurve(task='binary', thresholds=5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((6,), (6,), (5,))
+    """,
+    "image:QualityWithNoReference": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import QualityWithNoReference
+    >>> rng = np.random.RandomState(42)
+    >>> metric = QualityWithNoReference()
+    >>> metric.update(rng.rand(2, 3, 32, 32).astype(np.float32), {'ms': rng.rand(2, 3, 16, 16).astype(np.float32), 'pan': rng.rand(2, 3, 32, 32).astype(np.float32), 'pan_lr': rng.rand(2, 3, 16, 16).astype(np.float32)})
+    >>> round(float(metric.compute()), 4)
+    0.8921
+    """,
+    "classification:ROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import ROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ROC(task='binary', thresholds=5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((5,), (5,), (5,))
+    """,
+    "text:ROUGEScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import ROUGEScore
+    >>> metric = ROUGEScore()
+    >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'rouge1_fmeasure': 0.8333, 'rouge1_precision': 0.8333, 'rouge1_recall': 0.8333, 'rouge2_fmeasure': 0.6, 'rouge2_precision': 0.6, 'rouge2_recall': 0.6, 'rougeL_fmeasure': 0.8333, 'rougeL_precision': 0.8333, 'rougeL_recall': 0.8333, 'rougeLsum_fmeasure': 0.8333, 'rougeLsum_precision': 0.8333, 'rougeLsum_recall': 0.8333}
+    """,
+    "clustering:RandScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import RandScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RandScore()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.5167
+    """,
+    "classification:RecallAtFixedPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import RecallAtFixedPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RecallAtFixedPrecision(task='binary', min_precision=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "image:RelativeAverageSpectralError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import RelativeAverageSpectralError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RelativeAverageSpectralError()
+    >>> metric.update(rng.rand(2, 3, 16, 16).astype(np.float32) + 0.1, rng.rand(2, 3, 16, 16).astype(np.float32) + 0.1)
+    >>> round(float(metric.compute()), 4)
+    4352.2803
+    """,
+    "regression:RelativeSquaredError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import RelativeSquaredError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RelativeSquaredError()
+    >>> metric.update(rng.randn(10).astype(np.float32), rng.randn(10).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    5.1162
+    """,
+    "retrieval:RetrievalAUROC": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalAUROC
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalAUROC()
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+    """,
+    "retrieval:RetrievalFallOut": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalFallOut
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalFallOut(top_k=2)
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "retrieval:RetrievalHitRate": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalHitRate
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalHitRate(top_k=2)
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "retrieval:RetrievalMRR": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalMRR()
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "retrieval:RetrievalPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalPrecision(top_k=2)
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "retrieval:RetrievalPrecisionRecallCurve": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalPrecisionRecallCurve(max_k=4)
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((4,), (4,), (4,))
+    """,
+    "retrieval:RetrievalRPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalRPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalRPrecision()
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+    """,
+    "retrieval:RetrievalRecall": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalRecall
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalRecall(top_k=2)
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+    """,
+    "retrieval:RetrievalRecallAtFixedPrecision": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=4)
+    >>> metric.update(rng.rand(8).astype(np.float32), rng.randint(0, 2, 8), np.repeat(np.arange(2), 4))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "image:RootMeanSquaredErrorUsingSlidingWindow": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import RootMeanSquaredErrorUsingSlidingWindow
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RootMeanSquaredErrorUsingSlidingWindow(window_size=4)
+    >>> metric.update(rng.rand(2, 3, 16, 16).astype(np.float32), rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.4068
+    """,
+    "aggregation:RunningMean": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.aggregation import RunningMean
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RunningMean(window=2)
+    >>> metric.update(rng.randn(6).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.3435
+    """,
+    "aggregation:RunningSum": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.aggregation import RunningSum
+    >>> rng = np.random.RandomState(42)
+    >>> metric = RunningSum(window=2)
+    >>> metric.update(rng.randn(6).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    2.0609
+    """,
+    "text:SQuAD": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import SQuAD
+    >>> metric = SQuAD()
+    >>> metric.update([{'prediction_text': 'paris', 'id': 'q1'}], [{'answers': {'answer_start': [0], 'text': ['paris']}, 'id': 'q1'}])
+    >>> {k: np.round(np.asarray(v, np.float64), 4).tolist() for k, v in sorted(metric.compute().items())}
+    {'exact_match': 100.0, 'f1': 100.0}
+    """,
+    "text:SacreBLEUScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import SacreBLEUScore
+    >>> metric = SacreBLEUScore()
+    >>> metric.update(["the squirrel eats the nut"], [["the squirrel is eating the nut"]])
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "audio:ScaleInvariantSignalNoiseRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+    >>> rng = np.random.RandomState(42)
+    >>> metric = ScaleInvariantSignalNoiseRatio()
+    >>> metric.update(rng.randn(2, 128).astype(np.float32), rng.randn(2, 128).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    -28.3682
+    """,
+    "classification:SensitivityAtSpecificity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import SensitivityAtSpecificity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SensitivityAtSpecificity(task='binary', min_specificity=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "audio:SignalDistortionRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import SignalDistortionRatio
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SignalDistortionRatio()
+    >>> metric.update(rng.randn(2, 256).astype(np.float64), rng.randn(2, 256).astype(np.float64))
+    >>> round(float(metric.compute()), 4)
+    nan
+    """,
+    "audio:SourceAggregatedSignalDistortionRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SourceAggregatedSignalDistortionRatio()
+    >>> metric.update(rng.randn(1, 2, 256).astype(np.float32), rng.randn(1, 2, 256).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    -39.8171
+    """,
+    "image:SpatialCorrelationCoefficient": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import SpatialCorrelationCoefficient
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SpatialCorrelationCoefficient()
+    >>> metric.update(rng.rand(2, 3, 16, 16).astype(np.float32), rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    -0.0162
+    """,
+    "image:SpatialDistortionIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import SpatialDistortionIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SpatialDistortionIndex()
+    >>> metric.update(rng.rand(2, 3, 32, 32).astype(np.float32), {'ms': rng.rand(2, 3, 16, 16).astype(np.float32), 'pan': rng.rand(2, 3, 32, 32).astype(np.float32), 'pan_lr': rng.rand(2, 3, 16, 16).astype(np.float32)})
+    >>> round(float(metric.compute()), 4)
+    0.0692
+    """,
+    "classification:SpecificityAtSensitivity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import SpecificityAtSensitivity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SpecificityAtSensitivity(task='binary', min_sensitivity=0.5)
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> tuple(np.asarray(v).shape for v in metric.compute())
+    ((), ())
+    """,
+    "image:SpectralAngleMapper": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import SpectralAngleMapper
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SpectralAngleMapper()
+    >>> metric.update(rng.rand(2, 3, 16, 16).astype(np.float32), rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.6218
+    """,
+    "image:SpectralDistortionIndex": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import SpectralDistortionIndex
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SpectralDistortionIndex()
+    >>> metric.update(rng.rand(2, 3, 16, 16).astype(np.float32), rng.rand(2, 3, 16, 16).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.0892
+    """,
+    "classification:StatScores": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import StatScores
+    >>> rng = np.random.RandomState(42)
+    >>> metric = StatScores(task='binary')
+    >>> metric.update(rng.rand(10).astype(np.float32), rng.randint(0, 2, 10))
+    >>> [round(float(v), 4) for v in np.asarray(metric.compute()).reshape(-1)]
+    [5.0, 1.0, 0.0, 4.0, 9.0]
+    """,
+    "regression:SymmetricMeanAbsolutePercentageError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import SymmetricMeanAbsolutePercentageError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = SymmetricMeanAbsolutePercentageError()
+    >>> metric.update(rng.rand(10).astype(np.float32) + 0.5, rng.rand(10).astype(np.float32) + 0.5)
+    >>> round(float(metric.compute()), 4)
+    0.2335
+    """,
+    "nominal:TheilsU": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.nominal import TheilsU
+    >>> rng = np.random.RandomState(42)
+    >>> metric = TheilsU(num_classes=3)
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.1535
+    """,
+    "text:TranslationEditRate": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import TranslationEditRate
+    >>> metric = TranslationEditRate()
+    >>> metric.update(["the squirrel eats the nut"], [["the squirrel is eating the nut"]])
+    >>> round(float(metric.compute()), 4)
+    0.3333
+    """,
+    "nominal:TschuprowsT": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.nominal import TschuprowsT
+    >>> rng = np.random.RandomState(42)
+    >>> metric = TschuprowsT(num_classes=3)
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    "regression:TweedieDevianceScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import TweedieDevianceScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = TweedieDevianceScore(power=1.5)
+    >>> metric.update(rng.rand(10).astype(np.float32) + 0.5, rng.rand(10).astype(np.float32) + 0.5)
+    >>> round(float(metric.compute()), 4)
+    0.0755
+    """,
+    "clustering:VMeasureScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.clustering import VMeasureScore
+    >>> rng = np.random.RandomState(42)
+    >>> metric = VMeasureScore()
+    >>> metric.update(rng.randint(0, 3, 16), rng.randint(0, 3, 16))
+    >>> round(float(metric.compute()), 4)
+    0.144
+    """,
+    "image:VisualInformationFidelity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import VisualInformationFidelity
+    >>> rng = np.random.RandomState(42)
+    >>> metric = VisualInformationFidelity()
+    >>> metric.update(rng.rand(1, 3, 48, 48).astype(np.float32), rng.rand(1, 3, 48, 48).astype(np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.0035
+    """,
+    "regression:WeightedMeanAbsolutePercentageError": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.regression import WeightedMeanAbsolutePercentageError
+    >>> rng = np.random.RandomState(42)
+    >>> metric = WeightedMeanAbsolutePercentageError()
+    >>> metric.update(rng.rand(10).astype(np.float32) + 0.5, rng.rand(10).astype(np.float32) + 0.5)
+    >>> round(float(metric.compute()), 4)
+    0.2331
+    """,
+    "text:WordInfoLost": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import WordInfoLost
+    >>> metric = WordInfoLost()
+    >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+    >>> round(float(metric.compute()), 4)
+    0.3056
+    """,
+    "text:WordInfoPreserved": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import WordInfoPreserved
+    >>> metric = WordInfoPreserved()
+    >>> metric.update(["the cat sat on the mat"], ["the cat sat on a mat"])
+    >>> round(float(metric.compute()), 4)
+    0.6944
+    """,
+}

@@ -189,8 +189,6 @@ def _binned_curve_state(preds: Array, target_bin: Array, valid: Array, threshold
     ``valid``: (N, ...) bool. Returns (T, ..., 2, 2) int32 where
     ``[t, ..., y, p]`` counts (target==y, (pred>=thr_t)==p).
     """
-    import os
-
     len_t = thresholds.shape[0]
     inner = preds.shape[1:]  # e.g. (C,) for multiclass/multilabel, () for binary
     n_inner = int(np.prod(inner)) if inner else 1
@@ -198,32 +196,6 @@ def _binned_curve_state(preds: Array, target_bin: Array, valid: Array, threshold
     p = preds.reshape(n, n_inner)
     y = jnp.clip(target_bin, 0, 1).reshape(n, n_inner)
 
-    # Opt-in TPU path (TM_TPU_PALLAS=1): the fused Pallas kernel keeps the
-    # (chunk, C, T) compare tensor in VMEM. Standalone it beats the einsum
-    # formulation ~18% and is bit-exact against it; in the full update the
-    # two are within noise on v5e, so the portable XLA path stays default.
-    # f32 accumulation bounds n; thresholds must be concrete for kernel
-    # specialization.
-    use_pallas = (
-        os.environ.get("TM_TPU_PALLAS", "0") == "1"
-        and jax.default_backend() == "tpu"
-        and n < (1 << 24)
-        and not isinstance(jnp.asarray(thresholds), jax.core.Tracer)
-    )
-    if use_pallas:
-        try:
-            from torchmetrics_tpu.ops import binned_confusion_counts_pallas
-
-            valid_f = valid.reshape(n, n_inner)
-            ge_pos, ge_all = binned_confusion_counts_pallas(p, y, valid_f, np.asarray(thresholds))
-            # per-class totals over valid samples, split by target value
-            masks_i = jnp.stack([(1 - y) * valid_f, y * valid_f], axis=-1)
-            total = masks_i.sum(0).astype(jnp.int32)  # (C, 2)
-            ge = jnp.stack([ge_all - ge_pos, ge_pos], axis=-1)  # (T, C, y)
-            state = jnp.stack([total[None] - ge, ge], axis=-1)  # [t, c, target, pred]
-            return state.reshape((len_t,) + inner + (2, 2)) if inner else state.reshape(len_t, 2, 2)
-        except Exception:  # pragma: no cover - fall back to the portable path
-            pass
     v = valid.reshape(n, n_inner)
     masks_i = jnp.stack([(1 - y) * v, y * v], axis=-1)  # (N, C, 2) int
     total = masks_i.sum(0).astype(jnp.int32)  # (C, 2) per-class target counts

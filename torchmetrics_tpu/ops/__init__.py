@@ -1,6 +1,16 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""Hand-written TPU kernels (Pallas) for the hottest metric ops."""
-from torchmetrics_tpu.ops.binned_confusion import binned_confusion_counts_pallas
+"""Hand-written TPU kernels (Pallas).
 
-__all__ = ["binned_confusion_counts_pallas"]
+Currently empty: the r3 binned-confusion Pallas kernel beat the int8-einsum
+XLA formulation by ~18% standalone but was within measurement noise in the
+full update (the op is bandwidth-bound and XLA's fusion already saturates
+it), so it and its ``TM_TPU_PALLAS`` opt-in flag were retired in r4 per the
+measured-win-or-delete rule. The mAP matcher and BERTScore matching — the
+other SURVEY §7 Pallas candidates — moved off the profile entirely when
+matching+accumulation fused into one XLA program and the encoder forward
+became the text bottleneck. New kernels belong here when a profiled,
+driver-reproducible stage win exists.
+"""
+
+__all__: list = []
