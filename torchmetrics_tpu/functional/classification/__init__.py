@@ -146,7 +146,10 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     stat_scores,
 )
 
+from torchmetrics_tpu.functional.segmentation.generalized_dice import generalized_dice_score  # noqa: E501  (reference also re-exports it here)
+
 __all__ = [
+    "generalized_dice_score",
     "accuracy",
     "binary_accuracy",
     "multiclass_accuracy",

@@ -5,6 +5,7 @@ from torchmetrics_tpu.functional.text.bleu import bleu_score
 from torchmetrics_tpu.functional.text.chrf import chrf_score
 from torchmetrics_tpu.functional.text.edit import edit_distance
 from torchmetrics_tpu.functional.text.eed import extended_edit_distance
+from torchmetrics_tpu.functional.text.bert import bert_score
 from torchmetrics_tpu.functional.text.infolm import infolm
 from torchmetrics_tpu.functional.text.perplexity import perplexity
 from torchmetrics_tpu.functional.text.rouge import rouge_score
@@ -20,6 +21,7 @@ from torchmetrics_tpu.functional.text.wer import (
 )
 
 __all__ = [
+    "bert_score",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

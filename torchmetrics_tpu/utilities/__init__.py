@@ -21,7 +21,7 @@ from torchmetrics_tpu.utilities.data import (
 )
 from torchmetrics_tpu.utilities.distributed import class_reduce, gather_all_arrays, reduce
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
-from torchmetrics_tpu.utilities.prints import rank_zero_print, rank_zero_warn
+from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_print, rank_zero_warn
 
 __all__ = [
     "check_forward_full_state_property",
@@ -39,6 +39,8 @@ __all__ = [
     "reduce",
     "TorchMetricsUserError",
     "TorchMetricsUserWarning",
+    "rank_zero_debug",
+    "rank_zero_info",
     "rank_zero_print",
     "rank_zero_warn",
 ]

@@ -8,6 +8,7 @@ process all devices share the Python interpreter, so process 0 is rank zero.
 """
 from __future__ import annotations
 
+import logging
 import warnings
 from functools import partial, wraps
 from typing import Any, Callable
@@ -43,6 +44,21 @@ def rank_zero_print(*args: Any, **kwargs: Any) -> None:
 def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
     kwargs.setdefault("stacklevel", 5)
     warnings.warn(message, *args, **kwargs)
+
+
+_log = logging.getLogger("torchmetrics_tpu")
+
+
+@rank_zero_only
+def rank_zero_debug(*args: Any, **kwargs: Any) -> None:
+    """Log at debug level on process 0 only (reference ``utilities/prints.py``)."""
+    _log.debug(*args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(*args: Any, **kwargs: Any) -> None:
+    """Log at info level on process 0 only (reference ``utilities/prints.py``)."""
+    _log.info(*args, **kwargs)
 
 
 def _deprecation_warn(message: str) -> None:
