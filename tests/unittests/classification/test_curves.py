@@ -266,3 +266,42 @@ def test_exact_auroc_is_jittable_all_tasks():
         jax.jit(lambda p, t: multilabel_auroc(p, t, num_labels=4, validate_args=False))(p_ml, t_ml)
     )
     np.testing.assert_allclose(got, roc_auc_score(t_ml, p_ml, average="macro"), atol=1e-5)
+
+
+def test_padded_clf_curve_valid_neginf_pred_keeps_group_end():
+    """r4 advisor: a valid prediction equal to -inf shares the -inf sort key
+    with ignored entries; validity must break the tie so the group-end mask
+    lands on the last VALID member (not an invalid tail that gets masked)."""
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import _binary_clf_curve_padded
+
+    preds = np.array([-np.inf, 0.5, 0.2, 0.1], np.float32)
+    target = np.array([1, 1, 0, -1], np.int32)  # last entry ignored
+    fps, tps, thres, mask = (np.asarray(x) for x in _binary_clf_curve_padded(preds, target))
+    # valid entries sorted desc: 0.5(t=1), 0.2(t=0), -inf(t=1); invalid last
+    assert mask.tolist() == [True, True, True, False]
+    assert tps[mask].tolist() == [1, 1, 2]
+    assert fps[mask].tolist() == [0, 1, 1]
+    # the same case with the ignored entry's key ALSO -inf but positioned
+    # before the valid -inf in input order (stable-sort worst case)
+    preds2 = np.array([0.7, -np.inf, -np.inf, 0.3], np.float32)
+    target2 = np.array([0, -1, 1, 1], np.int32)
+    fps2, tps2, thres2, mask2 = (np.asarray(x) for x in _binary_clf_curve_padded(preds2, target2))
+    assert int(mask2.sum()) == 3  # three unique valid thresholds: 0.7, 0.3, -inf
+    assert tps2[mask2].tolist() == [0, 1, 2]
+    assert fps2[mask2].tolist() == [1, 1, 1]
+
+
+def test_host_clf_curve_float64_keeps_precision():
+    """r4 advisor: f64 preds keep a NumPy f64 path — thresholds closer than
+    f32 eps stay distinct and counts accumulate in int64."""
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import _binary_clf_curve_host
+
+    base = 0.5
+    eps64 = 1e-12  # far below f32 resolution at 0.5
+    preds = np.array([base, base + eps64, base + 2 * eps64], np.float64)
+    target = np.array([0, 1, 1], np.int64)
+    fps, tps, thres = _binary_clf_curve_host(preds, target)
+    assert thres.dtype == np.float64
+    assert len(thres) == 3  # all three thresholds distinct in f64
+    assert tps.tolist() == [1, 2, 2]
+    assert fps.tolist() == [0, 0, 1]

@@ -78,15 +78,19 @@ def _binary_clf_curve_padded(
     target = target.reshape(-1)
     valid = target >= 0
     key = jnp.where(valid, preds, -jnp.inf)
-    order = jnp.argsort(-key)  # descending; invalid entries land at the end
+    # Descending key, with validity as tie-break so a VALID ``-inf``
+    # prediction never shares its tie-group tail with an invalid entry (an
+    # invalid last member would otherwise erase the group-end mask).
+    order = jnp.lexsort(((~valid).astype(jnp.int32), -key))
     k_sorted = key[order]
     v_sorted = valid[order]
     y_sorted = ((target[order] == pos_label) & v_sorted).astype(jnp.int32)
     tps = jnp.cumsum(y_sorted)
     fps = jnp.cumsum(v_sorted.astype(jnp.int32)) - tps
-    n = preds.shape[0]
     nxt = jnp.concatenate([k_sorted[1:], jnp.full((1,), -jnp.inf, k_sorted.dtype)])
-    is_end = (k_sorted != nxt) | (jnp.arange(n) == n - 1)
+    # ~nxt_v covers the final position too (appended next-validity is False)
+    nxt_v = jnp.concatenate([v_sorted[1:], jnp.zeros((1,), bool)])
+    is_end = (k_sorted != nxt) | ~nxt_v
     return fps, tps, k_sorted, is_end & v_sorted
 
 
@@ -102,7 +106,23 @@ def _binary_clf_curve_host(
     ``_binary_clf_curve_padded``; the host's only job is the dynamic-shape
     boolean-index that drops tie-group-interior positions. Assumes inputs are
     already filtered of ignored entries (callers pass ``target ∈ {0..C-1}``).
+
+    float64 predictions keep a NumPy path: the device kernel computes in f32
+    (and int32 counts), which would merge thresholds closer than f32 eps and
+    overflow past 2^31 elements; f64 callers get f64 thresholds / int64 sums.
     """
+    preds = np.asarray(preds).reshape(-1)
+    target = np.asarray(target).reshape(-1)
+    if preds.dtype == np.float64:
+        if preds.size == 0:
+            empty = np.zeros(0, np.int64)
+            return empty, empty.copy(), np.zeros(0, np.float64)
+        order = np.argsort(-preds, kind="stable")
+        p_sorted = preds[order]
+        tps = np.cumsum(target[order] == pos_label, dtype=np.int64)
+        fps = np.arange(1, preds.size + 1, dtype=np.int64) - tps
+        is_end = np.r_[p_sorted[1:] != p_sorted[:-1], True]
+        return fps[is_end], tps[is_end], p_sorted[is_end]
     fps, tps, thres, mask = _jitted_clf_curve_padded(jnp.asarray(preds), jnp.asarray(target), pos_label)
     m = np.asarray(mask)
     return np.asarray(fps)[m], np.asarray(tps)[m], np.asarray(thres)[m]

@@ -52,7 +52,7 @@ def _binary_roc_compute(
     # prepend origin so the curve starts at (0, 0)
     tps = np.concatenate([[0], tps])
     fps = np.concatenate([[0], fps])
-    thres = np.concatenate([[1.0], thres])
+    thres = np.concatenate([np.ones(1, thres.dtype), thres])
     if fps[-1] <= 0:
         rank_zero_warn(
             "No negative samples in targets, false positive value should be meaningless."
@@ -71,7 +71,10 @@ def _binary_roc_compute(
         tpr = np.zeros_like(thres)
     else:
         tpr = tps / tps[-1]
-    return jnp.asarray(fpr, jnp.float32), jnp.asarray(tpr, jnp.float32), jnp.asarray(thres, jnp.float32)
+    # keep f64 thresholds (the host curve's f64 branch preserved sub-f32-eps
+    # threshold gaps) when the caller runs with x64 enabled
+    thr_dtype = jnp.float64 if (thres.dtype == np.float64 and jax.config.jax_enable_x64) else jnp.float32
+    return jnp.asarray(fpr, jnp.float32), jnp.asarray(tpr, jnp.float32), jnp.asarray(thres, thr_dtype)
 
 
 def binary_roc(

@@ -436,6 +436,16 @@ def coco_mean_average_precision(
     ``target[i]``: same geometry key, ``labels``, optional ``iscrowd``/``area``.
     Mask IoU/areas run through the native C++ RLE codec
     (:mod:`torchmetrics_tpu.functional.detection.mask_utils`).
+
+    .. note::
+        With the default (uniform 101-point) ``rec_thresholds`` grid the
+        recall→threshold-slot assignment reproduces pycocotools' float64
+        comparison EXACTLY via integer arithmetic. A **custom non-uniform**
+        grid falls back to an f32 ``searchsorted`` on device: when a recall
+        value ``tp/npig`` collides with a threshold within f32 noise the slot
+        can differ by one from an f64 reference. Exact semantics require a
+        uniform grid spanning exactly ``[0, 1]`` (``np.linspace(0, 1, R)``
+        for any resolution ``R``); any other grid takes the f32 fallback.
     """
     if iou_type not in ("bbox", "segm"):
         raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {iou_type}")

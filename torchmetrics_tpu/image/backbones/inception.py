@@ -300,6 +300,9 @@ class FIDInceptionV3(nn.Module):
         return out
 
 
+_BF16_AUTOSELECT_NOTIFIED = False
+
+
 class InceptionFeatureExtractor:
     """Callable wrapper: jitted apply + cached params (the Flax analogue of
     reference ``NoTrainInceptionV3``, ``image/fid.py:44-73``)."""
@@ -319,6 +322,17 @@ class InceptionFeatureExtractor:
         ``image/fid.py:370-377``) one precision tier down."""
         if dtype is None:
             dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+            if dtype == jnp.bfloat16:
+                from torchmetrics_tpu.utilities.prints import rank_zero_info
+
+                global _BF16_AUTOSELECT_NOTIFIED
+                if not _BF16_AUTOSELECT_NOTIFIED:
+                    _BF16_AUTOSELECT_NOTIFIED = True
+                    rank_zero_info(
+                        "InceptionFeatureExtractor auto-selected a bfloat16 conv tower on TPU"
+                        " (FID/KID/IS/MiFID drift vs float32 is <=1e-3; pass dtype=jnp.float32"
+                        " here, or tower_dtype=jnp.float32 on the metric classes, for f32)."
+                    )
         self.features_list = [str(f) for f in features_list]
         self.module = FIDInceptionV3(features_list=tuple(self.features_list), dtype=dtype)
         if params is None:
