@@ -349,3 +349,182 @@ def test_coco_json_roundtrip_segm_and_formats(tmp_path):
         json.dump([{"image_id": 999, "category_id": 0, "score": 0.5, "bbox": [0, 0, 1, 1]}], f)
     with pytest.raises(ValueError, match="image_id"):
         MeanAveragePrecision.coco_to_tm(f"{name3}_preds.json", f"{name3}_target.json")
+
+
+# ----------------------------------------------------------- mixed iou_type
+
+
+def _small_rect_masks(rects, h=140, w=140):
+    rects = np.asarray(rects, np.int64).reshape(-1, 4)
+    masks = np.zeros((len(rects), h, w), np.uint8)
+    for i, (x1, y1, x2, y2) in enumerate(rects):
+        masks[i, max(y1, 0): max(y2, 0), max(x1, 0): max(x2, 0)] = 1
+    return masks
+
+
+def _mixed_dataset(seed=11, n_imgs=4):
+    """Big boxes (large-area bin) with small rectangular masks inside them
+    (small-area bin) — the configuration where the reference's mixed-mode
+    area semantics (gt bins by MASK area, det ignore-range by the geometry
+    of the pass) actually change the small/medium/large splits."""
+    rng = np.random.RandomState(seed)
+    preds, target = [], []
+    for _ in range(n_imgs):
+        n_gt, n_dt = rng.randint(1, 5), rng.randint(1, 6)
+        gt_xy = rng.randint(0, 30, (n_gt, 2))
+        gt_wh = rng.randint(97, 110, (n_gt, 2))  # box area > 96^2 -> "large"
+        gt_boxes = np.concatenate([gt_xy, gt_xy + gt_wh], 1).astype(np.float64)
+        # small sub-rectangle inside each box: area < 32^2 -> "small"
+        m_wh = rng.randint(8, 30, (n_gt, 2))
+        gt_mrects = np.concatenate([gt_xy, gt_xy + m_wh], 1)
+        dt_xy = rng.randint(0, 30, (n_dt, 2))
+        dt_wh = rng.randint(97, 110, (n_dt, 2))
+        dt_boxes = np.concatenate([dt_xy, dt_xy + dt_wh], 1).astype(np.float64)
+        dm_wh = rng.randint(8, 30, (n_dt, 2))
+        dt_mrects = np.concatenate([dt_xy, dt_xy + dm_wh], 1)
+        for j in range(min(n_dt, n_gt)):
+            if rng.rand() < 0.7:  # correlate some dets with gts
+                dt_boxes[j] = gt_boxes[j] + rng.randint(-6, 7, 4)
+                dt_boxes[j, 2:] = np.maximum(dt_boxes[j, 2:], dt_boxes[j, :2] + 1)
+                dt_mrects[j] = gt_mrects[j] + rng.randint(-3, 4, 4)
+                dt_mrects[j, 2:] = np.maximum(dt_mrects[j, 2:], dt_mrects[j, :2] + 1)
+        scores = np.round(rng.rand(n_dt), 3)
+        dt_labels = rng.randint(0, 3, n_dt)
+        gt_labels = rng.randint(0, 3, n_gt)
+        crowd = (rng.rand(n_gt) < 0.15).astype(np.int64)
+        preds.append({
+            "boxes": np.clip(dt_boxes, 0, 139), "masks": _small_rect_masks(np.clip(dt_mrects, 0, 139)),
+            "scores": scores, "labels": dt_labels,
+            "_mrects": np.clip(dt_mrects, 0, 139).astype(np.float64),
+        })
+        target.append({
+            "boxes": np.clip(gt_boxes, 0, 139), "masks": _small_rect_masks(np.clip(gt_mrects, 0, 139)),
+            "labels": gt_labels, "iscrowd": crowd,
+            "_mrects": np.clip(gt_mrects, 0, 139).astype(np.float64),
+        })
+    return preds, target
+
+
+def test_mixed_iou_type_matches_per_type_oracles():
+    """Mixed ("bbox", "segm") runs both evaluations over one stream with
+    prefixed result keys (reference mean_ap.py:526-558). Small rectangular
+    masks inside large boxes make the area semantics observable: the bbox
+    pass must bin gts by MASK area while taking det areas from the boxes."""
+    from tests.unittests.detection._coco_oracle import coco_eval_oracle
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    preds, target = _mixed_dataset()
+    metric = MeanAveragePrecision(iou_type=("bbox", "segm"))
+    metric.update(
+        [{k: v for k, v in p.items() if k != "_mrects"} for p in preds],
+        [{k: v for k, v in t.items() if k != "_mrects"} for t in target],
+    )
+    res = metric.compute()
+
+    def mask_areas(item):
+        r = item["_mrects"]
+        return (r[:, 2] - r[:, 0]) * (r[:, 3] - r[:, 1])
+
+    # bbox pass oracle: box geometry, gt areas = mask areas
+    oracle_bbox = coco_eval_oracle(
+        [{"boxes": p["boxes"], "scores": p["scores"], "labels": p["labels"]} for p in preds],
+        [
+            {"boxes": t["boxes"], "labels": t["labels"], "iscrowd": t["iscrowd"], "area": mask_areas(t)}
+            for t in target
+        ],
+    )
+    # segm pass oracle: rectangular masks -> equivalent bbox run on the rects
+    oracle_segm = coco_eval_oracle(
+        [{"boxes": p["_mrects"], "scores": p["scores"], "labels": p["labels"]} for p in preds],
+        [
+            {"boxes": t["_mrects"], "labels": t["labels"], "iscrowd": t["iscrowd"], "area": mask_areas(t)}
+            for t in target
+        ],
+    )
+    keys = [
+        "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+        "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+    ]
+    for k in keys:
+        assert abs(float(res[f"bbox_{k}"]) - oracle_bbox[k]) < 1e-6, ("bbox", k, float(res[f"bbox_{k}"]), oracle_bbox[k])
+        assert abs(float(res[f"segm_{k}"]) - oracle_segm[k]) < 1e-6, ("segm", k, float(res[f"segm_{k}"]), oracle_segm[k])
+    # unprefixed keys absent except classes; per-class placeholders prefixed
+    assert "map" not in res and "classes" in res
+    assert "bbox_map_per_class" in res and "segm_mar_100_per_class" in res
+    # the area semantics actually fired: bbox gts landed in the small bin
+    assert float(res["bbox_map_large"]) == -1.0  # no gt binned large despite large boxes
+    assert float(res["bbox_map_small"]) > -1.0
+
+
+def test_mixed_iou_type_streaming_and_sync_roundtrip():
+    """Mixed-mode state streams over multiple updates and survives the sync
+    machinery with BOTH geometry states populated — box arrays through the
+    pad/trim array gather, RLE mask dicts through the object gather — with
+    masks staying aligned to scores/labels."""
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+    from torchmetrics_tpu.utilities.distributed import gather_all_arrays
+
+    preds, target = _mixed_dataset(seed=23)
+    strip = lambda items: [{k: v for k, v in it.items() if k != "_mrects"} for it in items]
+    one = MeanAveragePrecision(iou_type=("bbox", "segm"))
+    one.update(strip(preds), strip(target))
+    res_one = one.compute()
+
+    two = MeanAveragePrecision(iou_type=("bbox", "segm"), sync_on_compute=False)
+    two.update(strip(preds[:2]), strip(target[:2]))
+    two.update(strip(preds[2:]), strip(target[2:]))
+    # drive _sync_dist directly (single-process degenerate gather): both the
+    # array states and the mask object states must come back intact and in
+    # the same order so masks stay aligned with scores/labels
+    two._sync_dist(gather_all_arrays)
+    assert len(two.detection_box) == len(two.detection_mask) == len(preds)
+    res_two = two.compute()
+    for k in res_one:
+        np.testing.assert_allclose(
+            np.asarray(res_one[k]), np.asarray(res_two[k]), atol=1e-7, err_msg=k
+        )
+
+
+def test_mixed_coco_to_tm_backfills_missing_geometry(tmp_path):
+    """coco_to_tm under the mixed tuple mirrors loadRes' back-fills: results
+    files carrying only segmentation derive boxes via rleToBbox; results
+    carrying only boxes derive rectangle-polygon masks."""
+    import json
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+    from torchmetrics_tpu.functional.detection import mask_utils
+
+    h = w = 64
+    gt_mask = np.zeros((h, w), np.uint8)
+    gt_mask[10:30, 5:25] = 1
+    rle = mask_utils.encode(gt_mask)
+    target_file = {
+        "images": [{"id": 1, "height": h, "width": w}],
+        "annotations": [{
+            "id": 1, "image_id": 1, "category_id": 3,
+            "bbox": [5.0, 10.0, 20.0, 20.0],
+            "segmentation": {"size": [h, w], "counts": np.asarray(rle["counts"]).tolist()},
+            "iscrowd": 0, "area": 400.0,
+        }],
+    }
+    # segmentation-only prediction (no bbox key) and bbox-only prediction
+    preds_file = [
+        {"image_id": 1, "category_id": 3, "score": 0.9,
+         "segmentation": {"size": [h, w], "counts": np.asarray(rle["counts"]).tolist()}},
+        {"image_id": 1, "category_id": 3, "score": 0.4, "bbox": [5.0, 10.0, 20.0, 20.0]},
+    ]
+    tpath, ppath = tmp_path / "t.json", tmp_path / "p.json"
+    tpath.write_text(json.dumps(target_file))
+    ppath.write_text(json.dumps(preds_file))
+    preds, target = MeanAveragePrecision.coco_to_tm(str(ppath), str(tpath), iou_type=("bbox", "segm"))
+    assert preds[0]["boxes"].shape == (2, 4) and len(preds[0]["masks"]) == 2
+    # derived box from mask == the true box (xyxy)
+    np.testing.assert_allclose(preds[0]["boxes"][0], [5, 10, 25, 30])
+    # derived rectangle mask from box == the true mask here
+    np.testing.assert_allclose(
+        mask_utils.decode(preds[0]["masks"][1]), gt_mask)
+    m = MeanAveragePrecision(iou_type=("bbox", "segm"))
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["bbox_map"]) == 1.0 and float(res["segm_map"]) == 1.0

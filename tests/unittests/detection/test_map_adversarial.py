@@ -318,3 +318,36 @@ def test_segm_overlapping_masks_exact_iou_and_rle_paths():
     assert abs(float(res_masks["map_50"]) - 1.0) < 1e-6
     assert abs(float(res_masks["map_75"]) - 0.0) < 1e-6
     assert abs(float(res_masks["map"]) - 0.1) < 1e-6
+
+
+def test_golden_mixed_fixture_replay():
+    """The mixed ("bbox", "segm") fixture replays bit-identically through the
+    module metric; tools/replay_coco_fixtures.py re-checks the same expected
+    stats against two real COCOeval runs wherever pycocotools exists."""
+    with open(FIXTURE_PATH) as fh:
+        fixtures = json.load(fh)
+    assert len(fixtures["mixed_cases"]) >= 1
+    for case in fixtures["mixed_cases"]:
+        preds = [
+            {
+                "boxes": np.asarray(p["boxes"], np.float64).reshape(-1, 4),
+                "masks": [{"size": m["size"], "counts": np.asarray(m["counts"], np.uint32)} for m in p["masks"]],
+                "scores": np.asarray(p["scores"], np.float64),
+                "labels": np.asarray(p["labels"], np.int64),
+            }
+            for p in case["preds"]
+        ]
+        target = [
+            {
+                "boxes": np.asarray(t["boxes"], np.float64).reshape(-1, 4),
+                "masks": [{"size": m["size"], "counts": np.asarray(m["counts"], np.uint32)} for m in t["masks"]],
+                "labels": np.asarray(t["labels"], np.int64),
+                "iscrowd": np.asarray(t["iscrowd"], np.int64),
+            }
+            for t in case["target"]
+        ]
+        metric = MeanAveragePrecision(iou_type=tuple(case["iou_type"]))
+        metric.update(preds, target)
+        res = metric.compute()
+        for key, expected in case["expected"].items():
+            assert abs(float(res[key]) - expected) < 1e-6, (case["name"], key, float(res[key]), expected)

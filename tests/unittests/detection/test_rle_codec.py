@@ -107,3 +107,41 @@ def test_coco_to_tm_polygon_segmentations(tmp_path):
     metric.update(p, t)
     res = metric.compute()
     np.testing.assert_allclose(float(res["map"]), 1.0, atol=1e-6)  # identical mask -> perfect
+
+
+def test_to_bbox_matches_pycocotools_rule():
+    """to_bbox reproduces rleToBbox: tight xywh box; a run crossing a column
+    boundary covers full height."""
+    import numpy as np
+
+    from torchmetrics_tpu.functional.detection import mask_utils
+
+    m = np.zeros((10, 12), np.uint8)
+    m[3:7, 2:9] = 1  # box x=2 y=3 w=7 h=4
+    np.testing.assert_allclose(mask_utils.to_bbox(mask_utils.encode(m)), [2, 3, 7, 4])
+    # empty mask
+    np.testing.assert_allclose(mask_utils.to_bbox(mask_utils.encode(np.zeros((5, 5), np.uint8))), [0, 0, 0, 0])
+    # full-column run crossing boundary -> full height
+    m2 = np.zeros((4, 4), np.uint8)
+    m2[:, 1:3] = 1
+    np.testing.assert_allclose(mask_utils.to_bbox(mask_utils.encode(m2)), [1, 0, 2, 4])
+    # batch form
+    out = mask_utils.to_bbox([mask_utils.encode(m), mask_utils.encode(m2)])
+    np.testing.assert_allclose(out, [[2, 3, 7, 4], [1, 0, 2, 4]])
+    # random masks: bbox must equal the numpy-derived tight bounds
+    rng = np.random.RandomState(0)
+    for _ in range(20):
+        mm = (rng.rand(17, 23) < 0.2).astype(np.uint8)
+        got = mask_utils.to_bbox(mask_utils.encode(mm))
+        ys, xs = np.nonzero(mm)
+        if xs.size == 0:
+            np.testing.assert_allclose(got, [0, 0, 0, 0])
+            continue
+        # per-column rule: a run spanning columns widens y to full height;
+        # for random masks runs rarely span columns, so compare only when
+        # no foreground run crosses a column boundary
+        col_joined = any(mm[-1, c] and mm[0, c + 1] for c in range(mm.shape[1] - 1))
+        if not col_joined:
+            np.testing.assert_allclose(
+                got, [xs.min(), ys.min(), xs.max() - xs.min() + 1, ys.max() - ys.min() + 1]
+            )

@@ -10,6 +10,13 @@ loop-based numpy oracle). pycocotools is not installed in the build image, so
 this script is the third-party handshake: run it anywhere pycocotools exists
 and it asserts the expected stats to 1e-6 against ``COCOeval`` itself.
 
+Plain ``cases`` run one bbox COCOeval. ``mixed_cases`` (iou_type
+``("bbox", "segm")``) run two COCOeval passes over one dataset with the
+reference's mixed-mode semantics (torchmetrics mean_ap.py:526-558, :915-936):
+gt annotations carry area = MASK area; detection areas follow the pass
+geometry, which loadRes reproduces when dets are loaded per-type (bbox-only
+results -> w*h, segmentation results -> RLE area).
+
 Usage::
 
     pip install pycocotools
@@ -32,42 +39,90 @@ _STATS = {
 }
 
 
-def _to_coco_datasets(case):
-    """Fixture case -> (COCO gt dict, detection list) in pycocotools format."""
-    images, annotations, det_results = [], [], []
+def _to_coco_datasets(case, with_masks=False):
+    """Fixture case -> (COCO gt dict, bbox det list, segm det list).
+
+    With ``with_masks`` the gt annotations additionally carry the RLE
+    ``segmentation`` and ``area`` = mask area (the reference's mixed-mode gt
+    semantics), and the segm detection list is populated; otherwise gt area
+    is the box area and the segm list stays empty.
+    """
+    if with_masks:
+        from pycocotools import mask as mask_utils
+
+    images, annotations, det_bbox, det_segm = [], [], [], []
     categories = set()
     ann_id = 1
     for img_id, (p, t) in enumerate(zip(case["preds"], case["target"]), start=1):
-        images.append({"id": img_id, "width": 1000, "height": 1000})
+        if with_masks and t["masks"]:
+            h, w = (int(v) for v in t["masks"][0]["size"])
+        else:
+            h, w = 1000, 1000
+        images.append({"id": img_id, "width": w, "height": h})
         boxes = np.asarray(t["boxes"], np.float64).reshape(-1, 4)
         labels = np.asarray(t["labels"], np.int64).reshape(-1)
         crowd = np.asarray(t.get("iscrowd", np.zeros(len(labels))), np.int64).reshape(-1)
-        for box, label, cr in zip(boxes, labels, crowd):
+        for k, (box, label, cr) in enumerate(zip(boxes, labels, crowd)):
             x1, y1, x2, y2 = box
-            annotations.append({
+            ann = {
                 "id": ann_id, "image_id": img_id, "category_id": int(label),
                 "bbox": [float(x1), float(y1), float(x2 - x1), float(y2 - y1)],
                 "area": float((x2 - x1) * (y2 - y1)), "iscrowd": int(cr),
-            })
+            }
+            if with_masks:
+                rle = mask_utils.frPyObjects(t["masks"][k], *t["masks"][k]["size"])
+                ann["segmentation"] = rle
+                ann["area"] = float(mask_utils.area(rle))
+            annotations.append(ann)
             categories.add(int(label))
             ann_id += 1
         dboxes = np.asarray(p["boxes"], np.float64).reshape(-1, 4)
         dscores = np.asarray(p["scores"], np.float64).reshape(-1)
         dlabels = np.asarray(p["labels"], np.int64).reshape(-1)
-        for box, score, label in zip(dboxes, dscores, dlabels):
+        for k, (box, score, label) in enumerate(zip(dboxes, dscores, dlabels)):
             x1, y1, x2, y2 = box
-            det_results.append({
+            det_bbox.append({
                 "image_id": img_id, "category_id": int(label),
                 "bbox": [float(x1), float(y1), float(x2 - x1), float(y2 - y1)],
                 "score": float(score),
             })
+            if with_masks:
+                det_segm.append({
+                    "image_id": img_id, "category_id": int(label),
+                    "segmentation": mask_utils.frPyObjects(p["masks"][k], *p["masks"][k]["size"]),
+                    "score": float(score),
+                })
             categories.add(int(label))
     gt = {
         "images": images,
         "annotations": annotations,
         "categories": [{"id": c, "name": str(c)} for c in sorted(categories)],
     }
-    return gt, det_results
+    return gt, det_bbox, det_segm
+
+
+def _load_res_or_empty(coco_gt, dets, gt_dict, COCO):
+    """loadRes([]) raises in pycocotools; build a valid empty result set."""
+    if dets:
+        return coco_gt.loadRes(dets)
+    coco_dt = COCO()
+    coco_dt.dataset = {"images": gt_dict["images"], "annotations": [],
+                       "categories": gt_dict["categories"]}
+    coco_dt.createIndex()
+    return coco_dt
+
+
+def _run_eval(gt_dict, dets, i_type, COCO, COCOeval):
+    with contextlib.redirect_stdout(io.StringIO()):
+        coco_gt = COCO()
+        coco_gt.dataset = gt_dict
+        coco_gt.createIndex()
+        coco_dt = _load_res_or_empty(coco_gt, dets, gt_dict, COCO)
+        ev = COCOeval(coco_gt, coco_dt, iouType=i_type)
+        ev.evaluate()
+        ev.accumulate()
+        ev.summarize()
+    return ev.stats
 
 
 def main() -> int:
@@ -84,33 +139,33 @@ def main() -> int:
     )
     fixtures = json.loads(path.read_text())
     failures = 0
-    for case in fixtures["cases"]:
-        gt_dict, det_results = _to_coco_datasets(case)
-        with contextlib.redirect_stdout(io.StringIO()):
-            coco_gt = COCO()
-            coco_gt.dataset = gt_dict
-            coco_gt.createIndex()
-            if det_results:
-                coco_dt = coco_gt.loadRes(det_results)
-            else:  # loadRes([]) raises; build a valid empty result set instead
-                coco_dt = COCO()
-                coco_dt.dataset = {"images": gt_dict["images"], "annotations": [],
-                                   "categories": gt_dict["categories"]}
-                coco_dt.createIndex()
-            ev = COCOeval(coco_gt, coco_dt, iouType="bbox")
-            ev.evaluate()
-            ev.accumulate()
-            ev.summarize()
+
+    def check(stats, expected_map, name, key_prefix=""):
+        nonlocal failures
         for idx, key in _STATS.items():
-            expected = case["expected"][key]
-            got = float(ev.stats[idx])
+            expected = expected_map[f"{key_prefix}{key}"]
+            got = float(stats[idx])
             if abs(got - expected) > 1e-6:
                 failures += 1
-                print(f"MISMATCH {case['name']}.{key}: pycocotools={got:.10f} fixtures={expected:.10f}")
+                print(f"MISMATCH {name}.{key_prefix}{key}:"
+                      f" pycocotools={got:.10f} fixtures={expected:.10f}")
+
+    for case in fixtures["cases"]:
+        gt_dict, det_bbox, _ = _to_coco_datasets(case)
+        stats = _run_eval(gt_dict, det_bbox, "bbox", COCO, COCOeval)
+        check(stats, case["expected"], case["name"])
+
+    for case in fixtures.get("mixed_cases", []):
+        gt_dict, det_bbox, det_segm = _to_coco_datasets(case, with_masks=True)
+        for i_type, dets in (("bbox", det_bbox), ("segm", det_segm)):
+            stats = _run_eval(gt_dict, dets, i_type, COCO, COCOeval)
+            check(stats, case["expected"], case["name"], key_prefix=f"{i_type}_")
+
     if failures:
         print(f"{failures} mismatches")
         return 1
-    print(f"all {len(fixtures['cases'])} cases match pycocotools to 1e-6")
+    n_mixed = len(fixtures.get("mixed_cases", []))
+    print(f"all {len(fixtures['cases'])} cases + {n_mixed} mixed cases match pycocotools to 1e-6")
     return 0
 
 

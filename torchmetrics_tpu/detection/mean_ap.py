@@ -41,8 +41,13 @@ class MeanAveragePrecision(Metric):
     ``iou_type="segm"`` encodes masks through the native C++ RLE codec
     (:mod:`torchmetrics_tpu.native`) at update time — the pycocotools-C
     replacement of SURVEY §2.6 — and runs the same device matching kernel on
-    the RLE IoU matrices. Mixed ``("bbox", "segm")`` tuples are not supported;
-    evaluate with two metric instances.
+    the RLE IoU matrices. The mixed ``("bbox", "segm")`` tuple runs both
+    evaluations over one accumulated stream and prefixes every result key
+    with the iou type (``bbox_map``, ``segm_map``, ...), matching reference
+    ``mean_ap.py:524-558``: detection areas are taken from the geometry of
+    the pass (box area for ``bbox``, RLE area for ``segm``) while ground
+    truths bin by their user-provided area where positive, else mask area —
+    the reference's mixed-mode annotation-area semantics.
     """
 
     is_differentiable: bool = False
@@ -70,11 +75,8 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
         self.iou_type = _validate_iou_type_arg(iou_type)
-        if len(self.iou_type) != 1:
-            raise ValueError(
-                "This implementation evaluates one iou_type per instance; create two instances for"
-                " ('bbox', 'segm')."
-            )
+        if len(set(self.iou_type)) != len(self.iou_type):
+            raise ValueError(f"Expected argument `iou_type` to contain no duplicates, but got {iou_type}")
         if iou_thresholds is not None and not isinstance(iou_thresholds, list):
             raise ValueError(
                 f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
@@ -119,7 +121,11 @@ class MeanAveragePrecision(Metric):
 
     @property
     def _is_segm(self) -> bool:
-        return self.iou_type[0] == "segm"
+        return "segm" in self.iou_type
+
+    @property
+    def _is_bbox(self) -> bool:
+        return "bbox" in self.iou_type
 
     def update(self, preds: Sequence[Dict[str, Any]], target: Sequence[Dict[str, Any]]) -> None:
         """Append per-image detections/ground truths (reference ``mean_ap.py:477-519``).
@@ -127,9 +133,10 @@ class MeanAveragePrecision(Metric):
         For ``segm``, masks are RLE-encoded immediately through the native
         codec (reference ``mean_ap.py:824-857`` does the same via pycocotools)
         so the stored state is compact run-length bytes, not dense masks.
+        With the mixed ``("bbox", "segm")`` tuple both geometries are stored.
         """
         _input_validator(preds, target, iou_type=self.iou_type)
-        segm = self._is_segm
+        segm, bbox = self._is_segm, self._is_bbox
         if segm:
             from torchmetrics_tpu.functional.detection import mask_utils
 
@@ -148,7 +155,7 @@ class MeanAveragePrecision(Metric):
         for item in preds:
             if segm:
                 self.detection_mask.append(_to_rle_list(item["masks"]))
-            else:
+            if bbox:
                 self.detection_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
             self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32).reshape(-1))
             self.detection_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
@@ -156,7 +163,7 @@ class MeanAveragePrecision(Metric):
             n = np.asarray(item["labels"]).size
             if segm:
                 self.groundtruth_mask.append(_to_rle_list(item["masks"]))
-            else:
+            if bbox:
                 self.groundtruth_box.append(jnp.asarray(item["boxes"], jnp.float32).reshape(-1, 4))
             self.groundtruth_labels.append(jnp.asarray(item["labels"], jnp.int32).reshape(-1))
             crowds = item.get("iscrowd")
@@ -168,32 +175,75 @@ class MeanAveragePrecision(Metric):
                 jnp.asarray(area, jnp.float32).reshape(-1) if area is not None else jnp.zeros(0, jnp.float32)
             )
 
+    def _mixed_target_areas(self) -> List[np.ndarray]:
+        """Ground-truth bin areas for the mixed ``("bbox", "segm")`` mode.
+
+        The reference's mixed-mode annotations carry ``area`` = user-provided
+        value where positive, else the MASK area (``mean_ap.py:915-922``:
+        the fallback is ``mask_utils.area`` whenever ``"segm" in iou_type``),
+        and target areas are NOT swapped per pass — only detection areas are.
+        """
+        from torchmetrics_tpu.functional.detection import mask_utils
+
+        areas = []
+        for gt_masks, a in zip(self.groundtruth_mask, self.groundtruth_area):
+            marea = (
+                np.asarray(mask_utils.area(gt_masks), np.float64).reshape(-1)
+                if gt_masks
+                else np.zeros(0, np.float64)
+            )
+            ua = np.asarray(a, np.float64).reshape(-1)
+            if ua.size == marea.size and ua.size:
+                marea = np.where(ua > 0, ua, marea)
+            areas.append(marea)
+        return areas
+
     def compute(self) -> Dict[str, Array]:
-        """Run the pure-JAX COCO evaluation over the accumulated stream."""
-        segm = self._is_segm
-        geom_key = "masks" if segm else "boxes"
-        det_geom = self.detection_mask if segm else self.detection_box
-        gt_geom = self.groundtruth_mask if segm else self.groundtruth_box
-        preds = [
-            {geom_key: g, "scores": s, "labels": l}
-            for g, s, l in zip(det_geom, self.detection_scores, self.detection_labels)
-        ]
-        target = [
-            {geom_key: g, "labels": l, "iscrowd": c, "area": (a if np.asarray(a).size else None)}
-            for g, l, c, a in zip(gt_geom, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area)
-        ]
-        return coco_mean_average_precision(
-            preds,
-            target,
-            box_format=self.box_format,
-            iou_thresholds=self.iou_thresholds,
-            rec_thresholds=self.rec_thresholds,
-            max_detection_thresholds=self.max_detection_thresholds,
-            class_metrics=self.class_metrics,
-            extended_summary=self.extended_summary,
-            average=self.average,
-            iou_type=self.iou_type[0],
-        )
+        """Run the pure-JAX COCO evaluation over the accumulated stream.
+
+        One pass per iou type; with the mixed tuple every result key gains an
+        ``{iou_type}_`` prefix (reference ``mean_ap.py:526-558``) and
+        ``classes`` stays unprefixed.
+        """
+        mixed = len(self.iou_type) > 1
+        mixed_areas = self._mixed_target_areas() if mixed else None
+        results: Dict[str, Array] = {}
+        classes = None
+        for i_type in self.iou_type:
+            prefix = f"{i_type}_" if mixed else ""
+            segm = i_type == "segm"
+            geom_key = "masks" if segm else "boxes"
+            det_geom = self.detection_mask if segm else self.detection_box
+            gt_geom = self.groundtruth_mask if segm else self.groundtruth_box
+            preds = [
+                {geom_key: g, "scores": s, "labels": l}
+                for g, s, l in zip(det_geom, self.detection_scores, self.detection_labels)
+            ]
+            target = []
+            for i, (g, l, c, a) in enumerate(
+                zip(gt_geom, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area)
+            ):
+                area = mixed_areas[i] if mixed else (a if np.asarray(a).size else None)
+                target.append({geom_key: g, "labels": l, "iscrowd": c, "area": area})
+            res = coco_mean_average_precision(
+                preds,
+                target,
+                box_format=self.box_format,
+                iou_thresholds=self.iou_thresholds,
+                rec_thresholds=self.rec_thresholds,
+                max_detection_thresholds=self.max_detection_thresholds,
+                class_metrics=self.class_metrics,
+                extended_summary=self.extended_summary,
+                average=self.average,
+                iou_type=i_type,
+            )
+            if not mixed:
+                return res
+            classes = res.pop("classes")
+            for key, val in res.items():
+                results[prefix + key] = val
+        results["classes"] = classes
+        return results
 
     def _sync_dist(self, dist_sync_fn=gather_all_arrays, process_group=None) -> None:
         """Multi-host sync: tensor states ride the generic pad/trim gather,
@@ -233,7 +283,8 @@ class MeanAveragePrecision(Metric):
         import json
 
         iou_type = _validate_iou_type_arg(iou_type)
-        segm = iou_type[0] == "segm"
+        segm = "segm" in iou_type
+        bbox = "bbox" in iou_type
         with open(coco_target) as f:
             gt_data = json.load(f)
         with open(coco_preds) as f:
@@ -261,25 +312,58 @@ class MeanAveragePrecision(Metric):
                         f"Annotation references image_id {ann['image_id']!r} which is not in the target"
                         " file's image list — mismatched prediction/target files?"
                     )
-                x, y, w, h = ann["bbox"] if not segm else (0, 0, 0, 0)
-                if not segm:
-                    entry["boxes"].append([x, y, x + w, y + h])
-                else:
-                    seg = ann["segmentation"]
+                def _parse_segmentation(a):
+                    """Annotation segmentation -> RLE dict, or None if absent."""
+                    seg = a.get("segmentation")
+                    if seg is None:
+                        return None
                     if isinstance(seg, list):
                         # polygon format: rasterize through the native codec
-                        img_meta = img_sizes.get(ann["image_id"])
+                        img_meta = img_sizes.get(a["image_id"])
                         if img_meta is None:
                             raise ValueError(
                                 "Polygon segmentations need image height/width in the target file's"
-                                f" images entry for image_id {ann['image_id']!r}."
+                                f" images entry for image_id {a['image_id']!r}."
                             )
-                        entry["masks"].append(mask_utils.from_polygons(seg, img_meta[0], img_meta[1]))
+                        return mask_utils.from_polygons(seg, img_meta[0], img_meta[1])
+                    counts = seg["counts"]
+                    if isinstance(counts, (str, bytes)):
+                        counts = mask_utils.rle_from_string(counts)
+                    return {"size": seg["size"], "counts": np.asarray(counts, np.uint32)}
+
+                rle = _parse_segmentation(ann) if (segm or "bbox" not in ann) else None
+                if segm:
+                    if rle is None:
+                        # loadRes back-fills segm results that only carry a
+                        # box as the box's rectangle polygon — mirror that
+                        if "bbox" not in ann:
+                            raise ValueError(
+                                f"Annotation for image_id {ann['image_id']!r} has neither"
+                                " 'segmentation' nor 'bbox'; cannot build masks."
+                            )
+                        img_meta = img_sizes.get(ann["image_id"])
+                        if img_meta is None:
+                            raise ValueError(
+                                "Deriving a mask from a bare bbox needs image height/width in the"
+                                f" target file's images entry for image_id {ann['image_id']!r}."
+                            )
+                        x, y, w, h = ann["bbox"]
+                        rle = mask_utils.from_polygons(
+                            [[x, y, x, y + h, x + w, y + h, x + w, y]], img_meta[0], img_meta[1]
+                        )
+                    entry["masks"].append(rle)
+                if bbox:
+                    if "bbox" in ann:
+                        x, y, w, h = ann["bbox"]
+                    elif rle is not None:
+                        # loadRes derives the box from the mask (rleToBbox)
+                        x, y, w, h = mask_utils.to_bbox(rle).tolist()
                     else:
-                        counts = seg["counts"]
-                        if isinstance(counts, (str, bytes)):
-                            counts = mask_utils.rle_from_string(counts)
-                        entry["masks"].append({"size": seg["size"], "counts": np.asarray(counts, np.uint32)})
+                        raise ValueError(
+                            f"Annotation for image_id {ann['image_id']!r} has no 'bbox' and no"
+                            " segmentation to derive one from."
+                        )
+                    entry["boxes"].append([x, y, x + w, y + h])
                 entry["labels"].append(ann["category_id"])
                 entry["crowds"].append(ann.get("iscrowd", 0))
                 entry["area"].append(ann.get("area"))
@@ -291,7 +375,7 @@ class MeanAveragePrecision(Metric):
                 item: Dict[str, Any] = {"labels": np.asarray(e["labels"], np.int64)}
                 if segm:
                     item["masks"] = e["masks"]
-                else:
+                if bbox:
                     item["boxes"] = np.asarray(e["boxes"], np.float64).reshape(-1, 4)
                 if with_scores:
                     item["scores"] = np.asarray(e["scores"], np.float64)
@@ -324,7 +408,7 @@ class MeanAveragePrecision(Metric):
         from torchmetrics_tpu.functional.detection import mask_utils
         from torchmetrics_tpu.functional.detection.helpers import box_convert
 
-        segm = self._is_segm
+        segm, bbox = self._is_segm, self._is_bbox
 
         def _boxes_to_xyxy(boxes):
             boxes = np.asarray(boxes, np.float64).reshape(-1, 4)
@@ -348,8 +432,8 @@ class MeanAveragePrecision(Metric):
             labels = np.asarray(self.groundtruth_labels[i])
             crowds = np.asarray(self.groundtruth_crowds[i])
             areas = np.asarray(self.groundtruth_area[i])
-            gt_boxes_xyxy = None if segm else _boxes_to_xyxy(self.groundtruth_box[i])
-            det_boxes_xyxy = None if segm else _boxes_to_xyxy(self.detection_box[i])
+            gt_boxes_xyxy = _boxes_to_xyxy(self.groundtruth_box[i]) if bbox else None
+            det_boxes_xyxy = _boxes_to_xyxy(self.detection_box[i]) if bbox else None
             for j in range(labels.size):
                 ann: Dict[str, Any] = {
                     "id": ann_id,
@@ -361,10 +445,11 @@ class MeanAveragePrecision(Metric):
                     rle = self.groundtruth_mask[i][j]
                     ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
                     ann["area"] = float(areas[j]) if areas.size else float(mask_utils.area(rle))
-                else:
+                if bbox:
                     box = gt_boxes_xyxy[j]
                     ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
-                    ann["area"] = float(areas[j]) if areas.size else float((box[2] - box[0]) * (box[3] - box[1]))
+                    if "area" not in ann:  # mixed mode keeps the reference's mask-area fallback
+                        ann["area"] = float(areas[j]) if areas.size else float((box[2] - box[0]) * (box[3] - box[1]))
                 gt_annotations.append(ann)
                 ann_id += 1
             scores = np.asarray(self.detection_scores[i])
@@ -374,7 +459,7 @@ class MeanAveragePrecision(Metric):
                 if segm:
                     rle = self.detection_mask[i][j]
                     ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
-                else:
+                if bbox:
                     box = det_boxes_xyxy[j]
                     ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
                 pred_annotations.append(ann)

@@ -84,6 +84,31 @@ def area(rles: Union[RLE, Sequence[RLE]]) -> np.ndarray:
     return out[0] if single else out
 
 
+def to_bbox(rles: Union[RLE, Sequence[RLE]]) -> np.ndarray:
+    """Tight ``[x, y, w, h]`` bounding box(es) of RLE mask(s) — the
+    pycocotools ``rleToBbox`` rule: a foreground run spanning a column
+    boundary covers the full mask height."""
+    single = isinstance(rles, dict)
+    out = []
+    for r in [rles] if single else rles:
+        h, _w = (int(v) for v in r["size"])
+        cnts = np.asarray(r["counts"], np.int64)
+        ends = np.cumsum(cnts)
+        starts = ends - cnts
+        s, e = starts[1::2], ends[1::2] - 1  # inclusive bounds of 1-runs
+        if s.size == 0 or h == 0:
+            out.append([0.0, 0.0, 0.0, 0.0])
+            continue
+        xs, xe = s // h, e // h
+        spans = xe > xs
+        ys = np.where(spans, 0, s % h)
+        ye = np.where(spans, h - 1, e % h)
+        x0, x1 = xs.min(), xe.max()
+        y0, y1 = ys.min(), ye.max()
+        out.append([float(x0), float(y0), float(x1 - x0 + 1), float(y1 - y0 + 1)])
+    return np.asarray(out[0] if single else out, np.float64)
+
+
 def iou(dt: Sequence[RLE], gt: Sequence[RLE], iscrowd: Optional[Sequence[int]] = None) -> np.ndarray:
     """Crowd-aware IoU matrix ``(len(dt), len(gt))`` between RLE sets."""
     dt, gt = list(dt), list(gt)
