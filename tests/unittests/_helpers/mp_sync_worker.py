@@ -107,6 +107,138 @@ def main() -> None:
     objs2 = _gather_objects_via_bytes(("payload", pid, b"x" * (1 + 100 * pid)))
     assert len(objs2) == nproc and objs2[1][2] == b"x" * 101, objs2
 
+    # 6) END-TO-END MeanAveragePrecision, bbox AND segm (VERDICT r4 next #4):
+    # each rank updates with its half of the images; compute() must route the
+    # box/score/label array states through the pad/trim gather and the RLE
+    # mask states through the object gather IN THE SAME RANK ORDER, matching
+    # the single-process evaluation of all images.
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    def boxes_to_masks(bxs, h=96, w=96):
+        m = np.zeros((len(bxs), h, w), np.uint8)
+        for i, (x1, y1, x2, y2) in enumerate(np.asarray(bxs, int)):
+            m[i, y1:y2, x1:x2] = 1
+        return m
+
+    det_rng = np.random.RandomState(7)  # identical on both ranks
+    imgs_p, imgs_t = [], []
+    for _ in range(4):
+        n_gt, n_dt = det_rng.randint(1, 4), det_rng.randint(1, 5)
+        g_xy = det_rng.randint(0, 40, (n_gt, 2))
+        g_boxes = np.concatenate([g_xy, g_xy + det_rng.randint(8, 40, (n_gt, 2))], 1).clip(0, 95).astype(np.float64)
+        d_xy = det_rng.randint(0, 40, (n_dt, 2))
+        d_boxes = np.concatenate([d_xy, d_xy + det_rng.randint(8, 40, (n_dt, 2))], 1).clip(0, 95).astype(np.float64)
+        if n_dt and n_gt:
+            d_boxes[0] = g_boxes[0] + det_rng.randint(-3, 4, 4)
+            d_boxes[0, 2:] = np.maximum(d_boxes[0, 2:], d_boxes[0, :2] + 1)
+            d_boxes = d_boxes.clip(0, 95)
+        imgs_p.append({
+            "boxes": d_boxes, "masks": boxes_to_masks(d_boxes),
+            "scores": det_rng.rand(n_dt), "labels": det_rng.randint(0, 2, n_dt),
+        })
+        imgs_t.append({
+            "boxes": g_boxes, "masks": boxes_to_masks(g_boxes),
+            "labels": det_rng.randint(0, 2, n_gt),
+        })
+
+    for iou_type in ("bbox", ("bbox", "segm")):
+        ref = MeanAveragePrecision(iou_type=iou_type, distributed_available_fn=lambda: False)
+        ref.update(imgs_p, imgs_t)
+        want_map = ref.compute()
+        mine = MeanAveragePrecision(iou_type=iou_type)
+        lo_i, hi_i = (0, 2) if pid == 0 else (2, 4)
+        mine.update(imgs_p[lo_i:hi_i], imgs_t[lo_i:hi_i])
+        got_map = mine.compute()
+        for key in want_map:
+            np.testing.assert_allclose(
+                np.asarray(got_map[key]), np.asarray(want_map[key]), atol=1e-7,
+                err_msg=f"mAP {iou_type} sync: {key}",
+            )
+
+    # 7) MetricCollection with a compute group across the process group
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import BinaryF1Score, BinaryPrecision
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    def new_collection(dist=True):
+        kw = {} if dist else {"distributed_available_fn": lambda: False}
+        return MetricCollection({
+            "f1": BinaryF1Score(**kw), "prec": BinaryPrecision(**kw), "mse": MeanSquaredError(**kw),
+        })
+
+    coll_ref = new_collection(dist=False)
+    coll_ref.update(preds, target)
+    want_coll = {k: float(v) for k, v in coll_ref.compute().items()}
+    coll = new_collection()
+    coll.update(preds[lo:hi], target[lo:hi])
+    got_coll = {k: float(v) for k, v in coll.compute().items()}
+    for key, val in want_coll.items():
+        assert abs(got_coll[key] - val) < 1e-6, f"collection sync {key}: {got_coll[key]} != {val}"
+
+    # 8) text metrics — host-side string states (sum-state WER/CHRF, n-gram
+    # count BLEU) across the process group: the replica regime for the domain
+    # that cannot ride shard_map
+    from torchmetrics_tpu.text import BLEUScore, CHRFScore, WordErrorRate
+
+    corpus_p = ["the cat sat on a mat", "hello there general", "completely different phrase", "one two three four"]
+    corpus_t = ["the cat sat on the mat", "hello there general kenobi", "totally different phrase", "one two three four"]
+    text_cases = [
+        (WordErrorRate, {}, corpus_p, corpus_t),
+        (CHRFScore, {}, corpus_p, corpus_t),
+        (BLEUScore, {}, corpus_p, [[t] for t in corpus_t]),
+    ]
+    for cls, kw, cp, ct in text_cases:
+        ref_m = cls(distributed_available_fn=lambda: False, **kw)
+        ref_m.update(cp, ct)
+        want = float(ref_m.compute())
+        mine_m = cls(**kw)
+        mine_m.update(cp[2 * pid : 2 * pid + 2], ct[2 * pid : 2 * pid + 2])
+        got = float(mine_m.compute())
+        assert abs(got - want) < 1e-6, f"{cls.__name__} sync: {got} != {want}"
+
+    # 9) remaining host-input detection classes: box IoU (per-image list
+    # states through the interleaved gather) and panoptic quality (host
+    # preprocessing + sum states)
+    from torchmetrics_tpu.detection import IntersectionOverUnion, PanopticQuality
+
+    iou_ref = IntersectionOverUnion(distributed_available_fn=lambda: False)
+    iou_preds = [{"boxes": p["boxes"], "scores": p["scores"], "labels": p["labels"]} for p in imgs_p]
+    iou_tgts = [{"boxes": t["boxes"], "labels": t["labels"]} for t in imgs_t]
+    iou_ref.update(iou_preds, iou_tgts)
+    want_iou = float(iou_ref.compute()["iou"])
+    iou_m = IntersectionOverUnion()
+    iou_m.update(iou_preds[lo_i:hi_i], iou_tgts[lo_i:hi_i])
+    got_iou = float(iou_m.compute()["iou"])
+    assert abs(got_iou - want_iou) < 1e-6, f"IoU sync: {got_iou} != {want_iou}"
+
+    pq_rng = np.random.RandomState(11)
+    pq_p = pq_rng.randint(0, 3, (4, 12, 12, 2))
+    pq_t = pq_rng.randint(0, 3, (4, 12, 12, 2))
+    pq_kw = {"things": {0, 1}, "stuffs": {2}}
+    pq_ref = PanopticQuality(distributed_available_fn=lambda: False, **pq_kw)
+    pq_ref.update(pq_p, pq_t)
+    want_pq = float(pq_ref.compute())
+    pq_m = PanopticQuality(**pq_kw)
+    pq_m.update(pq_p[lo_i:hi_i], pq_t[lo_i:hi_i])
+    got_pq = float(pq_m.compute())
+    assert abs(got_pq - want_pq) < 1e-6, f"PanopticQuality sync: {got_pq} != {want_pq}"
+
+    # 10) multimodal: CLIPScore (embedded tower + scalar sum states) with the
+    # tiny deterministic CLIP both ranks construct identically
+    from tests.unittests.multimodal.test_clip_and_bert import _tiny_clip
+    from torchmetrics_tpu.multimodal import CLIPScore
+
+    clip_model, clip_proc = _tiny_clip()
+    imgs = np.random.RandomState(5).randint(0, 255, (4, 3, 32, 32)).astype(np.uint8)
+    texts = ["a cat", "a dog on grass", "blue car", "red house"]
+    cs_ref = CLIPScore(model=clip_model, processor=clip_proc, distributed_available_fn=lambda: False)
+    cs_ref.update(list(imgs), texts)
+    want_cs = float(cs_ref.compute())
+    cs_m = CLIPScore(model=clip_model, processor=clip_proc)
+    cs_m.update(list(imgs[lo_i:hi_i]), texts[lo_i:hi_i])
+    got_cs = float(cs_m.compute())
+    assert abs(got_cs - want_cs) < 1e-4, f"CLIPScore sync: {got_cs} != {want_cs}"
+
     print(f"rank {pid}: all multi-process sync checks passed")
 
 

@@ -263,7 +263,15 @@ class MeanAveragePrecision(Metric):
         """Multi-host sync: tensor states ride the generic pad/trim gather,
         RLE mask states (Python dicts, not arrays) go through the host
         object gather — the analogue of the reference's
-        ``all_gather_object`` path (``mean_ap.py:1029-1061``)."""
+        ``all_gather_object`` path (``mean_ap.py:1029-1061``).
+
+        The base gather merges list states INTERLEAVED by element index
+        (``[r0_img0, r1_img0, r0_img1, ...]`` — one collective per local
+        element, reference ``metric.py:435-474`` does the same), so the mask
+        lists must interleave identically or masks desync from their
+        scores/labels rows (caught by the 2-process mAP segm check in
+        ``mp_sync_worker.py``).
+        """
         from torchmetrics_tpu.utilities.distributed import gather_all_objects
 
         mask_states = {}
@@ -276,8 +284,10 @@ class MeanAveragePrecision(Metric):
             for attr, local in mask_states.items():
                 gathered = gather_all_objects(local)
                 merged: list = []
-                for proc_masks in gathered:
-                    merged.extend(proc_masks)
+                for i in range(max((len(pm) for pm in gathered), default=0)):
+                    for proc_masks in gathered:
+                        if i < len(proc_masks):
+                            merged.append(proc_masks[i])
                 setattr(self, attr, merged)
 
     @staticmethod

@@ -8,6 +8,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torchmetrics_tpu.utilities.checks import _is_concrete
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -113,13 +114,14 @@ def _nominal_confmat(
     preds = jnp.argmax(preds, axis=1) if preds.ndim == 2 else preds
     target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
     preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
-    max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
-    min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))
-    if max_label >= num_classes or min_label < 0:
-        raise ValueError(
-            f"Detected label values in [{min_label}, {max_label}] but `num_classes`={num_classes}; nominal"
-            " metrics expect labels in 0..num_classes-1. Relabel the data or pass a larger `num_classes`."
-        )
+    if _is_concrete(preds) and _is_concrete(target):  # skip under jit/shard_map tracing
+        max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
+        min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))
+        if max_label >= num_classes or min_label < 0:
+            raise ValueError(
+                f"Detected label values in [{min_label}, {max_label}] but `num_classes`={num_classes}; nominal"
+                " metrics expect labels in 0..num_classes-1. Relabel the data or pass a larger `num_classes`."
+            )
     return _multiclass_confusion_matrix_update(preds.astype(jnp.int32), target.astype(jnp.int32), num_classes)
 
 

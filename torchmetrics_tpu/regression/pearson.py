@@ -63,12 +63,21 @@ class PearsonCorrCoef(Metric):
     def _merged_states(self):
         """States, merged across gathered shards when they arrive stacked
         (reference ``pearson.py:159-170``): returns
-        ``(mean_x, mean_y, var_x, var_y, corr_xy, n_total)``."""
+        ``(mean_x, mean_y, var_x, var_y, corr_xy, n_total)``.
+
+        Stacked states may carry MULTIPLE shard axes (e.g. repeated
+        ``sharded_update`` folds stack a (devices, outputs) gather per step
+        into (steps, devices, outputs)); all leading axes flatten into one
+        shard axis before the parallel-variance merge."""
         if (self.num_outputs == 1 and jnp.asarray(self.mean_x).size > 1) or (
             self.num_outputs > 1 and jnp.asarray(self.mean_x).ndim > 1
         ):
+            def shards(v):
+                return jnp.asarray(v).reshape(-1, self.num_outputs)
+
             return _final_aggregation(
-                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+                shards(self.mean_x), shards(self.mean_y), shards(self.var_x),
+                shards(self.var_y), shards(self.corr_xy), shards(self.n_total),
             )
         return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
 
