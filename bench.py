@@ -155,8 +155,12 @@ def bench_ours(n_batches: int, repeats: int = 5):
     return runs, per_batch * n_batches if per_batch else None
 
 
-def bench_reference(n_batches: int) -> float:
-    """Reference TorchMetrics on torch (CPU in this image), same suite."""
+def ensure_reference_importable() -> None:
+    """Make the reference torchmetrics importable from ``/root/reference/src``
+    (CPU torch build): installs a minimal ``lightning_utilities`` shim and
+    prepends the reference source tree to ``sys.path``. Idempotent; shared by
+    ``bench_reference`` and the per-workload torch-CPU baselines in
+    ``bench_workloads``."""
     import types
 
     # minimal shim for the reference's lightning_utilities import surface
@@ -238,7 +242,13 @@ def bench_reference(n_batches: int) -> float:
         sys.modules["lightning_utilities.core.enums"] = enums_mod
         sys.modules["lightning_utilities.core.rank_zero"] = rank_zero_mod
 
-    sys.path.insert(0, "/root/reference/src")
+    if "/root/reference/src" not in sys.path:
+        sys.path.insert(0, "/root/reference/src")
+
+
+def bench_reference(n_batches: int) -> float:
+    """Reference TorchMetrics on torch (CPU in this image), same suite."""
+    ensure_reference_importable()
     import torch
     from torchmetrics import MetricCollection
     from torchmetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
@@ -305,15 +315,24 @@ def main() -> None:
             bench_fid50k,
             bench_retrieval_ndcg,
             bench_ssim,
+            bench_wer,
         )
 
         for name, fn, args in (
-            ("ssim", bench_ssim, (max(4, n_batches // 2),)),
-            ("retrieval_ndcg", bench_retrieval_ndcg, (max(4, n_batches // 2),)),
+            ("wer", bench_wer, (max(512, n_batches * 256),)),
+            # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;
+            # at the old 8 batches it was ~0.15s and the tunnel's per-execution
+            # jitter (±50-300ms) alone explained r3's 1140 -> r4's 709 img/s
+            # swing (VERDICT r4 weak #5)
+            ("ssim", bench_ssim, (max(32, n_batches * 4),)),
+            ("retrieval_ndcg", bench_retrieval_ndcg, (max(32, n_batches * 4),)),
             ("coco_map", bench_coco_map, ()),
             ("coco_map_scale", bench_coco_map_scale, ()),
             ("fid50k", bench_fid50k, ()),
-            ("bertscore", bench_bertscore, (max(64, n_batches * 16),)),
+            # repeats=2: the bertscore leg compiles two corpus programs over
+            # the tunnel (~2 min); two timed runs per leg keeps the whole
+            # workload under ~5 min so the bench never outruns the driver
+            ("bertscore", bench_bertscore, (max(64, n_batches * 16), 2)),
         ):
             if time.perf_counter() - t_start > budget_s:
                 extras[name] = {"skipped": "time budget"}

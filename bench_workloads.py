@@ -154,13 +154,103 @@ def _synth_detections(n_images, n_dets, n_gts, n_classes, seed=0):
     return preds, target
 
 
-def bench_coco_map(repeats: int = 3) -> Dict:
-    """Images/sec of full COCO-style mAP evaluation (vectorized JAX matching).
+def _legacy_torch_map_baseline(n_images: int, n_dets: int, n_gts: int, n_classes: int, seed: int) -> Optional[float]:
+    """Images/s of the reference's pure-torch legacy COCO evaluator
+    (``/root/reference/src/torchmetrics/detection/_mean_ap.py`` — the
+    987-LoC no-pycocotools implementation) on the same synthetic shapes,
+    on CPU.
 
-    The reference backend (pycocotools C/CPU) is not installed in this image,
-    so no live baseline — the number stands alone until measured on a host
-    with pycocotools.
+    pycocotools/torchvision are absent from this image; the legacy evaluator
+    only uses them for trivial geometry helpers in the bbox path, so those
+    are stubbed in pure torch (box_area/box_iou/box_convert — standard
+    formulas). The matching/accumulation hot loops being timed are 100%
+    reference code.
     """
+    import importlib.machinery
+    import importlib.util
+    import sys
+    import types
+
+    import bench
+
+    bench.ensure_reference_importable()
+    import torch
+
+    def stub(name):
+        mod = sys.modules.get(name)
+        if mod is None:
+            mod = types.ModuleType(name)
+            mod.__spec__ = importlib.machinery.ModuleSpec(name, None)
+            sys.modules[name] = mod
+        return mod
+
+    # only stub packages that are genuinely absent — on a host where the real
+    # torchvision/pycocotools are installed the legacy eval must use them
+    # (and a stub left in sys.modules would shadow them process-wide)
+    have_tv = importlib.util.find_spec("torchvision") is not None
+    have_pc = importlib.util.find_spec("pycocotools") is not None
+    if not have_tv:
+        ops = stub("torchvision.ops")
+        if not hasattr(ops, "box_iou"):
+            def box_area(b):
+                return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+            def box_iou(a, b):
+                area1, area2 = box_area(a), box_area(b)
+                lt = torch.max(a[:, None, :2], b[None, :, :2])
+                rb = torch.min(a[:, None, 2:], b[None, :, 2:])
+                wh = (rb - lt).clamp(min=0)
+                inter = wh[..., 0] * wh[..., 1]
+                return inter / (area1[:, None] + area2[None, :] - inter)
+
+            def box_convert(boxes, in_fmt, out_fmt):
+                if in_fmt == out_fmt:
+                    return boxes
+                raise NotImplementedError((in_fmt, out_fmt))
+
+            ops.box_area, ops.box_iou, ops.box_convert = box_area, box_iou, box_convert
+            tv = stub("torchvision")
+            tv.ops = ops
+            tv.__version__ = "0.15"
+    if not have_pc:
+        stub("pycocotools")
+        stub("pycocotools.mask")
+
+    import torchmetrics.detection._mean_ap as legacy
+
+    if not have_pc:
+        legacy._PYCOCOTOOLS_AVAILABLE = True
+    if not have_tv:
+        legacy._TORCHVISION_GREATER_EQUAL_0_8 = True
+
+    preds, target = _synth_detections(n_images, n_dets, n_gts, n_classes, seed=seed)
+    tp = [
+        {
+            "boxes": torch.from_numpy(np.asarray(p["boxes"], np.float32)),
+            "scores": torch.from_numpy(np.asarray(p["scores"], np.float32)),
+            "labels": torch.from_numpy(np.asarray(p["labels"])).long(),
+        }
+        for p in preds
+    ]
+    tt = [
+        {
+            "boxes": torch.from_numpy(np.asarray(t["boxes"], np.float32)),
+            "labels": torch.from_numpy(np.asarray(t["labels"])).long(),
+        }
+        for t in target
+    ]
+    metric = legacy.MeanAveragePrecision()
+    t0 = time.perf_counter()
+    metric.update(tp, tt)
+    metric.compute()
+    return n_images / (time.perf_counter() - t0)
+
+
+def bench_coco_map(repeats: int = 3) -> Dict:
+    """Images/sec of full COCO-style mAP evaluation (vectorized JAX matching)
+    vs the reference's pure-torch legacy evaluator on CPU (pycocotools'
+    C backend is not installed in this image; the legacy eval is the
+    reference's own torch implementation of the same algorithm)."""
     from torchmetrics_tpu.functional.detection.map import coco_mean_average_precision
 
     preds, target = _synth_detections(MAP_IMAGES, MAP_DETS, MAP_GTS, 40)
@@ -172,7 +262,16 @@ def bench_coco_map(repeats: int = 3) -> Dict:
         # r4 on-device accumulate — without the float() this times enqueue
         float(coco_mean_average_precision(preds, target)["map"])
         runs.append(MAP_IMAGES / (time.perf_counter() - t0))
-    return {"runs": runs, "unit": "images/s", "baseline": None}
+    try:
+        baseline = _legacy_torch_map_baseline(MAP_IMAGES, MAP_DETS, MAP_GTS, 40, seed=0)
+    except Exception:
+        baseline = None
+    return {
+        "runs": runs,
+        "unit": "images/s",
+        "baseline": baseline,
+        "baseline_note": "reference legacy pure-torch COCO eval on CPU (same shapes)",
+    }
 
 
 def bench_coco_map_scale(repeats: int = 3) -> Dict:
@@ -192,10 +291,18 @@ def bench_coco_map_scale(repeats: int = 3) -> Dict:
         dt = time.perf_counter() - t0
         elapsed.append(round(dt, 2))
         runs.append(MAP_SCALE_IMAGES / dt)
+    # torch-CPU baseline on a 64-image subset of the same shapes: the legacy
+    # eval is per-image Python loops, so its img/s is shape-dependent but not
+    # corpus-size-dependent (measured 6.1 img/s at 8 imgs, 9.5 at 32)
+    try:
+        baseline = _legacy_torch_map_baseline(64, MAP_SCALE_DETS, MAP_SCALE_GTS, MAP_SCALE_CLASSES, seed=1)
+    except Exception:
+        baseline = None
     return {
         "runs": runs,
         "unit": "images/s",
-        "baseline": None,
+        "baseline": baseline,
+        "baseline_note": "reference legacy pure-torch COCO eval on CPU, 64-image subset of the same shapes",
         "images": MAP_SCALE_IMAGES,
         "dets_per_image": MAP_SCALE_DETS,
         "classes": MAP_SCALE_CLASSES,
@@ -279,17 +386,6 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
         np.asarray(fn_rep(*rep_args))
         tr_runs.append(time.perf_counter() - t0)
 
-    t1_med = sorted(t1_runs)[len(t1_runs) // 2]
-    extra_pairs = (r_big - 1) * n_pairs
-    marg = [(tr - t1_med) / extra_pairs for tr in tr_runs]  # s/pair per repeat
-    runs = [1.0 / m for m in marg if m > 0]
-    marginal_valid = bool(runs)
-    if not marginal_valid:  # tunnel noise swallowed the slope entirely
-        runs = [n_pairs / t for t in t1_runs]
-    pos = sorted(m for m in marg if m > 0)
-    marg_med = pos[len(pos) // 2] if pos else t1_med / n_pairs
-    marginal_corpus_s = marg_med * n_pairs
-
     # XLA's own FLOP count of one chunk body (lax.map bodies count once —
     # see _program_flops caveat), scaled to the corpus
     single = jax.jit(_make_fused_score_fn(model, num_layers, False))
@@ -297,6 +393,27 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
     zf = np.full((1, batch_size, seq), 1.0 / seq, np.float32)
     per_chunk = _program_flops(single, model.params, zi, zi, zi, zf, zi, zi, zi, zf)
     flops = per_chunk * n_chunks if per_chunk else None
+
+    t1_med = sorted(t1_runs)[len(t1_runs) // 2]
+    extra_pairs = (r_big - 1) * n_pairs
+    marg = [(tr - t1_med) / extra_pairs for tr in tr_runs]  # s/pair per repeat
+    # median over ALL slopes (negatives included) — dropping noise-negative
+    # repeats before the median would bias the headline upward
+    marg_med = sorted(marg)[len(marg) // 2]
+    marginal_valid = marg_med > 0
+    # physical-bound sanity: a slope faster than the chip's bf16 peak on the
+    # XLA-counted FLOPs is tunnel noise, not throughput (197e12 = v5e-1 peak,
+    # same constant bench.py divides by for mfu_pct)
+    if marginal_valid and flops and marg_med * n_pairs < flops / 197e12:
+        marginal_valid = False
+    if marginal_valid:
+        runs = [1.0 / m for m in marg if m > 0]
+        if len(runs) != len(marg):  # degenerate band: quote only the median
+            runs = [1.0 / marg_med]
+    else:  # tunnel noise swallowed or inverted the slope this session
+        runs = [n_pairs / t for t in t1_runs]
+        marg_med = t1_med / n_pairs
+    marginal_corpus_s = marg_med * n_pairs
 
     baseline = None
     try:
@@ -331,6 +448,7 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
         "corpus_pairs": n_pairs,
         "scan_repeats": r_big,
         "repeat_runs_s": [round(t, 2) for t in sorted(tr_runs)],
+        "raw_slopes_ms_per_pair": [round(1e3 * m, 4) for m in marg],
     }
 
 
@@ -407,11 +525,95 @@ def bench_fid50k(n_batches: int = FID50K_BATCHES) -> Dict:
         dt = time.perf_counter() - t0
         runs.append(n_images / dt)
         elapsed.append(round(dt, 1))
+    # torch-CPU baseline: the repo's torch mirror of the same Inception tower
+    # (tests/unittests/_helpers/torch_towers.py, identical architecture and
+    # feature taps) over a 32-image subset — the tower forward dominates the
+    # FID feature pass on both sides
+    baseline = None
+    try:
+        import os
+        import sys
+
+        import torch
+
+        helpers = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests", "unittests", "_helpers")
+        if helpers not in sys.path:
+            sys.path.insert(0, helpers)
+        from torch_towers import TorchFIDInception
+
+        tower = TorchFIDInception().eval()
+        t_imgs = torch.from_numpy(
+            np.random.default_rng(0).integers(0, 256, (16, 3, 299, 299), dtype=np.uint8)
+        )
+        with torch.no_grad():
+            tower(t_imgs)  # warm
+            t0 = time.perf_counter()
+            for _ in range(2):
+                tower(t_imgs)
+            baseline = 32 / (time.perf_counter() - t0)
+    except Exception:
+        pass
     return {
         "runs": runs,
         "unit": "images/s",
-        "baseline": None,
+        "baseline": baseline,
+        "baseline_note": "torch-CPU twin of the Inception tower, 32-image subset",
         "images": n_images,
         "elapsed_s": max(elapsed),
         "program_flops": flops,
+    }
+
+
+def bench_wer(n_pairs: int = 4096, repeats: int = 3) -> Dict:
+    """Sentences/sec of corpus word-error-rate — the text dynamic-programming
+    workload. Ours runs the token-interned batch edit distance through the
+    native C++ kernel (``native/edit_distance.cpp``, OpenMP over pairs);
+    the baseline is the reference's pure-Python per-pair DP
+    (``/root/reference/src/torchmetrics/functional/text/helper.py:329``,
+    the ``_edit_distance`` hot loop of ``word_error_rate``) on the same
+    corpus. Host CPU both sides — this workload never touches the TPU.
+    """
+    from torchmetrics_tpu.functional.text.wer import word_error_rate
+
+    rng = np.random.default_rng(0)
+    vocab = [f"w{i}" for i in range(2000)]
+
+    def sentence(lo=15, hi=60):
+        return " ".join(rng.choice(vocab, rng.integers(lo, hi)))
+
+    target = [sentence() for _ in range(n_pairs)]
+    # realistic error mix: drop/substitute some words
+    preds = []
+    for t in target:
+        toks = t.split()
+        toks = [w for w in toks if rng.random() > 0.1]
+        toks = [w if rng.random() > 0.1 else rng.choice(vocab) for w in toks]
+        preds.append(" ".join(toks) if toks else "w0")
+
+    float(word_error_rate(preds, target))  # warm (interning caches nothing, but JIT-free anyway)
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(word_error_rate(preds, target))
+        runs.append(n_pairs / (time.perf_counter() - t0))
+
+    baseline = None
+    try:
+        import bench
+
+        bench.ensure_reference_importable()
+        from torchmetrics.functional.text.wer import word_error_rate as ref_wer
+
+        n_b = min(1024, n_pairs)
+        t0 = time.perf_counter()
+        float(ref_wer(preds[:n_b], target[:n_b]))
+        baseline = n_b / (time.perf_counter() - t0)
+    except Exception:
+        pass
+    return {
+        "runs": runs,
+        "unit": "sentences/s",
+        "baseline": baseline,
+        "baseline_note": "reference word_error_rate (pure-Python DP) on CPU, 1024-sentence subset",
+        "pairs": n_pairs,
     }
