@@ -404,6 +404,226 @@ _EXAMPLES = {
     >>> round(float(metric.compute()), 4)
     -14.4344
     """,
+    # ------------------------------------- bases (subclassing contracts)
+    "metric.Metric": """
+    >>> import numpy as np
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu import Metric
+    >>> class CountPositives(Metric):
+    ...     def __init__(self, **kwargs):
+    ...         super().__init__(**kwargs)
+    ...         self.add_state("count", default=jnp.asarray(0), dist_reduce_fx="sum")
+    ...     def update(self, values):
+    ...         self.count = self.count + (jnp.asarray(values) > 0).sum()
+    ...     def compute(self):
+    ...         return self.count
+    >>> metric = CountPositives()
+    >>> metric.update(np.array([1.0, -2.0, 3.0]))
+    >>> metric.update(np.array([4.0, -5.0]))
+    >>> int(metric.compute())
+    3
+    """,
+    "metric.CompositionalMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MeanSquaredError
+    >>> metric = MeanSquaredError() * 2  # arithmetic on metrics builds a CompositionalMetric
+    >>> metric.update(np.array([2.5, 0.0, 2.0, 8.0]), np.array([3.0, -0.5, 2.0, 7.0]))
+    >>> round(float(metric.compute()), 4)
+    0.75
+    """,
+    "retrieval.base.RetrievalMetric": """
+    >>> import numpy as np
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.retrieval import RetrievalMetric
+    >>> class RetrievalFirstRelevant(RetrievalMetric):  # rank of first relevant doc
+    ...     def _metric_row(self, preds, target, valid):
+    ...         # masked-row kernel, vmapped over the padded query grid
+    ...         key = jnp.where(valid, preds, -jnp.inf)
+    ...         order = jnp.argsort(-key)
+    ...         hit = (target[order] > 0) & valid[order]
+    ...         return jnp.argmax(hit).astype(jnp.float32) + 1.0
+    >>> metric = RetrievalFirstRelevant()
+    >>> metric.update(np.array([0.9, 0.2, 0.8]), np.array([0, 0, 1]), indexes=np.array([0, 0, 0]))
+    >>> round(float(metric.compute()), 4)
+    2.0
+    """,
+    # ----------------------------------------------------------- wrappers
+    "wrappers.abstract.WrapperMetric": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.wrappers import WrapperMetric
+    >>> from torchmetrics_tpu import MeanSquaredError
+    >>> class NegatedMetric(WrapperMetric):  # wraps any metric, negates compute()
+    ...     def __init__(self, base, **kwargs):
+    ...         super().__init__(**kwargs)
+    ...         self.base = base
+    ...     def update(self, *args, **kwargs):
+    ...         self.base.update(*args, **kwargs)
+    ...     def compute(self):
+    ...         return -self.base.compute()
+    >>> metric = NegatedMetric(MeanSquaredError())
+    >>> metric.update(np.array([2.5, 0.0, 2.0, 8.0]), np.array([3.0, -0.5, 2.0, 7.0]))
+    >>> round(float(metric.compute()), 4)
+    -0.375
+    """,
+    "wrappers.bootstrapping.BootStrapper": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.wrappers import BootStrapper
+    >>> from torchmetrics_tpu import MeanSquaredError
+    >>> metric = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=7)
+    >>> metric.update(np.array([2.5, 0.0, 2.0, 8.0], np.float32), np.array([3.0, -0.5, 2.0, 7.0], np.float32))
+    >>> sorted(metric.compute())
+    ['mean', 'std']
+    """,
+    "wrappers.running.Running": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.wrappers import Running
+    >>> from torchmetrics_tpu import MeanMetric
+    >>> metric = Running(MeanMetric(), window=2)
+    >>> for v in (1.0, 2.0, 3.0):
+    ...     metric.update(np.array([v], np.float32))
+    >>> round(float(metric.compute()), 4)  # mean of the last 2 updates
+    2.5
+    """,
+    "wrappers.transformations.BinaryTargetTransformer": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.wrappers import BinaryTargetTransformer
+    >>> from torchmetrics_tpu.classification import BinaryAccuracy
+    >>> metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=2)
+    >>> metric.update(np.array([1, 0, 1, 1]), np.array([0.0, 1.0, 4.0, 3.0]))  # targets binarize at > 2
+    >>> round(float(metric.compute()), 4)
+    0.75
+    """,
+    "wrappers.transformations.LambdaInputTransformer": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.wrappers import LambdaInputTransformer
+    >>> from torchmetrics_tpu.classification import BinaryAccuracy
+    >>> metric = LambdaInputTransformer(BinaryAccuracy(), transform_pred=lambda p: 1 - p)
+    >>> metric.update(np.array([0.9, 0.1, 0.2]), np.array([0, 1, 1]))
+    >>> round(float(metric.compute()), 4)
+    1.0
+    """,
+    "wrappers.transformations.MetricInputTransformer": """
+    >>> import numpy as np
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.wrappers import MetricInputTransformer
+    >>> from torchmetrics_tpu import MeanSquaredError
+    >>> class ClampInputs(MetricInputTransformer):  # subclass the transform hook
+    ...     def transform_pred(self, pred):
+    ...         return jnp.clip(pred, 0.0, 1.0)
+    >>> metric = ClampInputs(MeanSquaredError())
+    >>> metric.update(np.array([1.5, 0.5], np.float32), np.array([1.0, 0.5], np.float32))
+    >>> round(float(metric.compute()), 4)
+    0.0
+    """,
+    # ------------------------- tower / dep-gated classes (usage contracts;
+    # values need pretrained weights or optional deps, so examples are +SKIP
+    # like the reference's pretrained-model docstrings)
+    "image.fid.FrechetInceptionDistance": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import FrechetInceptionDistance
+    >>> metric = FrechetInceptionDistance(feature=2048)  # doctest: +SKIP
+    >>> imgs = np.random.randint(0, 255, (8, 3, 299, 299), dtype=np.uint8)  # doctest: +SKIP
+    >>> metric.update(imgs, real=True)  # doctest: +SKIP
+    >>> metric.update(imgs, real=False)  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "image.inception_score.InceptionScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import InceptionScore
+    >>> metric = InceptionScore()  # doctest: +SKIP
+    >>> metric.update(np.random.randint(0, 255, (8, 3, 299, 299), dtype=np.uint8))  # doctest: +SKIP
+    >>> mean, std = metric.compute()  # doctest: +SKIP
+    """,
+    "image.kid.KernelInceptionDistance": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import KernelInceptionDistance
+    >>> metric = KernelInceptionDistance(subset_size=4)  # doctest: +SKIP
+    >>> imgs = np.random.randint(0, 255, (8, 3, 299, 299), dtype=np.uint8)  # doctest: +SKIP
+    >>> metric.update(imgs, real=True)  # doctest: +SKIP
+    >>> metric.update(imgs, real=False)  # doctest: +SKIP
+    >>> mean, std = metric.compute()  # doctest: +SKIP
+    """,
+    "image.lpip.LearnedPerceptualImagePatchSimilarity": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+    >>> metric = LearnedPerceptualImagePatchSimilarity(net_type='alex')  # doctest: +SKIP
+    >>> a = np.random.rand(4, 3, 64, 64).astype(np.float32) * 2 - 1  # doctest: +SKIP
+    >>> b = np.random.rand(4, 3, 64, 64).astype(np.float32) * 2 - 1  # doctest: +SKIP
+    >>> metric.update(a, b)  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "image.mifid.MemorizationInformedFrechetInceptionDistance": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import MemorizationInformedFrechetInceptionDistance
+    >>> metric = MemorizationInformedFrechetInceptionDistance(feature=2048)  # doctest: +SKIP
+    >>> imgs = np.random.randint(0, 255, (8, 3, 299, 299), dtype=np.uint8)  # doctest: +SKIP
+    >>> metric.update(imgs, real=True)  # doctest: +SKIP
+    >>> metric.update(imgs, real=False)  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "image.perceptual_path_length.PerceptualPathLength": """
+    >>> from torchmetrics_tpu.image import PerceptualPathLength
+    >>> metric = PerceptualPathLength(num_samples=8)  # doctest: +SKIP
+    >>> metric.update(generator)  # a GeneratorLike with sample()/forward  # doctest: +SKIP
+    >>> mean, std, lengths = metric.compute()  # doctest: +SKIP
+    """,
+    "audio.metrics.DeepNoiseSuppressionMeanOpinionScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import DeepNoiseSuppressionMeanOpinionScore
+    >>> metric = DeepNoiseSuppressionMeanOpinionScore(fs=16000, personalized=False)  # doctest: +SKIP
+    >>> metric.update(np.random.randn(16000).astype(np.float32))  # doctest: +SKIP
+    >>> metric.compute()  # p808_mos, sig, bak, ovr  # doctest: +SKIP
+    """,
+    "audio.metrics.PerceptualEvaluationSpeechQuality": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
+    >>> metric = PerceptualEvaluationSpeechQuality(16000, 'wb')  # doctest: +SKIP
+    >>> target = np.random.randn(16000).astype(np.float32)  # doctest: +SKIP
+    >>> metric.update(target + 0.01 * np.random.randn(16000).astype(np.float32), target)  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "audio.metrics.ShortTimeObjectiveIntelligibility": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import ShortTimeObjectiveIntelligibility
+    >>> metric = ShortTimeObjectiveIntelligibility(fs=16000)  # doctest: +SKIP
+    >>> target = np.random.randn(16000).astype(np.float32)  # doctest: +SKIP
+    >>> metric.update(target + 0.1 * np.random.randn(16000).astype(np.float32), target)  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "audio.metrics.SpeechReverberationModulationEnergyRatio": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+    >>> metric = SpeechReverberationModulationEnergyRatio(fs=8000)  # doctest: +SKIP
+    >>> metric.update(np.random.randn(8000).astype(np.float32))  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "text.bert.BERTScore": """
+    >>> from torchmetrics_tpu.text import BERTScore
+    >>> metric = BERTScore(model_name_or_path='bert-base-uncased')  # doctest: +SKIP
+    >>> metric.update(['the cat sat on the mat'], ['a cat sat on the mat'])  # doctest: +SKIP
+    >>> metric.compute()  # {'precision': ..., 'recall': ..., 'f1': ...}  # doctest: +SKIP
+    """,
+    "text.infolm.InfoLM": """
+    >>> from torchmetrics_tpu.text import InfoLM
+    >>> metric = InfoLM('google/bert_uncased_L-2_H-128_A-2', idf=False)  # doctest: +SKIP
+    >>> metric.update(['the cat sat on the mat'], ['a cat sat on the mat'])  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "multimodal.clip_score.CLIPScore": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.multimodal import CLIPScore
+    >>> metric = CLIPScore(model_name_or_path='openai/clip-vit-base-patch16')  # doctest: +SKIP
+    >>> imgs = np.random.randint(0, 255, (1, 3, 224, 224), dtype=np.uint8)  # doctest: +SKIP
+    >>> metric.update(list(imgs), ['a photo of a cat'])  # doctest: +SKIP
+    >>> float(metric.compute())  # doctest: +SKIP
+    """,
+    "multimodal.clip_iqa.CLIPImageQualityAssessment": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+    >>> metric = CLIPImageQualityAssessment(prompts=('quality',))  # doctest: +SKIP
+    >>> metric.update(np.random.rand(1, 3, 224, 224).astype(np.float32))  # doctest: +SKIP
+    >>> metric.compute()  # doctest: +SKIP
+    """,
     # ------------------------------------------------------------- collections
     "collections.MetricCollection": """
     >>> import numpy as np
