@@ -322,3 +322,79 @@ def test_sensitivity_at_specificity_and_reverse():
     m.update(preds, target)
     s2, _ = m.compute()
     np.testing.assert_allclose(float(s2), expected_spec, atol=1e-6)
+
+
+def test_at_fixed_constraint_device_selection_matches_host_oracle():
+    """r5: the *AtFixedX selections run on device. The jit-safe masked-maxima
+    lexargmax must match the reference-ported host implementations on random
+    curves INCLUDING ties, and the binned functionals must be jittable."""
+    from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+        _lex_best_at_constraint_device,
+        _lexargmax,
+    )
+    from torchmetrics_tpu.functional.classification.sensitivity_specificity import (
+        _first_best_at_constraint_device,
+    )
+
+    rng = np.random.RandomState(17)
+    for trial in range(30):
+        n = rng.randint(1, 20)
+        # heavy ties: quantized values
+        primary = np.round(rng.rand(n), 1).astype(np.float32)
+        constraint = np.round(rng.rand(n), 1).astype(np.float32)
+        thr = np.round(rng.rand(n), 1).astype(np.float32)
+        min_c = float(rng.choice([0.0, 0.3, 0.7, 1.1]))  # 1.1 -> empty mask
+
+        # host oracle, PR family (lexargmax + zero-primary sentinel)
+        zipped = np.stack([primary, constraint, thr], 1)
+        masked = zipped[constraint >= min_c]
+        if masked.shape[0]:
+            i = _lexargmax(masked)
+            want_p, _, want_t = masked[i]
+        else:
+            want_p, want_t = 0.0, 0.0
+        if want_p == 0.0:
+            want_t = 1e6
+        got_p, got_t = _lex_best_at_constraint_device(primary, constraint, thr, min_c)
+        assert float(got_p) == np.float32(want_p), (trial, "lex primary")
+        assert float(got_t) == np.float32(want_t), (trial, "lex threshold")
+
+        # host oracle, ROC family (first max among masked, no sentinel-on-zero)
+        if masked.shape[0]:
+            j = int(np.argmax(masked[:, 0]))
+            want_p2, want_t2 = masked[j, 0], masked[j, 2]
+        else:
+            want_p2, want_t2 = 0.0, 1e6
+        got_p2, got_t2 = _first_best_at_constraint_device(primary, constraint, thr, min_c)
+        assert float(got_p2) == np.float32(want_p2), (trial, "first primary")
+        assert float(got_t2) == np.float32(want_t2), (trial, "first threshold")
+
+
+def test_at_fixed_constraint_binned_functionals_are_jittable():
+    """Binned-mode *AtFixedX functionals compile end-to-end under jit and
+    match their eager values (round 5; previously the selection forced a
+    host round-trip)."""
+    import jax
+
+    from torchmetrics_tpu.functional.classification import (
+        binary_precision_at_fixed_recall,
+        binary_recall_at_fixed_precision,
+        binary_sensitivity_at_specificity,
+        binary_specificity_at_sensitivity,
+    )
+
+    rng = np.random.RandomState(3)
+    p = rng.rand(64).astype(np.float32)
+    t = rng.randint(0, 2, 64)
+    for fn, arg in (
+        (binary_recall_at_fixed_precision, 0.5),
+        (binary_precision_at_fixed_recall, 0.5),
+        (binary_sensitivity_at_specificity, 0.5),
+        (binary_specificity_at_sensitivity, 0.5),
+    ):
+        eager = fn(p, t, arg, thresholds=21)
+        jitted = jax.jit(
+            lambda pp, tt, f=fn, a=arg: f(pp, tt, a, thresholds=21, validate_args=False)
+        )(p, t)
+        np.testing.assert_allclose(float(jitted[0]), float(eager[0]), atol=1e-7, err_msg=str(fn))
+        np.testing.assert_allclose(float(jitted[1]), float(eager[1]), atol=1e-7, err_msg=str(fn))

@@ -24,6 +24,7 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
 from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
     _binary_recall_at_fixed_precision_arg_validation,
     _binary_recall_at_fixed_precision_compute,
+    _lex_best_at_constraint_device,
     _lexargmax,
     _multiclass_recall_at_fixed_precision_arg_compute,
     _multiclass_recall_at_fixed_precision_arg_validation,
@@ -40,18 +41,9 @@ def _precision_at_recall(
     thresholds: Array,
     min_recall: float,
 ) -> Tuple[Array, Array]:
-    """Max precision whose recall >= min_recall (reference ``:37-55``)."""
-    precision, recall, thresholds = np.asarray(precision), np.asarray(recall), np.asarray(thresholds)
-    max_precision, best_threshold = 0.0, 0.0
-    n = min(len(recall), len(precision), len(thresholds))
-    zipped = np.stack([precision[:n], recall[:n], thresholds[:n]], axis=1)
-    zipped_masked = zipped[zipped[:, 1] >= min_recall]
-    if zipped_masked.shape[0] > 0:
-        idx = _lexargmax(zipped_masked)
-        max_precision, _, best_threshold = zipped_masked[idx]
-    if max_precision == 0.0:
-        best_threshold = 1e6
-    return jnp.asarray(max_precision, jnp.float32), jnp.asarray(best_threshold, jnp.float32)
+    """Max precision whose recall >= min_recall (reference ``:37-55``),
+    on device."""
+    return _lex_best_at_constraint_device(precision, recall, thresholds, min_recall)
 
 
 def binary_precision_at_fixed_recall(

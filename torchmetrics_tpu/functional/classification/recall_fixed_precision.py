@@ -3,8 +3,11 @@
 """Recall at fixed precision (reference
 ``src/torchmetrics/functional/classification/recall_fixed_precision.py``).
 
-Curve evaluation happens on-device (binned mode); the final argmax over the
-handful of curve points runs host-side in numpy — it is O(T) scalar work.
+Both the curve AND the constrained-argmax selection run on device: the
+lexicographic tie-break of the reference's ``_lexargmax`` (primary value,
+then secondary, then threshold, then first row) is expressed as sequential
+masked maxima, so the whole binned-mode functional is jittable (round 5;
+exact mode still compacts its curve on host first).
 """
 from __future__ import annotations
 
@@ -36,7 +39,8 @@ Array = jax.Array
 
 
 def _lexargmax(x: np.ndarray) -> int:
-    """Index of the lexicographic maximum row (reference ``:40-55``)."""
+    """Index of the lexicographic maximum row (reference ``:40-55``; host
+    fallback kept as the differential oracle for the device selection)."""
     idx: Optional[np.ndarray] = None
     for k in range(x.shape[1]):
         col = x[idx, k] if idx is not None else x[:, k]
@@ -49,24 +53,46 @@ def _lexargmax(x: np.ndarray) -> int:
     return int(idx[0])
 
 
+def _lex_best_at_constraint_device(
+    primary: Array, constraint: Array, thresholds: Array, min_constraint: float
+) -> Tuple[Array, Array]:
+    """Jit-safe ``_lexargmax`` over ``(primary, constraint, threshold)`` rows
+    restricted to ``constraint >= min_constraint``.
+
+    The lexicographic order resolves as sequential masked maxima: maximize
+    primary, break ties by the constraint column, then by threshold, then
+    first row (``jnp.argmax`` returns the first index of a maximum). Static
+    shapes, no host sync.
+    """
+    primary = jnp.asarray(primary)
+    constraint = jnp.asarray(constraint)
+    thresholds = jnp.asarray(thresholds)
+    n = min(primary.shape[0], constraint.shape[0], thresholds.shape[0])
+    primary, constraint, thresholds = primary[:n], constraint[:n], thresholds[:n]
+    valid = constraint >= min_constraint
+    p = jnp.where(valid, primary, -jnp.inf)
+    m1 = valid & (p == p.max())
+    c = jnp.where(m1, constraint, -jnp.inf)
+    m2 = m1 & (c == c.max())
+    t = jnp.where(m2, thresholds, -jnp.inf)
+    idx = jnp.argmax(t)
+    has = valid.any()
+    best_primary = jnp.where(has, primary[idx], 0.0).astype(jnp.float32)
+    best_threshold = jnp.where(
+        has & (best_primary != 0.0), thresholds[idx], 1e6
+    ).astype(jnp.float32)
+    return best_primary, best_threshold
+
+
 def _recall_at_precision(
     precision: Array,
     recall: Array,
     thresholds: Array,
     min_precision: float,
 ) -> Tuple[Array, Array]:
-    """Max recall whose precision >= min_precision (reference ``:58-76``)."""
-    precision, recall, thresholds = np.asarray(precision), np.asarray(recall), np.asarray(thresholds)
-    max_recall, best_threshold = 0.0, 0.0
-    n = min(len(recall), len(precision), len(thresholds))
-    zipped = np.stack([recall[:n], precision[:n], thresholds[:n]], axis=1)
-    zipped_masked = zipped[zipped[:, 1] >= min_precision]
-    if zipped_masked.shape[0] > 0:
-        idx = _lexargmax(zipped_masked)
-        max_recall, _, best_threshold = zipped_masked[idx]
-    if max_recall == 0.0:
-        best_threshold = 1e6
-    return jnp.asarray(max_recall, jnp.float32), jnp.asarray(best_threshold, jnp.float32)
+    """Max recall whose precision >= min_precision (reference ``:58-76``),
+    on device."""
+    return _lex_best_at_constraint_device(recall, precision, thresholds, min_precision)
 
 
 def _binary_recall_at_fixed_precision_arg_validation(

@@ -38,22 +38,36 @@ def _convert_fpr_to_specificity(fpr: Array) -> Array:
     return 1 - fpr
 
 
+def _first_best_at_constraint_device(
+    primary: Array, constraint: Array, thresholds: Array, min_constraint: float
+) -> Tuple[Array, Array]:
+    """Jit-safe ``argmax(primary)`` among points with
+    ``constraint >= min_constraint`` — the ROC-family selection (FIRST
+    maximum wins, no lexicographic tie-break, no zero-value threshold
+    sentinel; empty constraint set -> ``(0, 1e6)``). Masking with ``-inf``
+    preserves the reference's compact-then-argmax first-match order."""
+    primary = jnp.asarray(primary)
+    constraint = jnp.asarray(constraint)
+    thresholds = jnp.asarray(thresholds)
+    n = min(primary.shape[0], constraint.shape[0], thresholds.shape[0])
+    primary, constraint, thresholds = primary[:n], constraint[:n], thresholds[:n]
+    valid = constraint >= min_constraint
+    idx = jnp.argmax(jnp.where(valid, primary, -jnp.inf))
+    has = valid.any()
+    best = jnp.where(has, primary[idx], 0.0).astype(jnp.float32)
+    best_threshold = jnp.where(has, thresholds[idx], 1e6).astype(jnp.float32)
+    return best, best_threshold
+
+
 def _sensitivity_at_specificity(
     sensitivity: Array,
     specificity: Array,
     thresholds: Array,
     min_specificity: float,
 ) -> Tuple[Array, Array]:
-    """Max sensitivity whose specificity >= min_specificity (reference ``:47-71``)."""
-    sensitivity, specificity, thresholds = (np.asarray(sensitivity), np.asarray(specificity), np.asarray(thresholds))
-    indices = specificity >= min_specificity
-    if not indices.any():
-        max_sens, best_threshold = 0.0, 1e6
-    else:
-        sensitivity, thresholds = sensitivity[indices], thresholds[indices]
-        idx = int(np.argmax(sensitivity))
-        max_sens, best_threshold = sensitivity[idx], thresholds[idx]
-    return jnp.asarray(max_sens, jnp.float32), jnp.asarray(best_threshold, jnp.float32)
+    """Max sensitivity whose specificity >= min_specificity (reference
+    ``:47-71``), on device."""
+    return _first_best_at_constraint_device(sensitivity, specificity, thresholds, min_specificity)
 
 
 def _binary_sensitivity_at_specificity_arg_validation(

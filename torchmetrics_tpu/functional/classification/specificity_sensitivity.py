@@ -29,6 +29,7 @@ from torchmetrics_tpu.functional.classification.roc import (
 from torchmetrics_tpu.functional.classification.sensitivity_specificity import (
     _binary_sensitivity_at_specificity_arg_validation,
     _convert_fpr_to_specificity,
+    _first_best_at_constraint_device,
     _multiclass_sensitivity_at_specificity_arg_validation,
     _multilabel_sensitivity_at_specificity_arg_validation,
 )
@@ -42,16 +43,9 @@ def _specificity_at_sensitivity(
     thresholds: Array,
     min_sensitivity: float,
 ) -> Tuple[Array, Array]:
-    """Max specificity whose sensitivity >= min_sensitivity (reference ``:48-72``)."""
-    specificity, sensitivity, thresholds = (np.asarray(specificity), np.asarray(sensitivity), np.asarray(thresholds))
-    indices = sensitivity >= min_sensitivity
-    if not indices.any():
-        max_spec, best_threshold = 0.0, 1e6
-    else:
-        specificity, thresholds = specificity[indices], thresholds[indices]
-        idx = int(np.argmax(specificity))
-        max_spec, best_threshold = specificity[idx], thresholds[idx]
-    return jnp.asarray(max_spec, jnp.float32), jnp.asarray(best_threshold, jnp.float32)
+    """Max specificity whose sensitivity >= min_sensitivity (reference
+    ``:48-72``), on device."""
+    return _first_best_at_constraint_device(specificity, sensitivity, thresholds, min_sensitivity)
 
 
 def _binary_specificity_at_sensitivity_compute(
