@@ -25,7 +25,6 @@ from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
     _binary_recall_at_fixed_precision_arg_validation,
     _binary_recall_at_fixed_precision_compute,
     _lex_best_at_constraint_device,
-    _lexargmax,
     _multiclass_recall_at_fixed_precision_arg_compute,
     _multiclass_recall_at_fixed_precision_arg_validation,
     _multilabel_recall_at_fixed_precision_arg_compute,
