@@ -380,8 +380,13 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
     rep_args = (chunk(preds["input_ids"]), chunk(mask), chunk(pm), chunk(sc),
                 chunk(target["input_ids"]), chunk(mask), chunk(pm), chunk(sc))
     np.asarray(fn_rep(*rep_args))  # compile + warm
+    t1_med = sorted(t1_runs)[len(t1_runs) // 2]
+    # slow-regime bound: when the tunnel charges >35 s per execution, each
+    # extra repeat costs ~a minute; one slope estimate keeps the whole
+    # workload under ~7 min so the driver's bench never runs out of clock
+    rep_repeats = repeats if t1_med < 35 else 1
     tr_runs = []
-    for _ in range(repeats):
+    for _ in range(rep_repeats):
         t0 = time.perf_counter()
         np.asarray(fn_rep(*rep_args))
         tr_runs.append(time.perf_counter() - t0)
@@ -394,7 +399,6 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
     per_chunk = _program_flops(single, model.params, zi, zi, zi, zf, zi, zi, zi, zf)
     flops = per_chunk * n_chunks if per_chunk else None
 
-    t1_med = sorted(t1_runs)[len(t1_runs) // 2]
     extra_pairs = (r_big - 1) * n_pairs
     marg = [(tr - t1_med) / extra_pairs for tr in tr_runs]  # s/pair per repeat
     # median over ALL slopes (negatives included) — dropping noise-negative
