@@ -29,7 +29,12 @@ def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable] = "mea
     if aggregation == "mean":
         return values.mean() if dim is None else values.mean(axis=dim)
     if aggregation == "median":
-        return jnp.median(values) if dim is None else jnp.median(values, axis=dim)
+        # torch.median returns the LOWER of the two middle elements on even
+        # counts (the reference's semantics, ``base.py:33``); jnp.median
+        # would average them. No dim = flatten, like torch.median().
+        v, axis = (values.ravel(), 0) if dim is None else (values, dim)
+        k = max((v.shape[axis] - 1) // 2, 0)
+        return jnp.sort(v, axis=axis).take(k, axis=axis)
     if aggregation == "min":
         return values.min() if dim is None else values.min(axis=dim)
     if aggregation == "max":
