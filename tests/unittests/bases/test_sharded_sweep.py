@@ -18,6 +18,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
+from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio as _SI_SNR
 from torchmetrics_tpu.parallel import sharded_update
 
 NUM_DEVICES = 8
@@ -91,6 +92,18 @@ def _seg_onehot(b=8, c=3, s=16):
 
 def _vals(n=64):
     return [(_RNG.randn(n).astype(np.float32),) for _ in range(2)]
+
+
+def _perplexity_data(n=16, t=12, v=11):
+    return [(_RNG.randn(n, t, v).astype(np.float32), _RNG.randint(0, v, (n, t))) for _ in range(2)]
+
+
+def _pit_stream(b=8, s=2, t=128):
+    out = []
+    for _ in range(2):
+        tgt = _RNG.randn(b, s, t).astype(np.float32)
+        out.append(((tgt + 0.2 * _RNG.randn(b, s, t)).astype(np.float32), tgt))
+    return out
 
 
 # ---- case table: (id, domain, class name, kwargs, stream builder) ---------
@@ -175,7 +188,45 @@ CASES = [
     ("max_metric", "aggregation", "MaxMetric", {}, _vals),
     ("min_metric", "aggregation", "MinMetric", {}, _vals),
     ("cat_metric", "aggregation", "CatMetric", {}, _vals),
+    # classification — ranking family (multilabel rank statistics)
+    ("ml_coverage", "classification", "MultilabelCoverageError", {"num_labels": 4}, _ml),
+    ("ml_rank_loss", "classification", "MultilabelRankingLoss", {"num_labels": 4}, _ml),
+    # text — array-input metric rides shard_map directly (host-input text
+    # metrics take the 2-process replica regime, mp_sync_worker.py)
+    ("perplexity", "text", "Perplexity", {}, _perplexity_data),
 ]
+
+# Wrapper metrics: constructors take wrapped metric instances, so they build
+# via factories rather than the (cls, kwargs) grid. The deep state walk of
+# ``parallel.sharded`` shards the wrapper AND its children in one program;
+# ``Running``'s event-indexed window folds via its ``_fold_sharded_state``
+# rotation override.
+def _wrapper_cases():
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassF1Score
+    from torchmetrics_tpu.regression import MeanSquaredError
+    from torchmetrics_tpu.wrappers import ClasswiseWrapper, MinMaxMetric, MultioutputWrapper, Running
+
+    def _multi_reg(n=64, k=3):
+        return [(_RNG.randn(n, k).astype(np.float32), _RNG.randn(n, k).astype(np.float32)) for _ in range(3)]
+
+    from torchmetrics_tpu.audio import PermutationInvariantTraining
+
+    return [
+        # callable-constructor metric (kwargs forward to the metric_func, so
+        # it can't take the grid's validate_args injection — as upstream)
+        ("pit_si_snr", lambda: PermutationInvariantTraining(_SI_SNR), _pit_stream),
+        ("wrap_minmax", lambda: MinMaxMetric(BinaryAccuracy()), _bin),
+        (
+            "wrap_multioutput",
+            lambda: MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False),
+            _multi_reg,
+        ),
+        ("wrap_classwise", lambda: ClasswiseWrapper(MulticlassF1Score(num_classes=5, average=None)), _mc),
+        ("wrap_running_mean_w3", lambda: Running(MeanMetric(), window=3), lambda: [(v,) for v, in _vals()] * 3),
+        ("wrap_running_sum_w2", lambda: Running(SumMetric(), window=2), _vals),
+        ("wrap_running_mse_w3", lambda: Running(MeanSquaredError(), window=3), _multi_reg),
+    ]
 
 
 def _resolve(domain, cls_name):
@@ -222,18 +273,115 @@ def test_sharded_equals_replicated(name, domain, cls_name, kwargs, make_stream):
     _cmp(sharded.compute(), replicated.compute(), name)
 
 
+@pytest.mark.parametrize("name,make_metric,make_stream", _wrapper_cases(), ids=[c[0] for c in _wrapper_cases()])
+def test_wrapper_sharded_equals_replicated(name, make_metric, make_stream):
+    """Wrappers shard end-to-end: the deep state walk syncs wrapper AND child
+    states in one mesh program (reference analogue: wrapper tests under the
+    ddp leg, ``tests/unittests/wrappers/*``)."""
+    replicated, sharded = make_metric(), make_metric()
+    mesh = _mesh()
+    for batch in make_stream():
+        replicated.update(*batch)
+        sharded_update(sharded, mesh, *batch)
+    _cmp(sharded.compute(), replicated.compute(), name)
+
+
+def test_running_wrapper_mean_state_base_metric():
+    """Regression (r5 review): the sharded fold must leave ``Running``'s base
+    metric pristine. Folding it bumps its update count, and a base metric
+    with a ``dist_reduce_fx='mean'`` state then weights its running average
+    differently than the replicated path inside ``Running.compute``."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.metric import Metric
+    from torchmetrics_tpu.wrappers import Running
+
+    class MeanState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("v", jnp.asarray(0.0), dist_reduce_fx="mean")
+
+        def update(self, x):
+            self.v = jnp.mean(jnp.asarray(x))
+
+        def compute(self):
+            return self.v
+
+    replicated, sharded = Running(MeanState(), window=3), Running(MeanState(), window=3)
+    mesh = _mesh()
+    for _ in range(5):
+        (x,) = _vals()[0]
+        replicated.update(x)
+        sharded_update(sharded, mesh, x)
+    np.testing.assert_allclose(np.asarray(sharded.compute()), np.asarray(replicated.compute()), rtol=1e-6)
+    assert sharded.base_metric._update_count == replicated.base_metric._update_count == 0
+
+
+def test_bootstrapper_refuses_jit_update():
+    """``make_jit_update`` must refuse untraceable metrics just like
+    ``make_sharded_update`` — not bake the trace-time RNG draw into the step."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.parallel import make_jit_update
+    from torchmetrics_tpu.wrappers import BootStrapper
+
+    with pytest.raises(ValueError, match="does not support a traced update step"):
+        make_jit_update(BootStrapper(BinaryAccuracy(), num_bootstraps=3))
+
+
+def test_jit_update_refuses_wrapper_children():
+    """``make_jit_update``'s state pytree covers only the root registry, so
+    wrappers with child metrics must be refused (the deep walk belongs to
+    ``sharded_update``), not silently dropped from the compiled state."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.parallel import make_jit_update
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+
+    with pytest.raises(ValueError, match="wraps child metrics"):
+        make_jit_update(MinMaxMetric(BinaryAccuracy()))
+
+
+def test_multioutput_remove_nans_refuses_sharded_update():
+    """``remove_nans=True`` boolean-mask row dropping has no static shape; the
+    sharded regime must point at the ``remove_nans=False`` workaround instead
+    of dying inside jit with a NonConcreteBooleanIndexError."""
+    from torchmetrics_tpu.regression import MeanSquaredError
+    from torchmetrics_tpu.wrappers import MultioutputWrapper
+
+    wrapped = MultioutputWrapper(MeanSquaredError(), num_outputs=3)
+    p, t = _RNG.randn(64, 3).astype(np.float32), _RNG.randn(64, 3).astype(np.float32)
+    with pytest.raises(ValueError, match="remove_nans=False"):
+        sharded_update(wrapped, _mesh(), p, t)
+
+
+def test_bootstrapper_refuses_sharded_update():
+    """BootStrapper's per-update host resampling cannot be traced: a sharded
+    step would freeze the resample indices at trace time and silently produce
+    correlated bootstrap copies. The sharded regime must refuse, not mistrace."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.wrappers import BootStrapper
+
+    boot = BootStrapper(BinaryAccuracy(), num_bootstraps=3)
+    preds, target = _bin()[0]
+    with pytest.raises(ValueError, match="does not support sharded_update"):
+        sharded_update(boot, _mesh(), preds, target)
+
+
 def test_sweep_covers_every_array_domain_with_three_classes():
     """Gate: every array-input domain keeps >=3 distribution-tested classes
-    (segmentation has exactly its 2 public classes — both covered). Host
-    domains (text, detection, multimodal) are covered by the 2-process
-    replica suite instead (mp_sync_worker.py)."""
+    (segmentation has exactly its 2 public classes — both covered; text has
+    exactly 1 array-input metric, Perplexity). Host-input domains (text
+    n-gram/DP metrics, detection dict inputs, multimodal) are covered by the
+    2-process replica suite instead (mp_sync_worker.py)."""
     counts = {}
     for _, domain, cls_name, _, _ in CASES:
         counts.setdefault(domain, set()).add(cls_name)
     for domain, want in {
         "classification": 3, "regression": 3, "image": 3, "audio": 3,
         "retrieval": 3, "clustering": 3, "nominal": 3, "segmentation": 2,
-        "aggregation": 3,
+        "aggregation": 3, "text": 1,
     }.items():
         assert len(counts.get(domain, ())) >= want, (domain, counts.get(domain))
-    assert sum(len(v) for v in counts.values()) >= 50
+    assert len({c[0] for c in _wrapper_cases()}) >= 4  # wrappers under sharding
+    assert sum(len(v) for v in counts.values()) + len(_wrapper_cases()) >= 60

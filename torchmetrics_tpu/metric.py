@@ -27,7 +27,7 @@ from __future__ import annotations
 import functools
 import inspect
 from copy import deepcopy
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -97,6 +97,20 @@ class Metric:
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
+
+    #: extra host-side (non-array) attributes the sharded regime must
+    #: snapshot/restore around traced updates (e.g. ``Running._num_vals_seen``)
+    _host_counters: Tuple[str, ...] = ()
+
+    #: set to an explanatory string on metrics whose ``update`` cannot run
+    #: under a traced ``parallel.sharded_update`` step (e.g. per-update host
+    #: randomness); the sharded regime raises it instead of mistracing
+    _sharded_update_unsupported: Optional[str] = None
+
+    #: False on wrappers that consume child-metric state per update event and
+    #: reset the child (``Running``): the sharded fold then leaves the
+    #: children untouched, exactly like the replicated path does
+    _sharded_fold_children: bool = True
 
     plot_lower_bound: Optional[float] = None
     plot_upper_bound: Optional[float] = None
@@ -225,6 +239,24 @@ class Metric:
         """Snapshot the current state. Arrays are immutable so refs suffice;
         list states need a shallow copy (reference ``metric.py:336``)."""
         return {attr: list(v) if isinstance(v, list) else v for attr, v in self.state_tree().items()}
+
+    def _fold_sharded_state(self, part: Dict[str, Any], prev_count: int) -> None:
+        """Fold one merged sharded-update event (``parallel.sharded_update``)
+        into the live state.
+
+        ``part`` is this metric's slice of the mesh-reduced state pytree — the
+        state one ``update`` over the FULL (unsharded) batch would have
+        produced. The default folds it with the declared reductions, weighting
+        ``"mean"`` states by the running update count (reference
+        ``metric.py:317``). Wrappers whose states are indexed by update event
+        rather than accumulated (``Running``'s window slots) override this.
+        """
+        if prev_count == 0:
+            self.load_state_tree(part)
+            return
+        from torchmetrics_tpu.parallel.sharded import tree_merge
+
+        self.load_state_tree(tree_merge(self._reductions, self.state_tree(), part, weight_a=prev_count, weight_b=1))
 
     # ---------------------------------------------------------------- update
     def _wrap_update(self, update: Callable) -> Callable:

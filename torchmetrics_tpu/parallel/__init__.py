@@ -35,6 +35,8 @@ from torchmetrics_tpu.parallel.cat_buffer import (
 )
 from torchmetrics_tpu.parallel.sharded import (
     ShardedMetric,
+    deep_reductions,
+    deep_state_tree,
     fold_jit_state,
     make_jit_update,
     make_sharded_update,
@@ -52,6 +54,8 @@ __all__ = [
     "cat_buffer_init",
     "cat_buffer_merge",
     "cat_buffer_values",
+    "deep_reductions",
+    "deep_state_tree",
     "fold_jit_state",
     "make_jit_update",
     "make_sharded_update",

@@ -186,6 +186,18 @@ def make_jit_update(
     running average (reference ``metric.py:317``) instead of decaying
     pairwise means.
     """
+    walk = _walk_metrics(metric)
+    for path, m in walk:
+        reason = getattr(m, "_sharded_update_unsupported", None)
+        if reason:
+            where = f" (at {path!r})" if path else ""
+            raise ValueError(f"{type(m).__name__} does not support a traced update step{where}: {reason}")
+    if len(walk) > 1:
+        raise ValueError(
+            f"{type(metric).__name__} wraps child metrics; make_jit_update's state pytree covers only the"
+            " root registry, so the children would mistrace. Use sharded_update/make_sharded_update"
+            " (deep state walk) for wrapper metrics."
+        )
     reductions = dict(metric._reductions)
     list_state_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
     if list_state_keys and cat_capacity is None:
@@ -252,6 +264,106 @@ def fold_jit_state(metric: "Any", state: Dict[str, Any]) -> None:
 # ------------------------------------------------------------- sharded update
 
 
+def _walk_metrics(metric: "Any") -> list:
+    """Depth-first ``[(path, metric), ...]`` over the metric and every Metric
+    reachable through its attributes — wrapper children held directly, inside
+    list/tuple attributes (``MultioutputWrapper.metrics``, ``MetricTracker``),
+    or as dict values. The root's path is ``""``; child paths are
+    ``attr``/``attr[i]``/``attr[key]`` segments joined with ``/``."""
+    from torchmetrics_tpu.metric import Metric
+
+    seen = {id(metric)}
+    out = [("", metric)]
+    stack = [("", metric)]
+    while stack:
+        path, m = stack.pop()
+        for attr, val in vars(m).items():
+            found = []
+            if isinstance(val, Metric):
+                found.append((attr, val))
+            elif isinstance(val, (list, tuple)):
+                found.extend((f"{attr}[{i}]", v) for i, v in enumerate(val) if isinstance(v, Metric))
+            elif isinstance(val, dict):
+                found.extend((f"{attr}[{k}]", v) for k, v in val.items() if isinstance(v, Metric))
+            for seg, child in found:
+                if id(child) in seen:
+                    continue
+                seen.add(id(child))
+                child_path = f"{path}/{seg}" if path else seg
+                out.append((child_path, child))
+                stack.append((child_path, child))
+    return out
+
+
+def _fold_targets(metric: "Any") -> list:
+    """The ``_walk_metrics`` entries whose states the sharded fold must merge.
+
+    A wrapper that consumes its children's state per update event and resets
+    them (``Running``: child state is transient, the replicated path leaves it
+    pristine) declares ``_sharded_fold_children = False``; its descendants are
+    traced and snapshotted but NOT folded — folding them would bump their
+    update counts and mean-state weights away from the replicated path."""
+    walk = _walk_metrics(metric)
+    no_fold_prefixes = [
+        f"{path}/" if path else "" for path, m in walk if not getattr(m, "_sharded_fold_children", True)
+    ]
+
+    def skipped(path: str) -> bool:
+        return any(path != pref.rstrip("/") and path.startswith(pref) for pref in no_fold_prefixes)
+
+    return [(path, m) for path, m in walk if not skipped(path)]
+
+
+def _deep_key(path: str, name: str) -> str:
+    """Flat pytree key for a state: plain ``name`` on the root (preserving the
+    childless-metric key format everywhere), ``path:name`` on children
+    (attribute names cannot contain ``:``)."""
+    return f"{path}:{name}" if path else name
+
+
+def deep_reductions(metric: "Any") -> Dict[str, Any]:
+    """``dist_reduce_fx`` registry over the metric AND its wrapper children."""
+    return {_deep_key(p, n): r for p, m in _walk_metrics(metric) for n, r in m._reductions.items()}
+
+
+def deep_state_tree(metric: "Any") -> Dict[str, Any]:
+    """``state_tree`` over the metric and its wrapper children (flat keys)."""
+    return {_deep_key(p, n): v for p, m in _walk_metrics(metric) for n, v in m.state_tree().items()}
+
+
+def _deep_snapshot(metric: "Any") -> list:
+    return [
+        (m, m._copy_state_dict(), m._update_count, m._computed,
+         {a: getattr(m, a) for a in getattr(m, "_host_counters", ())})
+        for _, m in _walk_metrics(metric)
+    ]
+
+
+def _deep_restore(snapshot: list) -> None:
+    for m, state, count, computed, counters in snapshot:
+        m.load_state_tree(state)
+        m._update_count = count
+        m._computed = computed
+        for attr, val in counters.items():
+            setattr(m, attr, val)
+
+
+def _deep_batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+    """Run ``metric.update`` on a fresh state and return the deep state pytree.
+
+    Pure w.r.t. traced inputs: the metric object AND every reachable child
+    metric are reset/restored around the traced update so no tracer leaks
+    into any host-side object (wrappers delegate ``update`` to children)."""
+    snapshot = _deep_snapshot(metric)
+    try:
+        for _, m in _walk_metrics(metric):  # wrapper reset may not cascade; per-metric reset is idempotent
+            m.reset()
+        metric.update(*args, **kwargs)
+        return deep_state_tree(metric)
+    finally:
+        _deep_restore(snapshot)
+
+
 def _batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> Dict[str, Any]:
     """Run ``metric.update`` on a fresh state and return the resulting pytree.
 
@@ -292,11 +404,23 @@ def make_sharded_update(
     metrics run in this regime with no capacity bound (the buffer-capacity
     machinery of :func:`make_jit_update` is only needed when the whole
     streaming loop lives inside one compiled program).
+
+    Wrapper metrics (MinMax, Classwise, Multioutput, Running, ...) shard too:
+    the traced update walks every reachable child metric, so the merged pytree
+    carries the children's states under ``path:name`` keys (root states keep
+    their plain names — childless metrics see the same tree as before).
+    Metrics whose update cannot be traced (``BootStrapper``'s per-update host
+    resampling) declare ``_sharded_update_unsupported`` and are refused here.
     """
-    reductions = dict(metric._reductions)
+    for path, m in _walk_metrics(metric):
+        reason = getattr(m, "_sharded_update_unsupported", None)
+        if reason:
+            where = f" (at {path!r})" if path else ""
+            raise ValueError(f"{type(m).__name__} does not support sharded_update{where}: {reason}")
+    reductions = deep_reductions(metric)
 
     def per_device(*args: Any, **kwargs: Any) -> Dict[str, Any]:
-        partial_state = _batch_update_state(metric, args, kwargs)
+        partial_state = _deep_batch_update_state(metric, args, kwargs)
         return mesh_reduce_tree(reductions, partial_state, axis_name)
 
     def build_specs(args: Sequence[Any]) -> Tuple:
@@ -345,20 +469,21 @@ def sharded_update(
     entry = _SHARDED_FN_CACHE.get(key)
     if entry is None or entry[0]() is not metric or entry[1]() is not mesh:
         ref_m, ref_mesh = weakref.ref(metric), weakref.ref(mesh)
-        entry = (ref_m, ref_mesh, make_sharded_update(metric, mesh, axis_name=axis_name))
+        # the fold-target walk is invariant per metric — cache it with the
+        # compiled step so the hot path skips the recursive attribute scan
+        entry = (ref_m, ref_mesh, make_sharded_update(metric, mesh, axis_name=axis_name), _fold_targets(metric))
         _SHARDED_FN_CACHE[key] = entry
-    update_fn = entry[2]
+    update_fn, walk = entry[2], entry[3]
     merged = update_fn(*args)
-    current = metric.state_tree()
-    prev_count = metric._update_count
-    metric._computed = None
-    metric._update_count += 1
-    if prev_count == 0:
-        metric.load_state_tree(merged)
-    else:
-        # mean states: weight the running state by its update count so
-        # repeated folds stay a true running average (reference metric.py:317)
-        metric.load_state_tree(tree_merge(metric._reductions, current, merged, weight_a=prev_count, weight_b=1))
+    for path, m in walk:
+        prev_count = m._update_count
+        m._computed = None
+        m._update_count += 1
+        part = {n: merged[_deep_key(path, n)] for n in m._defaults}
+        # default fold: reduction-keyed merge, "mean" states weighted by the
+        # running update count (reference metric.py:317); event-indexed
+        # wrappers (Running) override the hook with their rotation
+        m._fold_sharded_state(part, prev_count)
 
 
 class ShardedMetric:
@@ -376,21 +501,30 @@ class ShardedMetric:
         sharded_update(self._metric, self._mesh, *args, axis_name=self._axis_name)
 
     def forward(self, *args: Any) -> Any:
-        """Sharded accumulate + batch-local value (reference ``metric.py:283`` dual return)."""
+        """Sharded accumulate + batch-local value (reference ``metric.py:283`` dual return).
+
+        For ``full_state_update`` wrappers (MinMax) this PRESERVES the wrapped
+        metric's global accumulation: the fold is a real state merge, and the
+        batch-local detour deep-snapshots every reachable child. The
+        reference's double-update trick instead resets children whose states
+        its shallow cache never captured (``metric.py:336-346`` +
+        ``minmax.py:106``), so upstream a ``forward`` stream leaves the base
+        metric holding only the last batch.
+        """
         prev_count = self._metric._update_count
         self.update(*args)
         if prev_count > 0:
             # batch-local value needs a fresh state: run the (cached) sharded
-            # step once more on a reset metric, compute, then restore
-            saved = self._metric._copy_state_dict()
-            saved_count = self._metric._update_count
-            self._metric.reset()
+            # step once more on a reset metric, compute, then restore (deep:
+            # wrapper children snapshot/restore too)
+            snapshot = _deep_snapshot(self._metric)
+            for _, m in _walk_metrics(self._metric):
+                m.reset()
             sharded_update(self._metric, self._mesh, *args, axis_name=self._axis_name)
             self._metric._to_sync = False
             batch_val = self._metric.compute()
             self._metric._to_sync = self._metric.sync_on_compute
-            self._metric.load_state_tree(saved)
-            self._metric._update_count = saved_count
+            _deep_restore(snapshot)
             self._metric._computed = None
             return batch_val
         self._metric._to_sync = False

@@ -54,6 +54,15 @@ class BootStrapper(WrapperMetric):
 
     full_state_update: Optional[bool] = True
 
+    #: host-side np RNG drives per-update resampling; under a traced
+    #: ``sharded_update`` the draw would run once at trace time and bake the
+    #: same indices into every execution (silently wrong CIs) — refuse instead
+    _sharded_update_unsupported = (
+        "BootStrapper resamples with a host RNG per update; a traced sharded step "
+        "would freeze the resample indices at trace time. Shard the wrapped metric "
+        "instead, or run BootStrapper in the replica regime."
+    )
+
     def __init__(
         self,
         base_metric: Metric,

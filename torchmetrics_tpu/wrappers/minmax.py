@@ -16,7 +16,19 @@ Array = jax.Array
 
 
 class MinMaxMetric(WrapperMetric):
-    """Track the min and max of a base metric over compute calls (reference ``minmax.py:29``)."""
+    """Track the min and max of a base metric over compute calls (reference ``minmax.py:29``).
+
+    .. note:: **Documented deviation.** The reference keeps ``min_val``/``max_val``
+        as plain (unregistered) tensors (``minmax.py:78-79``), so upstream the
+        bounds survive ``reset()`` — contradicting its own reset docstring
+        (``minmax.py:104``) — vanish from checkpoints, and dodge ``forward``'s
+        state cache/restore (tracking batch-local values there). Here the bounds
+        are registered states (``dist_reduce_fx`` min/max): ``reset()`` actually
+        resets them, they round-trip through ``state_dict``/Orbax, and they sync
+        across replicas. ``update``+``compute`` streams agree with the reference
+        exactly (wrapper parity suite); only reset/forward/checkpoint edge
+        behavior differs, in this framework's favor.
+    """
 
     full_state_update = True
 

@@ -47,6 +47,13 @@ class MultioutputWrapper(WrapperMetric):
         self.output_dim = output_dim
         self.remove_nans = remove_nans
         self.squeeze_outputs = squeeze_outputs
+        if remove_nans:
+            # data-dependent boolean indexing (dynamic shapes) cannot trace;
+            # fail the sharded regime cleanly instead of deep inside jit
+            self._sharded_update_unsupported = (
+                "remove_nans=True drops NaN rows with data-dependent boolean indexing, which has no"
+                " static shape under a traced step. Construct with remove_nans=False to shard."
+            )
 
     def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[tuple, dict]]:
         """Slice args/kwargs per output dim, optionally dropping NaN rows

@@ -17,6 +17,11 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 class Running(WrapperMetric):
     """Compute a metric over a running window of the last ``window`` updates."""
 
+    _host_counters = ("_num_vals_seen",)
+    # update() folds base state into a window slot and resets the base: the
+    # base is transient scratch, so the sharded fold must leave it pristine
+    _sharded_fold_children = False
+
     def __init__(self, base_metric: Metric, window: int = 5) -> None:
         super().__init__()
         if not isinstance(base_metric, Metric):
@@ -72,6 +77,17 @@ class Running(WrapperMetric):
         super().reset()
         self.base_metric.reset()
         self._num_vals_seen = 0
+
+    def _fold_sharded_state(self, part, prev_count) -> None:
+        """One sharded update event = one window slot: the mesh-reduced slot-0
+        state (a fresh traced update always writes slot 0) rotates into the
+        slot this event would have taken, other slots stay. Exactly matches
+        the replicated semantics — unlike the reference's DDP Running, whose
+        per-rank windows interleave rank-local batches."""
+        slot = self._num_vals_seen % self.window
+        for key in self.base_metric._defaults:
+            setattr(self, f"{key}_{slot}", part[f"{key}_0"])
+        self._num_vals_seen += 1
 
     def plot(self, val=None, ax=None):
         return self._plot(val, ax)
