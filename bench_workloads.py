@@ -35,7 +35,11 @@ MAP_GTS = 32
 # val2017-scale point behind BASELINE.md's mAP claim: COCO val2017 is 5k
 # images averaging ~7 gts; 1024 images x 100 dets x 80 classes stresses the
 # same matching dimensions per compiled program.
-MAP_SCALE_IMAGES = 1024
+#: 5000 images = the actual COCO val2017 count, so "val2017-scale" is literal;
+#: it also puts the timed region at ~7-8s, where the tunnel's ±0.2-0.5s
+#: per-execution jitter (which spanned r4's 713-738 band and today's 565-645
+#: at the old 1024-image region) drops under ~5%
+MAP_SCALE_IMAGES = 5000
 MAP_SCALE_DETS = 100
 MAP_SCALE_GTS = 32
 MAP_SCALE_CLASSES = 80
@@ -276,7 +280,8 @@ def bench_coco_map(repeats: int = 3) -> Dict:
 
 def bench_coco_map_scale(repeats: int = 3) -> Dict:
     """The val2017-scale point behind BASELINE.md's mAP claim, measured
-    first-class: 1024 images x 100 detections x 80 classes per evaluation."""
+    first-class: 5000 images (the real val2017 count) x 100 detections x 80
+    classes per evaluation."""
     from torchmetrics_tpu.functional.detection.map import coco_mean_average_precision
 
     preds, target = _synth_detections(
@@ -310,28 +315,37 @@ def bench_coco_map_scale(repeats: int = 3) -> Dict:
     }
 
 
-def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
-    """Marginal device throughput + MFU of the BERTScore tower, with the
-    remote tunnel's per-execution constant measured and subtracted.
+def bench_bertscore(n_pairs: int = 1024, repeats: int = 3, time_budget_s: float = 420.0) -> Dict:
+    """Device throughput + MFU of the BERTScore tower, robust to the remote
+    tunnel's per-execution constant.
 
-    The axon tunnel charges a large, VARIABLE per-execution constant
-    (measured 0.1s-60s across sessions, roughly independent of corpus size),
-    so end-to-end pairs/s at small corpora is a tunnel number, not a device
-    number (VERDICT r4 weak #1). This bench pins both:
+    The axon tunnel charges a large, ERRATIC per-execution constant (10-85s
+    measured across three r5 sessions, for the SAME compiled program), and
+    crashes the worker on single executions longer than ~3-4 min — so
+    neither end-to-end pairs/s, nor one very long dispatch, nor a single
+    unlucky two-point slope survives it. What r5 measured to work:
+    consecutive executions in one session usually draw CONSISTENT constants
+    (85.5 then 125.1 → slope 0.495 s/pass, clean), failing only when a draw
+    jumps (10s vs 48s in one session). The design therefore:
 
-    - **end-to-end**: the real ``bert_score`` API over ``n_pairs`` in one
-      fused dispatch (reported in extras, tunnel constant included);
-    - **marginal (the headline)**: the repeat-inside-program harness
-      (``_fused_score_repeated_forward``) runs R corpus passes inside ONE
-      dispatch with per-pass input perturbation; the slope between R=1
-      (= the end-to-end run) and R=R_BIG amortizes the constant away.
-      MFU = XLA-counted corpus FLOPs / marginal corpus seconds.
+    - runs (T(1), T(R_BIG=81)) PAIRS of the dynamic-repeat program
+      (``_fused_score_dynamic_repeat_forward``, repeat count R a runtime
+      ``fori_loop`` bound — both levels are the SAME program, R=81 ≈ 45s of
+      device work, safely under the execution ceiling);
+    - headline = median pairwise slope, guarded: positive and no faster
+      than the chip's bf16 peak on the XLA-counted FLOPs;
+    - always also reports the **floor** ``R_BIG*n_pairs/min(T(R_BIG))`` —
+      constant left in the denominator, so it can only understate;
+    - adapts pair count to the session: a fast session (constant <35s)
+      affords two pairs for a cross-checked median, a slow one takes one.
 
     bf16 encoder — the TPU-first choice, like the FID tower; score drift vs
     f32 is pinned by ``test_bert_score_bf16_model_parity`` — batch 256,
     seq 128, bert-base geometry (random weights, FLOP-identical to the
     trained checkpoint). Reference hot loop being measured against:
-    ``functional/text/bert.py:69-149``.
+    ``functional/text/bert.py:69-149``. The real ``bert_score`` API
+    end-to-end record (one fused dispatch per evaluation, constant included)
+    is appended only if the leg's time budget allows.
     """
     import jax
     import jax.numpy as jnp
@@ -339,14 +353,17 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
     from transformers import BertConfig, FlaxBertModel
 
     from torchmetrics_tpu.functional.text.bert import (
-        _fused_score_repeated_forward,
+        _fused_score_dynamic_repeat_forward,
         _make_fused_score_fn,
         bert_score,
     )
 
-    seq, batch_size, num_layers, r_big = 128, 256, 12, 25
-    # floor 1024: the marginal slope needs (r_big-1) x n_pairs of extra
-    # compute to clear the tunnel's +-10s execution-time noise
+    leg_start = time.perf_counter()
+    # r_big=61 ≈ 30s of device work per execution: enough for the slope and a
+    # usable floor, small enough to stay clear of the remote worker's
+    # crash-prone long-execution regime (R=481 ≈ 4.5 min crashed it
+    # reproducibly; R=81 crashed once in a degraded session)
+    seq, batch_size, num_layers, r_big = 128, 256, 12, 61
     n_pairs = max(1024, (n_pairs // batch_size) * batch_size)
     n_chunks = n_pairs // batch_size
     rng = np.random.default_rng(0)
@@ -363,33 +380,31 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
         model = FlaxBertModel(BertConfig(), seed=0, dtype=jnp.bfloat16)
         jax.block_until_ready(model.params)
 
-    # ---- end-to-end: the real API, one fused dispatch per evaluation
-    bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)  # compile + warm
-    t1_runs = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        out = bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)
-        np.asarray(out["f1"])  # forced materialization
-        t1_runs.append(time.perf_counter() - t0)
-
-    # ---- marginal: R_BIG corpus passes inside one dispatch
-    fn_rep = _fused_score_repeated_forward(model, num_layers, False, r_big)
+    # ---- the bound: R_BIG corpus passes in ONE dispatch, R a runtime arg
+    fn_dyn = _fused_score_dynamic_repeat_forward(model, num_layers, False)
     chunk = lambda x: np.asarray(x).reshape(n_chunks, batch_size, seq)
     pm = mask.copy()
     sc = (pm / pm.sum(-1, keepdims=True)).astype(np.float32)
     rep_args = (chunk(preds["input_ids"]), chunk(mask), chunk(pm), chunk(sc),
                 chunk(target["input_ids"]), chunk(mask), chunk(pm), chunk(sc))
-    np.asarray(fn_rep(*rep_args))  # compile + warm
-    t1_med = sorted(t1_runs)[len(t1_runs) // 2]
-    # slow-regime bound: when the tunnel charges >35 s per execution, each
-    # extra repeat costs ~a minute; one slope estimate keeps the whole
-    # workload under ~7 min so the driver's bench never runs out of clock
-    rep_repeats = repeats if t1_med < 35 else 1
-    tr_runs = []
-    for _ in range(rep_repeats):
+
+    def timed_dyn(r: int) -> float:
         t0 = time.perf_counter()
-        np.asarray(fn_rep(*rep_args))
-        tr_runs.append(time.perf_counter() - t0)
+        np.asarray(fn_dyn(jnp.int32(r), *rep_args))
+        return time.perf_counter() - t0
+
+    timed_dyn(1)  # compile + warm (transfers the 0.4GB weight pytree once)
+    t_smalls = [timed_dyn(1)]  # ~constant + one corpus pass
+    try:
+        t_bigs = [timed_dyn(r_big)]
+    except Exception:  # degraded sessions crash the worker on long executions;
+        time.sleep(45)  # the worker usually restarts — retry once, halve R
+        r_big = max(r_big // 2, 16)
+        t_bigs = [timed_dyn(r_big)]
+    if t_smalls[0] < 35:  # fast session: a second pair cross-checks the slope
+        t_smalls.append(timed_dyn(1))
+        t_bigs.append(timed_dyn(r_big))
+    extra_pairs_dyn = (r_big - 1) * n_pairs
 
     # XLA's own FLOP count of one chunk body (lax.map bodies count once —
     # see _program_flops caveat), scaled to the corpus
@@ -399,25 +414,15 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
     per_chunk = _program_flops(single, model.params, zi, zi, zi, zf, zi, zi, zi, zf)
     flops = per_chunk * n_chunks if per_chunk else None
 
-    extra_pairs = (r_big - 1) * n_pairs
-    marg = [(tr - t1_med) / extra_pairs for tr in tr_runs]  # s/pair per repeat
-    # median over ALL slopes (negatives included) — dropping noise-negative
-    # repeats before the median would bias the headline upward
-    marg_med = sorted(marg)[len(marg) // 2]
-    marginal_valid = marg_med > 0
-    # physical-bound sanity: a slope faster than the chip's bf16 peak on the
-    # XLA-counted FLOPs is tunnel noise, not throughput (197e12 = v5e-1 peak,
-    # same constant bench.py divides by for mfu_pct)
-    if marginal_valid and flops and marg_med * n_pairs < flops / 197e12:
-        marginal_valid = False
-    if marginal_valid:
-        runs = [1.0 / m for m in marg if m > 0]
-        if len(runs) != len(marg):  # degenerate band: quote only the median
-            runs = [1.0 / marg_med]
-    else:  # tunnel noise swallowed or inverted the slope this session
-        runs = [n_pairs / t for t in t1_runs]
-        marg_med = t1_med / n_pairs
-    marginal_corpus_s = marg_med * n_pairs
+    # the floor: constant included in the denominator, can only UNDERSTATE
+    bound_pairs_s = r_big * n_pairs / min(t_bigs)
+    # the headline: median pairwise same-program slope, physically guarded
+    slopes = [(tb - ts) / extra_pairs_dyn for ts, tb in zip(t_smalls, t_bigs)]
+    valid_slopes = [
+        s for s in slopes if s > 0 and (not flops or s * n_pairs >= flops / 197e12)
+    ]
+    slope = sorted(valid_slopes)[len(valid_slopes) // 2] if valid_slopes else None
+    slope_valid = slope is not None
 
     baseline = None
     try:
@@ -435,24 +440,44 @@ def bench_bertscore(n_pairs: int = 1024, repeats: int = 3) -> Dict:
         baseline = n_b / (time.perf_counter() - t0)
     except Exception:
         pass
+
+    # ---- optional end-to-end record: the real API, one fused dispatch per
+    # evaluation — only if the leg's clock allows (it costs a second compile)
+    end_to_end = None
+    if time.perf_counter() - leg_start < 0.6 * time_budget_s:
+        try:
+            bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)  # compile+warm
+            t0 = time.perf_counter()
+            out = bert_score(preds, target, model=model, batch_size=batch_size, num_layers=num_layers)
+            np.asarray(out["f1"])  # forced materialization
+            end_to_end = {
+                "pairs_s": round(n_pairs / (time.perf_counter() - t0), 1),
+                "note": "real bert_score API, one dispatch; includes the per-execution tunnel constant",
+            }
+        except Exception:
+            pass
+
+    if slope_valid:
+        runs = [1.0 / s for s in valid_slopes]
+        unit = "pairs/s (marginal, same-program slope)"
+        corpus_s = slope * n_pairs  # seconds per corpus pass, constant-free
+        mfu_flops, mfu_elapsed = flops, corpus_s
+    else:  # every slope draw inverted/beat-peak: publish the honest floor
+        runs = [bound_pairs_s]
+        unit = "pairs/s (>= floor, tunnel constant included)"
+        mfu_flops, mfu_elapsed = (flops * r_big if flops else None), min(t_bigs)
     return {
         "runs": runs,
-        # honesty flag: with no positive slope the published number is the
-        # end-to-end rate (tunnel constant INCLUDED), not a device number
-        "unit": "pairs/s (marginal)" if marginal_valid else "pairs/s (e2e FALLBACK; marginal unmeasurable this session)",
+        "unit": unit,
         "baseline": baseline,
-        "program_flops": flops if marginal_valid else None,
-        "elapsed_s": round(marginal_corpus_s, 3),
-        "end_to_end": {
-            "pairs_s": round(n_pairs / t1_med, 1),
-            "runs_s": [round(t, 2) for t in sorted(t1_runs)],
-            "note": "includes the per-execution tunnel constant",
-        },
-        "dispatch_constant_s": round(max(0.0, t1_med - marginal_corpus_s), 2) if marginal_valid else None,
+        "program_flops": mfu_flops,
+        "elapsed_s": round(mfu_elapsed, 3),
+        "floor_pairs_s": round(bound_pairs_s, 1),
+        "end_to_end": end_to_end,
         "corpus_pairs": n_pairs,
         "scan_repeats": r_big,
-        "repeat_runs_s": [round(t, 2) for t in sorted(tr_runs)],
-        "raw_slopes_ms_per_pair": [round(1e3 * m, 4) for m in marg],
+        "repeat_runs_s": {"r1": [round(t, 2) for t in t_smalls], f"r{r_big}": [round(t, 2) for t in t_bigs]},
+        "raw_slopes_ms_per_pair": [round(1e3 * s, 4) for s in slopes],
     }
 
 

@@ -346,6 +346,32 @@ def test_fused_repeated_harness_matches_sum_of_passes():
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_fused_dynamic_repeat_harness_matches_static():
+    """The dynamic-R harness (repeat count as a runtime ``fori_loop`` bound —
+    what makes the marginal slope a same-program difference) must equal the
+    static-R scan harness for every R, including the degenerate R=1."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.text.bert import (
+        _fused_score_dynamic_repeat_forward,
+        _fused_score_repeated_forward,
+    )
+
+    model, _ = _tiny_bert()
+    rng = np.random.RandomState(1)
+    C, bs, S = 2, 4, 12
+    ids_p = rng.randint(1, 60, (C, bs, S))
+    ids_t = rng.randint(1, 60, (C, bs, S))
+    m = np.ones((C, bs, S), np.int64)
+    sc = np.full((C, bs, S), 1.0 / S, np.float32)
+    dyn = _fused_score_dynamic_repeat_forward(model, None, False)
+    for R in (1, 3):
+        static = _fused_score_repeated_forward(model, None, False, R)
+        want = np.asarray(static(ids_p, m, m, sc, ids_t, m, m, sc))
+        got = np.asarray(dyn(jnp.int32(R), ids_p, m, m, sc, ids_t, m, m, sc))
+        np.testing.assert_allclose(got, want, atol=1e-5, err_msg=f"R={R}")
+
+
 def test_bert_score_bf16_model_parity():
     """A bf16-compute encoder (the bench configuration, mirroring the FID
     tower's TPU dtype choice) must track the f32 encoder's BERTScore within

@@ -204,6 +204,37 @@ def _fused_score_repeated_forward(
     return jitted_forward(model, f"fused_score_rep:{num_layers}:{all_layers}:{repeats}", make_fn)
 
 
+def _fused_score_dynamic_repeat_forward(model: Any, num_layers: Optional[int], all_layers: bool) -> Callable:
+    """Bench harness: like :func:`_fused_score_repeated_forward` but the
+    repeat count is a RUNTIME argument (``lax.fori_loop`` with a traced
+    bound), so every repeat level executes the SAME compiled program.
+
+    This is what makes the marginal-throughput slope robust on a remote
+    tunnel: the per-execution service constant differs wildly BETWEEN
+    programs (measured 28s vs 70s for two same-size programs in one session)
+    but only by a few seconds between executions of one program — a
+    same-program ``T(R_big) - T(R_small)`` difference cancels it. Not part
+    of the metric API."""
+    from torchmetrics_tpu.utilities.jit_cache import jitted_forward
+
+    def make_fn(m):
+        fwd = _make_fused_score_fn(m, num_layers, all_layers)
+
+        def repeated(params, repeats, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t):
+            out0 = fwd(params, ids_p, am_p, pm_p, sc_p, ids_t, am_t, pm_t, sc_t)
+
+            def body(r, acc):
+                out = fwd(params, (ids_p + r) % 30000, am_p, pm_p, sc_p,
+                          (ids_t + r) % 30000, am_t, pm_t, sc_t)
+                return acc + out
+
+            return jax.lax.fori_loop(1, repeats, body, out0)
+
+        return repeated
+
+    return jitted_forward(model, f"fused_score_dynrep:{num_layers}:{all_layers}", make_fn)
+
+
 def _host_side_inputs(
     input_ids: np.ndarray, attention_mask: np.ndarray, idf: bool, tokens_idf: Optional[Dict[int, float]]
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
