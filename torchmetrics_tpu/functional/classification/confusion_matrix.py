@@ -54,7 +54,7 @@ def _binary_confusion_matrix_arg_validation(
         raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
 
 
-def _binary_confusion_matrix_tensor_validation(
+def _binary_confusion_matrix_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array, target: Array, ignore_index: Optional[int] = None
 ) -> None:
     _check_same_shape(preds, target)
@@ -137,7 +137,7 @@ def _multiclass_confusion_matrix_arg_validation(
         raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
 
 
-def _multiclass_confusion_matrix_tensor_validation(
+def _multiclass_confusion_matrix_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
 ) -> None:
     if preds.ndim == target.ndim + 1:
@@ -223,7 +223,7 @@ def _multilabel_confusion_matrix_arg_validation(
         raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
 
 
-def _multilabel_confusion_matrix_tensor_validation(
+def _multilabel_confusion_matrix_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
 ) -> None:
     _check_same_shape(preds, target)

@@ -20,7 +20,7 @@ from torchmetrics_tpu.utilities.data import to_onehot
 Array = jax.Array
 
 
-def _dice_format(
+def _dice_format(  # metriclint: disable=ML002 -- num_classes=None infers the class count from concrete labels; the jit path passes num_classes
     preds: Array,
     target: Array,
     threshold: float = 0.5,

@@ -25,7 +25,7 @@ from torchmetrics_tpu.utilities.prints import rank_zero_warn
 Array = jax.Array
 
 
-def _groups_validation(groups: Array, num_groups: int) -> None:
+def _groups_validation(groups: Array, num_groups: int) -> None:  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     """Validate the groups tensor (reference ``:30-44``)."""
     if int(jnp.max(groups)) >= num_groups:
         raise ValueError(
@@ -104,7 +104,7 @@ def binary_groups_stat_rates(
     return _groups_reduce(group_stats)
 
 
-def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:  # metriclint: disable=ML002 -- result dict keys are data-dependent group ids: eager by design
     """DP = min positivity rate / max positivity rate (reference ``:164-175``)."""
     pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
     min_pos_rate_id = int(jnp.argmin(pos_rates))
@@ -127,7 +127,7 @@ def demographic_parity(
     return _compute_binary_demographic_parity(**_groups_stat_transform(group_stats))
 
 
-def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:  # metriclint: disable=ML002 -- result dict keys are data-dependent group ids: eager by design
     """EO = min TPR / max TPR (reference ``:243-255``)."""
     true_pos_rates = _safe_divide(tp, tp + fn)
     min_pos_rate_id = int(jnp.argmin(true_pos_rates))

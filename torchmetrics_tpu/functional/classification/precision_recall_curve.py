@@ -156,7 +156,7 @@ def _binary_precision_recall_curve_arg_validation(
         raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
 
 
-def _binary_precision_recall_curve_tensor_validation(
+def _binary_precision_recall_curve_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array, target: Array, ignore_index: Optional[int] = None
 ) -> None:
     """Validate tensor inputs (reference ``:123-148``)."""
@@ -277,17 +277,17 @@ def _binary_precision_recall_curve_compute(
     preds, target = preds[keep], target[keep]
     fps, tps, thresh = _binary_clf_curve_host(preds, target, pos_label=pos_label)
     denom = tps + fps
-    precision = np.where(denom > 0, tps / np.where(denom > 0, denom, 1), 0.0)
+    precision = np.where(denom > 0, tps / np.where(denom > 0, denom, 1), 0.0)  # metriclint: disable=ML004 -- host branch of a dual-mode compute: state is concrete numpy here
     if tps[-1] <= 0:
         rank_zero_warn(
             "No positive samples found in target, recall is undefined. Setting recall to one for all thresholds.",
             UserWarning,
         )
-        recall = np.ones_like(precision)
+        recall = np.ones_like(precision)  # metriclint: disable=ML004 -- host branch of a dual-mode compute: state is concrete numpy here
     else:
         recall = tps / tps[-1]
-    precision = np.concatenate([precision[::-1], [1.0]])
-    recall = np.concatenate([recall[::-1], [0.0]])
+    precision = np.concatenate([precision[::-1], [1.0]])  # metriclint: disable=ML004 -- host branch of a dual-mode compute: state is concrete numpy here
+    recall = np.concatenate([recall[::-1], [0.0]])  # metriclint: disable=ML004 -- host branch of a dual-mode compute: state is concrete numpy here
     thresh = thresh[::-1].copy()
     return jnp.asarray(precision, jnp.float32), jnp.asarray(recall, jnp.float32), jnp.asarray(thresh)
 
@@ -326,7 +326,7 @@ def _multiclass_precision_recall_curve_arg_validation(
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
 
 
-def _multiclass_precision_recall_curve_tensor_validation(
+def _multiclass_precision_recall_curve_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
 ) -> None:
     """Validate tensor inputs (reference ``:403-427``)."""
@@ -459,7 +459,7 @@ def _multilabel_precision_recall_curve_arg_validation(
     _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
 
 
-def _multilabel_precision_recall_curve_tensor_validation(
+def _multilabel_precision_recall_curve_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
 ) -> None:
     _check_same_shape(preds, target)

@@ -50,8 +50,8 @@ def _binary_roc_compute(
     preds, target = preds[keep], target[keep]
     fps, tps, thres = _binary_clf_curve_host(preds, target, pos_label=pos_label)
     # prepend origin so the curve starts at (0, 0)
-    tps = np.concatenate([[0], tps])
-    fps = np.concatenate([[0], fps])
+    tps = np.concatenate([[0], tps])  # metriclint: disable=ML004 -- host branch of a dual-mode compute: state is concrete numpy here
+    fps = np.concatenate([[0], fps])  # metriclint: disable=ML004 -- host branch of a dual-mode compute: state is concrete numpy here
     thres = np.concatenate([np.ones(1, thres.dtype), thres])
     if fps[-1] <= 0:
         rank_zero_warn(

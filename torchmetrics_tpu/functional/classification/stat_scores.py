@@ -47,7 +47,7 @@ def _binary_stat_scores_arg_validation(
         raise ValueError(f"Expected argument `zero_division` to be 0 or 1, but got {zero_division}.")
 
 
-def _binary_stat_scores_tensor_validation(
+def _binary_stat_scores_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array,
     target: Array,
     multidim_average: str = "global",
@@ -169,7 +169,7 @@ def _multiclass_stat_scores_arg_validation(
         raise ValueError(f"Expected argument `zero_division` to be 0 or 1, but got {zero_division}.")
 
 
-def _multiclass_stat_scores_tensor_validation(
+def _multiclass_stat_scores_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array,
     target: Array,
     num_classes: int,
@@ -391,7 +391,7 @@ def _multilabel_stat_scores_arg_validation(
         raise ValueError(f"Expected argument `zero_division` to be 0 or 1, but got {zero_division}.")
 
 
-def _multilabel_stat_scores_tensor_validation(
+def _multilabel_stat_scores_tensor_validation(  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     preds: Array,
     target: Array,
     num_labels: int,

@@ -31,6 +31,8 @@ def calinski_harabasz_score(data: Array, labels: Array) -> Array:
     mean = data.mean(axis=0)
     between = (counts * ((centroids - mean[None, :]) ** 2).sum(axis=1)).sum()
     within = ((data - centroids[inverse]) ** 2).sum()
-    if bool(within == 0):
-        return jnp.asarray(1.0)
-    return between * (num_samples - num_labels) / (within * (num_labels - 1.0))
+    # zero within-dispersion degenerates to 1.0; a traced select instead of an
+    # early return keeps the kernel jittable
+    safe_within = jnp.where(within == 0, 1.0, within)
+    score = between * (num_samples - num_labels) / (safe_within * (num_labels - 1.0))
+    return jnp.where(within == 0, 1.0, score)

@@ -32,9 +32,10 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
     diff = centroids[:, None, :] - centroids[None, :, :]
     centroid_distances = jnp.sqrt((diff**2).sum(axis=-1))
 
-    if bool(jnp.allclose(intra_dists, 0.0)) or bool(jnp.allclose(centroid_distances, 0.0)):
-        return jnp.asarray(0.0)
+    # degenerate clusterings (all-zero intra or inter distances) score 0; a
+    # traced select instead of an early return keeps the whole kernel jittable
+    degenerate = jnp.allclose(intra_dists, 0.0) | jnp.allclose(centroid_distances, 0.0)
     centroid_distances = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
     combined_intra = intra_dists[None, :] + intra_dists[:, None]
     scores = (combined_intra / centroid_distances).max(axis=1)
-    return scores.mean()
+    return jnp.where(degenerate, 0.0, scores.mean())

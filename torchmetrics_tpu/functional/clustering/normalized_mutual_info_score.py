@@ -24,9 +24,10 @@ def normalized_mutual_info_score(preds: Array, target: Array, average_method: st
     check_cluster_labels(preds, target)
     _validate_average_method_arg(average_method)
     mutual_info = mutual_info_score(preds, target)
-    if bool(jnp.isclose(mutual_info, 0.0, atol=jnp.finfo(jnp.float32).eps)):
-        return mutual_info
+    # ~zero MI short-circuits to MI itself (normalizer may be 0 there); a
+    # traced select instead of an early return keeps the kernel jittable
+    degenerate = jnp.isclose(mutual_info, 0.0, atol=jnp.finfo(jnp.float32).eps)
     normalizer = calculate_generalized_mean(
         jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
     )
-    return mutual_info / normalizer
+    return jnp.where(degenerate, mutual_info, mutual_info / jnp.where(degenerate, 1.0, normalizer))

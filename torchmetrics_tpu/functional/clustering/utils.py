@@ -16,7 +16,7 @@ import jax.numpy as jnp
 Array = jax.Array
 
 
-def is_nonnegative(x: Array, atol: float = 1e-5) -> bool:
+def is_nonnegative(x: Array, atol: float = 1e-5) -> bool:  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     """Return True if all elements are nonnegative within tolerance (reference ``:23-34``)."""
     return bool(jnp.all(x >= -atol))
 

@@ -81,7 +81,7 @@ def peak_signal_noise_ratio(
         target = jnp.clip(target, data_range[0], data_range[1])
         data_range = jnp.asarray(data_range[1] - data_range[0], jnp.float32)
     else:
-        data_range = jnp.asarray(float(data_range))
+        data_range = jnp.asarray(data_range, jnp.float32)
     sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
     return _psnr_compute(sum_squared_error, num_obs, data_range, base=base, reduction=reduction)
 

@@ -70,7 +70,8 @@ def _ssim_update(
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
 
     if data_range is None:
-        data_range = float(jnp.maximum(preds.max() - preds.min(), target.max() - target.min()))
+        # stays a traced scalar: c1/c2 broadcast, so the inferred-range path jits
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
     elif isinstance(data_range, tuple):
         preds = jnp.clip(preds, data_range[0], data_range[1])
         target = jnp.clip(target, data_range[0], data_range[1])

@@ -60,7 +60,7 @@ def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
         phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
             phi_squared, num_rows, num_cols, cm_sum
         )
-        if bool(jnp.minimum(rows_corrected, cols_corrected) == 1):
+        if bool(jnp.minimum(rows_corrected, cols_corrected) == 1):  # metriclint: disable=ML002 -- data-dependent user warning: eager by design
             _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
             return jnp.asarray(float("nan"))
         cramers_v_value = jnp.sqrt(phi_squared_corrected / jnp.minimum(rows_corrected - 1, cols_corrected - 1))
@@ -183,9 +183,8 @@ def _theils_u_compute(confmat: Array) -> Array:
     total_occurrences = confmat.sum()
     p_x = confmat.sum(axis=0) / total_occurrences
     s_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.where(p_x > 0, p_x, 1.0)), 0.0))
-    if bool(s_x == 0):
-        return jnp.asarray(0.0)
-    return (s_x - s_xy) / s_x
+    # zero marginal entropy degenerates to 0.0; traced select keeps it jittable
+    return jnp.where(s_x == 0, 0.0, (s_x - s_xy) / jnp.where(s_x == 0, 1.0, s_x))
 
 
 def theils_u(
@@ -241,7 +240,7 @@ def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
         phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
             phi_squared, num_rows, num_cols, cm_sum
         )
-        if bool(jnp.minimum(rows_corrected, cols_corrected) == 1):
+        if bool(jnp.minimum(rows_corrected, cols_corrected) == 1):  # metriclint: disable=ML002 -- data-dependent user warning: eager by design
             _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
             return jnp.asarray(float("nan"))
         value = jnp.sqrt(phi_squared_corrected / jnp.sqrt((rows_corrected - 1) * (cols_corrected - 1)))

@@ -115,8 +115,8 @@ def _nominal_confmat(
     target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
     preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
     if _is_concrete(preds) and _is_concrete(target):  # skip under jit/shard_map tracing
-        max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
-        min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))
+        max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
+        min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
         if max_label >= num_classes or min_label < 0:
             raise ValueError(
                 f"Detected label values in [{min_label}, {max_label}] but `num_classes`={num_classes}; nominal"

@@ -62,7 +62,7 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
     corr_xy = corr_xy / (nb - 1)
 
     bound = math.sqrt(jnp.finfo(jnp.asarray(var_x).dtype).eps)
-    if _is_concrete(var_x) and (bool(jnp.any(var_x < bound)) or bool(jnp.any(var_y < bound))):
+    if _is_concrete(var_x) and (bool(jnp.any(var_x < bound)) or bool(jnp.any(var_y < bound))):  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
         rank_zero_warn(
             "The variance of predictions or target is close to zero. This can cause instability in Pearson correlation"
             "coefficient, leading to wrong results. Consider re-scaling the input if possible or computing using a"

@@ -37,7 +37,7 @@ def _r2_score_compute(
     multioutput: str = "uniform_average",
 ) -> Array:
     """Finalize R² (reference ``r2.py:47``); masked assignments as ``where``."""
-    if int(num_obs) < 2:
+    if int(num_obs) < 2:  # metriclint: disable=ML002 -- eager sample-count validation on the host-side arg
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
     mean_obs = sum_obs / num_obs

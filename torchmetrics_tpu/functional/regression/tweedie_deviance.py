@@ -15,7 +15,7 @@ from torchmetrics_tpu.utilities.compute import _safe_xlogy
 Array = jax.Array
 
 
-def _tweedie_deviance_domain_check(preds: Array, targets: Array, power: float) -> None:
+def _tweedie_deviance_domain_check(preds: Array, targets: Array, power: float) -> None:  # metriclint: disable=ML002 -- eager validation helper: called outside jit by the validate_args contract
     """Domain checks per power regime (reference ``tweedie_deviance.py:51-75``);
     only run on concrete (non-traced) inputs so kernels stay jittable."""
     if not (_is_concrete(preds) and _is_concrete(targets)):

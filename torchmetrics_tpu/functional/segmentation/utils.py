@@ -22,16 +22,21 @@ def _segmentation_format(preds: Array, target: Array, num_classes: int, input_fo
     """Index → one-hot with channel dim at position 1 (shared by both kernels).
 
     Out-of-range index labels would be silently one-hot-encoded to all-zero
-    rows, so they error loudly instead (matching the torch reference).
+    rows, so on CONCRETE (eager) inputs they error loudly instead (matching
+    the torch reference). Under jit/shard_map tracing the range check is
+    necessarily skipped — validate index inputs eagerly before compiling.
     """
+    from torchmetrics_tpu.utilities.checks import _is_concrete
+
     if input_format == "index":
-        max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))
-        min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))
-        if max_label >= num_classes or min_label < 0:
-            raise ValueError(
-                f"Detected index labels in [{min_label}, {max_label}] outside the valid range"
-                f" 0..{num_classes - 1} implied by `num_classes`={num_classes}."
-            )
+        if _is_concrete(preds) and _is_concrete(target):  # range check only on concrete inputs, skipped under jit/shard_map tracing
+            max_label = int(jnp.maximum(jnp.max(preds), jnp.max(target)))  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
+            min_label = int(jnp.minimum(jnp.min(preds), jnp.min(target)))  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
+            if max_label >= num_classes or min_label < 0:
+                raise ValueError(
+                    f"Detected index labels in [{min_label}, {max_label}] outside the valid range"
+                    f" 0..{num_classes - 1} implied by `num_classes`={num_classes}."
+                )
         preds = jnp.moveaxis(jax.nn.one_hot(preds, num_classes, dtype=jnp.int32), -1, 1)
         target = jnp.moveaxis(jax.nn.one_hot(target, num_classes, dtype=jnp.int32), -1, 1)
     return preds, target

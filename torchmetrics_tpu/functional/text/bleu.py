@@ -71,18 +71,22 @@ def _bleu_score_compute(
     weights: Sequence[float],
     smooth: bool,
 ) -> Array:
-    """Geometric-mean precision with brevity penalty (reference ``bleu.py:104-137``)."""
-    if float(jnp.min(numerator)) == 0.0:
-        return jnp.asarray(0.0)
+    """Geometric-mean precision with brevity penalty (reference ``bleu.py:104-137``).
+
+    Fully traceable: the reference's zero-numerator early return is a
+    ``jnp.where`` select, so the whole compute can run under ``jit``.
+    """
     if smooth:
         precision_scores = (numerator + jnp.ones(n_gram)) / (denominator + jnp.ones(n_gram))
         precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
     else:
         precision_scores = numerator / denominator
-    log_precision_scores = jnp.asarray(weights) * jnp.log(precision_scores)
+    # guard the log against 0/0 lanes; any zero numerator zeroes the result below
+    safe_precision = jnp.where(numerator > 0, precision_scores, 1.0)
+    log_precision_scores = jnp.asarray(weights) * jnp.log(safe_precision)
     geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
     brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
-    return brevity_penalty * geometric_mean
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, brevity_penalty * geometric_mean)
 
 
 def bleu_score(

@@ -147,7 +147,7 @@ class FrechetInceptionDistance(Metric):
         fn = jitted_forward(self.inception, "fid_extract_fold", make_fn, params_attr="variables")
         return fn(imgs, s, c, n)
 
-    def compute(self) -> Array:
+    def compute(self) -> Array:  # metriclint: disable=ML002 -- documented host-side compute: f64 trace-sqrt has no TPU path
         """Mean/cov from streaming sums, host f64 trace-sqrt (reference ``fid.py:379-389``)."""
         if int(self.real_features_num_samples) < 2 or int(self.fake_features_num_samples) < 2:
             raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")

@@ -164,7 +164,7 @@ class _LPIPSNet(nn.Module):
         return total
 
 
-def _validate_lpips_inputs(img1: Array, img2: Array, normalize: bool) -> None:
+def _validate_lpips_inputs(img1: Array, img2: Array, normalize: bool) -> None:  # metriclint: disable=ML002 -- tracer-guarded: the body early-returns on tracers, only concrete inputs reach the coercion
     """Shape/layout and value-range checks shared by the module and the
     functional entry point (reference ``functional/image/lpips.py:352-366``).
     Range checks only run on concrete values — jit-traced calls skip them."""

@@ -183,6 +183,10 @@ class PerceptualPathLength(Metric):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    # the stored generator is host-side state: declared so snapshot/restore
+    # sees it, and update(generator) can never run under a traced step
+    _host_counters = ("_generator",)
+    _sharded_update_unsupported = "update() stores a host-side generator model; there is no array state to shard"
 
     def __init__(
         self,

@@ -1,0 +1,39 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metriclint: AST-based static checks for the JAX-purity and state contracts
+the runtime assumes (see ARCHITECTURE.md "Static contracts (metriclint)").
+
+Rules
+-----
+- **ML001** every attribute assigned in ``update`` must be registered via
+  ``add_state`` (or declared in ``_host_counters``) — an unregistered attr is
+  invisible to snapshot/reset/restore and leaks tracers under ``shard_map``.
+- **ML002** no Python-value coercion of arrays (``float()``, ``int()``,
+  ``bool()``, ``.item()``, ``.tolist()``, ``if array:``) inside jit-path
+  ``update``/``compute`` bodies and functional kernels — under ``jit`` these
+  raise ``ConcretizationTypeError``/``TracerBoolConversionError``.
+- **ML003** ``add_state`` must pass a valid ``dist_reduce_fx`` literal and a
+  default whose type (Array vs list) matches the reduction.
+- **ML004** no ``numpy`` ops on traced values where a ``jnp`` equivalent
+  exists — ``np.*`` on a tracer forces a host round-trip or raises.
+- **ML005** no metrics stored in containers ``parallel/sharded.py:
+  _walk_metrics`` cannot traverse (``set``/``frozenset``) — such children are
+  silently excluded from the deep snapshot/reset/restore.
+
+Suppress a finding with ``# metriclint: disable=ML00x -- reason`` on the
+offending line (or the line above); whole files opt out of one rule with
+``# metriclint: disable-file=ML00x -- reason``.
+
+This package intentionally imports nothing from the rest of
+``torchmetrics_tpu`` (and no third-party modules), so ``tools/metriclint.py``
+can load it standalone without paying the full package import.
+"""
+from .engine import (  # noqa: F401
+    Violation,
+    diff_against_baseline,
+    fingerprint,
+    lint_paths,
+    load_baseline,
+    summarize,
+)
+from .rules import RULES  # noqa: F401

@@ -1,0 +1,175 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metriclint driver: file walking, suppression comments, baseline ratchet.
+
+Baseline format (``tools/metriclint_baseline.json``): a JSON object mapping
+``"<path>::<rule>::<scope>"`` fingerprints to violation counts. The ratchet
+compares counts per fingerprint — line numbers are deliberately NOT part of
+the key so unrelated edits above a pre-existing violation do not break CI —
+and fails only when a fingerprint's count EXCEEDS its baselined value. A
+fingerprint that shrinks to zero just becomes stale; regenerate with
+``python tools/metriclint.py --write-baseline`` to ratchet it down.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .rules import ClassIndex, Violation, check_file
+
+_SUPPRESS_RE = re.compile(r"#\s*metriclint:\s*disable=([A-Z0-9_,\s]+?)(?:\s*--|$)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*metriclint:\s*disable-file=([A-Z0-9_,\s]+?)(?:\s*--|$)")
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path) and path.endswith(".py"):
+            yield path
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        yield os.path.join(dirpath, fname)
+
+
+def _parse_suppressions(source: str, tree: ast.Module) -> Tuple[Dict[int, set], set]:
+    """(line -> {rules disabled on/for that line}, file-wide disabled rules).
+
+    A suppression on a ``def``/``class`` line covers the whole body — the
+    idiom for functions that are host-path by design (eager validation
+    helpers, documented host branches)."""
+    raw: Dict[int, set] = {}
+    own_line: Dict[int, bool] = {}
+    file_wide: set = set()
+    # real COMMENT tokens only — suppression syntax quoted inside a
+    # string/docstring (documentation, test fixtures) must stay inert
+    try:
+        comments = [
+            (tok.start[0], tok.start[1], tok.string)
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+            if tok.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = []
+    for lineno, col, comment in comments:
+        match = _SUPPRESS_FILE_RE.search(comment)
+        if match:
+            file_wide |= {r.strip() for r in match.group(1).split(",") if r.strip()}
+            continue
+        match = _SUPPRESS_RE.search(comment)
+        if match:
+            raw.setdefault(lineno, set()).update(
+                r.strip() for r in match.group(1).split(",") if r.strip()
+            )
+            own_line[lineno] = col == 0 or not source.splitlines()[lineno - 1][:col].strip()
+    per_line: Dict[int, set] = {}
+    for lineno, rules in raw.items():
+        per_line.setdefault(lineno, set()).update(rules)
+        if own_line[lineno]:
+            # only a comment on its OWN line extends to the statement below —
+            # a trailing comment must not silence the neighbouring line
+            per_line.setdefault(lineno + 1, set()).update(rules)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node.lineno in raw and node.end_lineno is not None:
+                for lineno in range(node.lineno, node.end_lineno + 1):
+                    per_line.setdefault(lineno, set()).update(raw[node.lineno])
+    return per_line, file_wide
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None) -> List[Violation]:
+    """Run every rule over ``paths`` (files or directories), honouring
+    suppression comments. Paths in the result are relative to ``root``."""
+    root = os.path.abspath(root or os.getcwd())
+    # dedup by absolute path: overlapping inputs (dir + file inside it) must
+    # not register a file's classes twice, or violations double-count
+    files = list(dict.fromkeys(_iter_py_files([os.path.abspath(p) for p in paths])))
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.Module] = {}
+    index = ClassIndex()
+    for fname in files:
+        try:
+            with open(fname, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=fname)
+        except (OSError, SyntaxError):
+            continue  # unreadable/unparsable files are pytest's problem, not ours
+        rel = os.path.relpath(fname, root).replace(os.sep, "/")
+        sources[rel] = source
+        trees[rel] = tree
+        index.add_file(rel, tree)
+    index.finalize()
+
+    violations: List[Violation] = []
+    for rel, tree in trees.items():
+        per_line, file_wide = _parse_suppressions(sources[rel], tree)
+        for violation in check_file(rel, tree, index):
+            if violation.rule in file_wide:
+                continue
+            if violation.rule in per_line.get(violation.line, set()):
+                continue
+            violations.append(violation)
+    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return violations
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def fingerprint(violation: Violation) -> str:
+    return f"{violation.path}::{violation.rule}::{violation.scope}"
+
+
+def summarize(violations: Iterable[Violation]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for violation in violations:
+        key = fingerprint(violation)
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    counts = data.get("violations", data) if isinstance(data, dict) else data
+    return {str(k): int(v) for k, v in counts.items()}
+
+
+def write_baseline(path: str, violations: Iterable[Violation]) -> Dict[str, int]:
+    counts = summarize(violations)
+    payload = {
+        "_comment": "metriclint ratchet baseline — counts per path::rule::scope;"
+        " regenerate with `python tools/metriclint.py --write-baseline`."
+        " New violations (counts above these) fail CI; shrinking it is welcome.",
+        "violations": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return counts
+
+
+def diff_against_baseline(
+    violations: Sequence[Violation], baseline: Dict[str, int]
+) -> Tuple[List[Violation], Dict[str, int]]:
+    """(new violations above baseline, stale fingerprints below baseline).
+
+    Within one fingerprint the first ``baseline[fp]`` occurrences (in
+    file/line order) are considered pre-existing; the rest are new.
+    """
+    remaining = dict(baseline)
+    new: List[Violation] = []
+    for violation in violations:
+        key = fingerprint(violation)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+        else:
+            new.append(violation)
+    stale = {k: v for k, v in remaining.items() if v > 0}
+    return new, stale

@@ -1,0 +1,564 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Rule implementations for metriclint (stdlib-only AST analysis).
+
+The checks are deliberately conservative: a value is treated as an array
+("tainted") only when the source proves it — an ``Array``-annotated
+parameter, the result of a ``jnp.``/``jax.`` call, or a registered metric
+state — so host-side tokenization/numpy code does not flood the report.
+A function whose signature mentions ``str`` is classified host-path (its
+inputs cannot be traced operands) and is exempt from ML002/ML004.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "ML001": "attribute assigned in update() is not registered via add_state",
+    "ML002": "Python-value coercion of a traced array in a jit-path body",
+    "ML003": "add_state reduction/default contract violation",
+    "ML004": "numpy op on a traced value where a jnp equivalent exists",
+    "ML005": "Metric stored in a container _walk_metrics cannot traverse",
+}
+
+_VALID_REDUCTIONS = ("sum", "mean", "cat", "min", "max")
+
+# jnp equivalents for ML004 — hardcoded (stable numpy/jnp common surface) so
+# the linter never has to import jax
+_JNP_EQUIVALENTS = frozenset(
+    """abs absolute add all allclose amax amin any arange argmax argmin argsort
+    around atleast_1d atleast_2d average bincount broadcast_to ceil clip
+    column_stack concatenate cos cosh count_nonzero cumprod cumsum diag diff
+    divide dot einsum empty equal exp expand_dims eye flip floor full
+    full_like histogram hstack interp isclose isfinite isinf isnan linspace
+    log log10 log2 logical_and logical_not logical_or matmul max maximum mean
+    median min minimum moveaxis multiply nan_to_num nanmax nanmean nanmin
+    nansum nonzero norm ones ones_like outer pad percentile power prod
+    quantile ravel repeat reshape roll round searchsorted sign sin sinh sort
+    split sqrt square squeeze stack std subtract sum take tanh tensordot tile
+    trace transpose tril triu unique var vstack where zeros zeros_like""".split()
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    scope: str  # "Class.method" or "function" — the baseline fingerprint unit
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} [{self.scope}] {self.message}"
+
+
+# --------------------------------------------------------------- class index
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    bases: Tuple[str, ...]
+    state_names: Set[str]
+    dynamic_states: bool  # add_state with a non-literal name anywhere
+    host_counters: Set[str]
+    host_only: bool  # sets _sharded_update_unsupported (never on the jit path)
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):  # Generic[T]-style bases
+        return _base_name(node.value)
+    return None
+
+
+def _is_self_call(call: ast.Call, method: str) -> bool:
+    func = call.func
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == method
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "self"
+    )
+
+
+def _call_arg(call: ast.Call, pos: int, kw: str) -> Optional[ast.expr]:
+    if len(call.args) > pos:
+        return call.args[pos]
+    for keyword in call.keywords:
+        if keyword.arg == kw:
+            return keyword.value
+    return None
+
+
+def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
+    state_names: Set[str] = set()
+    dynamic = False
+    host_counters: Set[str] = set()
+    host_only = False
+    for stmt in ast.walk(node):
+        if isinstance(stmt, ast.Call) and _is_self_call(stmt, "add_state"):
+            name_arg = _call_arg(stmt, 0, "name")
+            if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                state_names.add(name_arg.value)
+            else:
+                dynamic = True
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            for tgt in targets:
+                tgt_name = None
+                if isinstance(tgt, ast.Name):
+                    tgt_name = tgt.id  # class-level declaration
+                elif isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+                    tgt_name = tgt.attr  # instance-level (e.g. conditional in __init__)
+                if tgt_name == "_sharded_update_unsupported":
+                    value = stmt.value
+                    if not (isinstance(value, ast.Constant) and value.value is None):
+                        host_only = True
+                elif tgt_name == "_host_counters" and stmt.value is not None:
+                    for elt in getattr(stmt.value, "elts", []):
+                        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                            host_counters.add(elt.value)
+    return ClassInfo(
+        name=node.name,
+        path=path,
+        node=node,
+        bases=tuple(b for b in (_base_name(base) for base in node.bases) if b),
+        state_names=state_names,
+        dynamic_states=dynamic,
+        host_counters=host_counters,
+        host_only=host_only,
+    )
+
+
+class ClassIndex:
+    """Package-wide class registry, resolved by simple class name.
+
+    Name collisions (same class name in two modules) merge conservatively:
+    states union, dynamic/host flags OR together — a ratchet linter prefers
+    missing a finding over inventing one.
+    """
+
+    def __init__(self) -> None:
+        self._by_name: Dict[str, List[ClassInfo]] = {}
+        self.metric_names: Set[str] = set()
+
+    def add_file(self, path: str, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._by_name.setdefault(node.name, []).append(_collect_class_info(path, node))
+
+    def finalize(self) -> None:
+        # transitive closure of "inherits (by name) from Metric"
+        names = {"Metric"}
+        changed = True
+        while changed:
+            changed = False
+            for name, infos in self._by_name.items():
+                if name in names:
+                    continue
+                if any(b in names for info in infos for b in info.bases):
+                    names.add(name)
+                    changed = True
+        self.metric_names = names
+
+    def is_metric_class(self, name: str) -> bool:
+        return name in self.metric_names
+
+    def _ancestry(self, info: ClassInfo) -> Iterator[ClassInfo]:
+        seen: Set[int] = set()
+        queue = [info]
+        while queue:
+            cur = queue.pop()
+            if id(cur) in seen:
+                continue
+            seen.add(id(cur))
+            yield cur
+            for base in cur.bases:
+                queue.extend(self._by_name.get(base, []))
+
+    def resolved_states(self, info: ClassInfo) -> Tuple[Set[str], Set[str], bool, bool]:
+        """(state_names, host_counters, dynamic_states, host_only) incl. ancestors."""
+        states: Set[str] = set()
+        counters: Set[str] = set()
+        dynamic = False
+        host_only = False
+        for cur in self._ancestry(info):
+            states |= cur.state_names
+            counters |= cur.host_counters
+            dynamic = dynamic or cur.dynamic_states
+            host_only = host_only or cur.host_only
+        return states, counters, dynamic, host_only
+
+    def classes_in_file(self, path: str) -> List[ClassInfo]:
+        return [info for infos in self._by_name.values() for info in infos if info.path == path]
+
+
+# ------------------------------------------------------------ taint analysis
+
+
+def _annotation_mentions(node: Optional[ast.expr], needle: str) -> bool:
+    if node is None:
+        return False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id == needle:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr == needle:
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) and needle in sub.value:
+            return True  # string ("from __future__") annotations
+    return False
+
+
+def _is_array_annotation(node: Optional[ast.expr]) -> bool:
+    if node is None:
+        return False
+    src = ast.unparse(node) if hasattr(ast, "unparse") else ""
+    return "Array" in src or "jnp.ndarray" in src
+
+
+def _fn_params(fn: ast.FunctionDef) -> List[ast.arg]:
+    a = fn.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def is_host_path_fn(fn: ast.FunctionDef) -> bool:
+    """True when a DATA parameter (one of the first two non-self params, the
+    conventional preds/target slots) is annotated with ``str`` — string
+    inputs cannot be traced operands, so the body runs host-side by
+    construction and ML002/ML004 do not apply. A ``str`` annotation on a
+    later parameter is a mode flag (``reduction: str``), not proof of a host
+    path: those functions stay checked."""
+    data_params = [p for p in _fn_params(fn) if p.arg not in ("self", "cls")][:2]
+    return any(_annotation_mentions(p.annotation, "str") for p in data_params)
+
+
+def _root_module(node: ast.expr) -> Optional[str]:
+    """Leftmost name of a dotted expression: ``jnp.linalg.norm`` -> ``jnp``."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.value if not isinstance(node, ast.Call) else node.func
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class Taint:
+    """Names/attributes in a function body that provably hold jax arrays."""
+
+    def __init__(self, fn: ast.FunctionDef, self_states: Optional[Set[str]] = None) -> None:
+        self.self_states = self_states or set()
+        self.names: Set[str] = {
+            p.arg for p in _fn_params(fn) if _is_array_annotation(p.annotation)
+        }
+        # fixpoint over assignments; two sweeps catch the chains that occur
+        # in practice (a = jnp.f(x); b = a + 1; float(b))
+        for _ in range(2):
+            before = len(self.names)
+            for stmt in ast.walk(fn):
+                if isinstance(stmt, ast.Assign) and self.is_tainted(stmt.value):
+                    for tgt in stmt.targets:
+                        self._taint_target(tgt)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    if self.is_tainted(stmt.value) or _is_array_annotation(stmt.annotation):
+                        self._taint_target(stmt.target)
+                elif isinstance(stmt, ast.AugAssign) and self.is_tainted(stmt.value):
+                    self._taint_target(stmt.target)
+            if len(self.names) == before:
+                break
+
+    def _taint_target(self, tgt: ast.expr) -> None:
+        if isinstance(tgt, ast.Name):
+            self.names.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._taint_target(elt)
+
+    def is_tainted(self, node: ast.expr) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("size", "ndim", "shape", "dtype"):
+                return False  # static under trace — plain Python values
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return node.attr in self.self_states
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Call):
+            root = _root_module(node.func)
+            if root in ("jnp", "jax"):
+                return True
+            if isinstance(node.func, ast.Attribute):  # method on a tainted value
+                return self.is_tainted(node.func.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.Compare):
+            # a comparison on an array is an array — bool(x == 0) concretizes
+            return self.is_tainted(node.left) or any(self.is_tainted(c) for c in node.comparators)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(elt) for elt in node.elts)
+        return False
+
+
+# ----------------------------------------------------------------- the rules
+
+
+def _walk_no_nested_fns(fn: ast.FunctionDef) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested def/class — nested
+    closures are frequently jit bodies with their own rules of engagement."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _self_attr_targets(stmt: ast.stmt) -> Iterator[Tuple[str, ast.AST]]:
+    if isinstance(stmt, ast.Assign):
+        targets: Sequence[ast.expr] = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        return
+    stack = list(targets)
+    while stack:
+        tgt = stack.pop()
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            stack.extend(tgt.elts)
+        elif isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            yield tgt.attr, tgt
+
+
+def check_ml001(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
+    """Unregistered ``self.<attr>`` assignment inside ``update``."""
+    states, counters, dynamic, _ = index.resolved_states(info)
+    if dynamic:
+        return  # state names are computed at runtime; nothing provable
+    for item in info.node.body:
+        if not (isinstance(item, ast.FunctionDef) and item.name == "update"):
+            continue
+        for stmt in _walk_no_nested_fns(item):
+            if not isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                continue
+            for attr, tgt in _self_attr_targets(stmt):
+                if attr in states or attr in counters:
+                    continue
+                yield Violation(
+                    "ML001", info.path, tgt.lineno, tgt.col_offset, f"{info.name}.update",
+                    f"`self.{attr}` assigned in update() but never registered via add_state"
+                    " (invisible to reset/snapshot; leaks tracers under shard_map) —"
+                    " register it or declare it in `_host_counters`",
+                )
+
+
+def _coercion_violations(
+    fn: ast.FunctionDef, taint: Taint, path: str, scope: str
+) -> Iterator[Violation]:
+    for node in _walk_no_nested_fns(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Name)
+                and func.id in ("float", "int", "bool")
+                and len(node.args) == 1
+                and not node.keywords
+                and taint.is_tainted(node.args[0])
+            ):
+                yield Violation(
+                    "ML002", path, node.lineno, node.col_offset, scope,
+                    f"`{func.id}()` on a traced array — raises ConcretizationTypeError under jit;"
+                    " keep the value on-device (jnp) or move the coercion off the jit path",
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "item"
+                and not node.args
+                and taint.is_tainted(func.value)
+            ):
+                yield Violation(
+                    "ML002", path, node.lineno, node.col_offset, scope,
+                    "`.item()` forces a device sync and fails on tracers —"
+                    " keep the value as a jax array",
+                )
+            elif (
+                isinstance(func, ast.Attribute)
+                and func.attr == "tolist"
+                and not node.args
+                and taint.is_tainted(func.value)
+            ):
+                yield Violation(
+                    "ML002", path, node.lineno, node.col_offset, scope,
+                    "`.tolist()` on a traced array — host materialization inside a jit-path body",
+                )
+        elif isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            if isinstance(test, (ast.Name, ast.Attribute)) and taint.is_tainted(test):
+                yield Violation(
+                    "ML002", path, node.lineno, node.col_offset, scope,
+                    "truth-test of a traced array (`if array:`) — raises TracerBoolConversionError"
+                    " under jit; use jnp.where or an explicit static condition",
+                )
+
+
+def _numpy_violations(fn: ast.FunctionDef, taint: Taint, path: str, scope: str) -> Iterator[Violation]:
+    for node in _walk_no_nested_fns(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name) and func.value.id == "np"):
+            continue
+        if func.attr not in _JNP_EQUIVALENTS:
+            continue
+        operands = list(node.args) + [kw.value for kw in node.keywords]
+        if any(taint.is_tainted(arg) for arg in operands):
+            yield Violation(
+                "ML004", path, node.lineno, node.col_offset, scope,
+                f"`np.{func.attr}` applied to a traced value — use `jnp.{func.attr}`"
+                " (numpy on a tracer forces a host round-trip or raises)",
+            )
+
+
+def check_jit_path_fn(
+    fn: ast.FunctionDef, path: str, scope: str, self_states: Optional[Set[str]] = None
+) -> Iterator[Violation]:
+    """ML002 + ML004 over one jit-path function/method body."""
+    taint = Taint(fn, self_states=self_states)
+    yield from _coercion_violations(fn, taint, path, scope)
+    yield from _numpy_violations(fn, taint, path, scope)
+
+
+def check_ml003(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
+    for node in ast.walk(info.node):
+        if not (isinstance(node, ast.Call) and _is_self_call(node, "add_state")):
+            continue
+        default = _call_arg(node, 1, "default")
+        fx = _call_arg(node, 2, "dist_reduce_fx")
+        fx_literal: object = None
+        fx_is_literal = fx is None or isinstance(fx, ast.Constant)
+        if isinstance(fx, ast.Constant):
+            fx_literal = fx.value
+        scope = f"{info.name}.add_state"
+        if fx_is_literal and fx_literal is not None and fx_literal not in _VALID_REDUCTIONS:
+            yield Violation(
+                "ML003", info.path, node.lineno, node.col_offset, scope,
+                f"dist_reduce_fx={fx_literal!r} is not a valid reduction"
+                f" (one of {list(_VALID_REDUCTIONS)}, a callable, or None)",
+            )
+            continue
+        if default is None:
+            continue
+        default_is_list = isinstance(default, (ast.List, ast.ListComp))
+        if default_is_list and isinstance(default, ast.List) and default.elts:
+            yield Violation(
+                "ML003", info.path, node.lineno, node.col_offset, scope,
+                "add_state default must be an EMPTY list (append/cat state) — a pre-filled"
+                " list default is rejected by the runtime",
+            )
+        if fx_is_literal and default_is_list and fx_literal not in ("cat", None):
+            yield Violation(
+                "ML003", info.path, node.lineno, node.col_offset, scope,
+                f"list default with dist_reduce_fx={fx_literal!r}: list states extend across"
+                " ranks, so only 'cat'/None reductions are meaningful — an arithmetic"
+                " reduction would silently concatenate instead of reducing",
+            )
+        array_literal = (
+            isinstance(default, ast.Constant)
+            or (isinstance(default, ast.Call) and _root_module(default.func) in ("jnp", "jax", "np"))
+        )
+        if fx_is_literal and fx_literal == "cat" and array_literal:
+            yield Violation(
+                "ML003", info.path, node.lineno, node.col_offset, scope,
+                "dist_reduce_fx='cat' with an array/scalar default: cat states should default"
+                " to `[]` so per-batch appends keep their identity (an array default is"
+                " concatenated INTO, changing shape every update)",
+            )
+
+
+def check_ml005(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
+    """Metric instances placed where ``_walk_metrics`` cannot see them.
+
+    ``_walk_metrics`` recurses attributes through arbitrarily nested
+    list/tuple/dict values; ``set``/``frozenset`` have no stable order and are
+    refused at runtime — flag the construction site statically.
+    """
+
+    def metric_ctor_inside(node: ast.AST) -> Optional[ast.Call]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                callee = None
+                if isinstance(sub.func, ast.Name):
+                    callee = sub.func.id
+                elif isinstance(sub.func, ast.Attribute):
+                    callee = sub.func.attr
+                if callee and index.is_metric_class(callee) and callee != "Metric":
+                    return sub
+        return None
+
+    for item in info.node.body:
+        if not isinstance(item, ast.FunctionDef):
+            continue
+        for node in ast.walk(item):
+            container: Optional[ast.AST] = None
+            if isinstance(node, (ast.Set, ast.SetComp)):
+                container = node
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("set", "frozenset")
+            ):
+                container = node
+            if container is None:
+                continue
+            hit = metric_ctor_inside(container)
+            if hit is not None:
+                yield Violation(
+                    "ML005", info.path, container.lineno, container.col_offset,
+                    f"{info.name}.{item.name}",
+                    "Metric constructed inside a set/frozenset — parallel/sharded.py:"
+                    "_walk_metrics cannot traverse unordered containers, so this child is"
+                    " invisible to the deep snapshot/reset/restore (silent state loss when"
+                    " sharded); use a list, tuple, or dict",
+                )
+
+
+# ------------------------------------------------------------- file checking
+
+
+def check_file(path: str, tree: ast.Module, index: ClassIndex) -> List[Violation]:
+    violations: List[Violation] = []
+    checked_methods: Set[int] = set()
+    for info in index.classes_in_file(path):
+        if not index.is_metric_class(info.name):
+            continue
+        states, counters, dynamic, host_only = index.resolved_states(info)
+        violations.extend(check_ml001(info, index))
+        violations.extend(check_ml003(info, index))
+        violations.extend(check_ml005(info, index))
+        for item in info.node.body:
+            if not (isinstance(item, ast.FunctionDef) and item.name in ("update", "compute")):
+                continue
+            checked_methods.add(id(item))
+            if host_only or (item.name == "update" and is_host_path_fn(item)):
+                continue  # never on the jit path — coercions are the contract
+            violations.extend(
+                check_jit_path_fn(item, path, f"{info.name}.{item.name}", self_states=states)
+            )
+    # functional kernels: every module-level function not proven host-path
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and id(node) not in checked_methods:
+            if is_host_path_fn(node):
+                continue
+            violations.extend(check_jit_path_fn(node, path, node.name))
+    return violations

@@ -55,8 +55,9 @@ except ImportError:  # pragma: no cover - older jax
 
 Array = jax.Array
 
-# compiled sharded-update steps keyed by (id(metric), id(mesh), axis); weakrefs
-# validate against id reuse after gc
+# compiled sharded-update steps keyed by (id(metric), id(mesh), axis,
+# walk-fingerprint); weakrefs validate against id reuse after gc, the
+# fingerprint invalidates on child-metric swaps / flag flips
 _SHARDED_FN_CACHE: Dict[Tuple, Tuple] = {}
 
 
@@ -266,11 +267,47 @@ def fold_jit_state(metric: "Any", state: Dict[str, Any]) -> None:
 
 def _walk_metrics(metric: "Any") -> list:
     """Depth-first ``[(path, metric), ...]`` over the metric and every Metric
-    reachable through its attributes — wrapper children held directly, inside
-    list/tuple attributes (``MultioutputWrapper.metrics``, ``MetricTracker``),
-    or as dict values. The root's path is ``""``; child paths are
-    ``attr``/``attr[i]``/``attr[key]`` segments joined with ``/``."""
+    reachable through its attributes — wrapper children held directly or
+    inside ARBITRARILY NESTED list/tuple/dict values (list-of-list,
+    dict-of-list, ...: ``MultioutputWrapper.metrics``, ``MetricTracker``,
+    user grids). The root's path is ``""``; child paths are
+    ``attr``/``attr[i]``/``attr[key]`` segments joined with ``/``.
+
+    A Metric reachable ONLY through an UNORDERED container (set/frozenset)
+    raises: its state would be silently excluded from the deep
+    snapshot/reset/restore and a traced update would later die with an
+    opaque ``UnexpectedTracerError``. A metric that merely ALSO sits in a
+    set (e.g. an auxiliary dedup index over a list attribute) is fine — the
+    check runs after the whole walk, against everything the supported paths
+    reached. metriclint rule ML005 flags the construction statically."""
     from torchmetrics_tpu.metric import Metric
+
+    set_hits: list = []
+
+    def find(seg: str, val: Any, found: list, visiting: set) -> None:
+        if isinstance(val, Metric):
+            found.append((seg, val))
+        elif isinstance(val, (list, tuple, dict)):
+            if id(val) in visiting:  # self-referential container
+                return
+            visiting.add(id(val))
+            items = val.items() if isinstance(val, dict) else enumerate(val)
+            for k, v in items:
+                find(f"{seg}[{k}]", v, found, visiting)
+        elif isinstance(val, (set, frozenset)):
+            collect_set_hits(seg, val)
+
+    def collect_set_hits(seg: str, val: Any) -> None:
+        # anything at any depth under a set/frozenset (members may be
+        # tuples/frozensets) is unreachable for the ordered state walk
+        if isinstance(val, Metric):
+            set_hits.append((seg, val))
+        elif isinstance(val, (set, frozenset, tuple, list)):
+            for v in val:
+                collect_set_hits(seg, v)
+        elif isinstance(val, dict):
+            for v in val.values():
+                collect_set_hits(seg, v)
 
     seen = {id(metric)}
     out = [("", metric)]
@@ -278,13 +315,8 @@ def _walk_metrics(metric: "Any") -> list:
     while stack:
         path, m = stack.pop()
         for attr, val in vars(m).items():
-            found = []
-            if isinstance(val, Metric):
-                found.append((attr, val))
-            elif isinstance(val, (list, tuple)):
-                found.extend((f"{attr}[{i}]", v) for i, v in enumerate(val) if isinstance(v, Metric))
-            elif isinstance(val, dict):
-                found.extend((f"{attr}[{k}]", v) for k, v in val.items() if isinstance(v, Metric))
+            found: list = []
+            find(attr, val, found, set())
             for seg, child in found:
                 if id(child) in seen:
                     continue
@@ -292,7 +324,26 @@ def _walk_metrics(metric: "Any") -> list:
                 child_path = f"{path}/{seg}" if path else seg
                 out.append((child_path, child))
                 stack.append((child_path, child))
+    orphaned = sorted({seg for seg, m in set_hits if id(m) not in seen})
+    if orphaned:
+        raise ValueError(
+            f"cannot shard: metric reachable only via unsupported container(s) {orphaned}"
+            " (set/frozenset have no stable order for the state walk) — use a list,"
+            " tuple, or dict"
+        )
     return out
+
+
+def _walk_fingerprint(metric: "Any") -> Tuple:
+    """Structural fingerprint of the metric walk for cache invalidation:
+    ``(path, id(child), unsupported-reason)`` per reachable metric. Swapping
+    a wrapper's child (``tracker.base_metric = other``) or flipping an
+    instance flag changes the fingerprint, so a cached compiled step keyed on
+    it can never silently fold the OLD children (ADVICE.md round-5)."""
+    return tuple(
+        (path, id(m), getattr(m, "_sharded_update_unsupported", None), getattr(m, "_sharded_fold_children", True))
+        for path, m in _walk_metrics(metric)
+    )
 
 
 def _fold_targets(metric: "Any") -> list:
@@ -465,13 +516,21 @@ def sharded_update(
     compiled step is cached on the metric per (mesh, axis), so repeated calls
     dispatch the same XLA program.
     """
-    key = (id(metric), id(mesh), axis_name)
+    # the walk fingerprint is part of the key: swapping a wrapper's child or
+    # flipping an instance-level flag after the first call must invalidate the
+    # cached compiled step, or it would silently fold the OLD children
+    # (ADVICE.md round-5). The fingerprint walk re-runs per call but is a
+    # cheap host-side attribute scan; the expensive parts (trace + compile +
+    # fold-target resolution) stay cached.
+    key = (id(metric), id(mesh), axis_name, _walk_fingerprint(metric))
     entry = _SHARDED_FN_CACHE.get(key)
     if entry is None or entry[0]() is not metric or entry[1]() is not mesh:
         ref_m, ref_mesh = weakref.ref(metric), weakref.ref(mesh)
-        # the fold-target walk is invariant per metric — cache it with the
-        # compiled step so the hot path skips the recursive attribute scan
         entry = (ref_m, ref_mesh, make_sharded_update(metric, mesh, axis_name=axis_name), _fold_targets(metric))
+        # evict superseded fingerprints of the same (metric, mesh, axis) so
+        # repeated child swaps do not grow the cache without bound
+        for old in [k for k in _SHARDED_FN_CACHE if k[:3] == key[:3] and k != key]:
+            del _SHARDED_FN_CACHE[old]
         _SHARDED_FN_CACHE[key] = entry
     update_fn, walk = entry[2], entry[3]
     merged = update_fn(*args)

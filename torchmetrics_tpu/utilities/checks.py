@@ -74,7 +74,7 @@ def _check_retrieval_target_and_prediction_types(
         not allow_non_binary_target
         and _is_concrete(target)
         and target.size
-        and bool((target.max() > 1) | (target.min() < 0))
+        and bool((target.max() > 1) | (target.min() < 0))  # metriclint: disable=ML002 -- guarded by _is_concrete: a tracer never reaches the coercion
     ):
         # range semantics, not exact-{0,1}: the reference accepts fractional
         # relevance in [0, 1] (checks.py:610)
@@ -112,7 +112,7 @@ def _check_retrieval_inputs(
     return (indexes.reshape(-1).astype(jnp.int32), preds, target)
 
 
-def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:
+def _allclose_recursive(res1, res2, atol: float = 1e-6) -> bool:  # metriclint: disable=ML002 -- test-harness comparison helper, host-only
     if isinstance(res1, (list, tuple)):
         return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
     if isinstance(res1, dict):

@@ -126,7 +126,7 @@ def _cumsum(x: Array, dim: int = 0, dtype=None) -> Array:
     return jnp.cumsum(x, axis=dim, dtype=dtype)
 
 
-def _flexible_bincount(x: Array) -> Array:
+def _flexible_bincount(x: Array) -> Array:  # metriclint: disable=ML004 -- unique is inherently dynamic-shape; documented host-only helper
     """Count occurrences of each *unique* value (reference ``data.py:222``).
 
     Unique is inherently dynamic-shape; runs on host (NumPy). Only used in
@@ -137,7 +137,7 @@ def _flexible_bincount(x: Array) -> Array:
     return jnp.asarray(counts)
 
 
-def allclose(tensor1: Array, tensor2: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+def allclose(tensor1: Array, tensor2: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:  # metriclint: disable=ML002 -- returns a Python bool by contract; host-only comparison helper
     """Shape- and dtype-robust allclose (reference ``data.py:241``)."""
     if jnp.shape(tensor1) != jnp.shape(tensor2):
         return False
