@@ -19,7 +19,15 @@ Each check asserts the synced value equals the single-process result on the
 concatenated data (both ranks hold the full dataset; each updates with its
 slice). Exits non-zero on any mismatch; the parent test checks exit codes.
 
-Usage: ``python mp_sync_worker.py <process_id> <num_processes> <coord_addr>``
+A second scenario, ``faults``, exercises the robustness layer under REAL
+injected faults across the 2-process group (``robustness/faults.py``, both
+env-driven and in-process): corrupt/truncated object-gather payloads raise
+``SyncError`` naming the offending rank, a transient failure succeeds after
+retry/backoff, ``on_error="local"`` degrades to local-only state, a mid-sync
+failure rolls back cleanly, and an ``ndim > 8`` array gathers through the
+dynamically-sized shape buffer.
+
+Usage: ``python mp_sync_worker.py <process_id> <num_processes> <coord_addr> [scenario]``
 """
 from __future__ import annotations
 
@@ -28,12 +36,125 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")  # before any backend use (axon!)
+# XLA's CPU backend refuses multi-process programs unless a cross-host
+# collectives transport is configured; gloo ships in-tree
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def run_fault_scenarios(pid: int, nproc: int) -> None:
+    """Injected-fault cases — every fault is deterministic and either
+    rank-scoped or identical on all ranks, so the group stays in lockstep."""
+    import warnings
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.robustness import SyncConfig, faults
+    from torchmetrics_tpu.utilities.distributed import _gather_objects_via_bytes, gather_all_arrays
+    from torchmetrics_tpu.utilities.exceptions import SyncError, SyncWarning
+
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 48
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    bounds = [0, 37, n_total]
+    lo, hi = bounds[pid], bounds[pid + 1]
+
+    def expected(p, t):
+        m = BinaryAccuracy(distributed_available_fn=lambda: False)
+        m.update(p, t)
+        return float(m.compute())
+
+    # A) env-driven corrupt payload on rank 1 (TM_TPU_FAULTS set by the parent
+    # test): the CRC check raises SyncError NAMING rank 1 on BOTH ranks
+    assert faults.active(), "parent must export TM_TPU_FAULTS for the faults scenario"
+    try:
+        _gather_objects_via_bytes(("rle-ish payload", pid))
+        raise AssertionError("corrupt object gather did not raise")
+    except SyncError as err:
+        assert "rank 1" in str(err) and "corrupt" in str(err).lower(), f"bad SyncError message: {err}"
+    # the fault was count=1: the very next gather heals
+    objs = _gather_objects_via_bytes(("rle-ish payload", pid))
+    assert [o[1] for o in objs] == [0, 1], objs
+    faults.clear()
+
+    # B) truncated payload on rank 0 (in-process injection)
+    with faults.inject(faults.Fault("truncate", "gather_bytes.payload", rank=0, arg=64)):
+        try:
+            _gather_objects_via_bytes(("x" * 512, pid))
+            raise AssertionError("truncated object gather did not raise")
+        except SyncError as err:
+            assert "rank 0" in str(err) and "truncated" in str(err), f"bad SyncError message: {err}"
+
+    # C) transient failure (both ranks, before any collective) succeeds after
+    # retry/backoff and matches the single-process result
+    acc = BinaryAccuracy(sync_config=SyncConfig(retries=3, backoff_base_s=0.05, backoff_max_s=0.2))
+    acc.update(preds[lo:hi], target[lo:hi])
+    with faults.inject(faults.Fault("fail", "sync.attempt", count=2)):
+        got = float(acc.compute())
+    want = expected(preds, target)
+    assert abs(got - want) < 1e-6, f"retry/backoff sync: {got} != {want}"
+
+    # D) on_error="local": every attempt fails -> local-only state with ONE
+    # rank-zero warning; the local state stays intact and a later sync heals
+    acc2 = BinaryAccuracy(sync_config=SyncConfig(retries=0, on_error="local"))
+    acc2.update(preds[lo:hi], target[lo:hi])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with faults.inject(faults.Fault("fail", "sync.attempt")):
+            got_local = float(acc2.compute())
+    want_local = expected(preds[lo:hi], target[lo:hi])
+    assert abs(got_local - want_local) < 1e-6, f"local fallback: {got_local} != {want_local}"
+    n_warn = sum(issubclass(w.category, SyncWarning) for w in caught)
+    assert n_warn == (1 if pid == 0 else 0), f"rank {pid}: {n_warn} SyncWarnings"
+    # subsequent compute() (faults gone) proves local state survived AND syncs
+    acc2._computed = None
+    got_healed = float(acc2.compute())
+    assert abs(got_healed - want) < 1e-6, f"post-fallback sync: {got_healed} != {want}"
+
+    # E) mid-sync failure: all gathers complete, then the apply loop dies
+    # after overwriting one state — sync() must roll back to the pre-sync
+    # cache, never leaving the metric half-synced
+    acc3 = BinaryAccuracy()
+    acc3.update(preds[lo:hi], target[lo:hi])
+    before = {k: np.asarray(v) for k, v in acc3.state_tree(include_count=True).items()}
+    with faults.inject(faults.Fault("fail", "sync.state_apply", after=1, count=1)):
+        try:
+            acc3.sync()
+            raise AssertionError("mid-sync fault did not raise")
+        except SyncError:
+            pass
+    after = acc3.state_tree(include_count=True)
+    for key, val in before.items():
+        np.testing.assert_array_equal(np.asarray(after[key]), val, err_msg=f"half-synced state {key!r}")
+    assert not acc3._is_synced and acc3._cache is None
+    # and the group is still healthy: a clean sync round-trips
+    acc3.sync()
+    acc3.unsync()
+    got3 = float(acc3.compute())
+    assert abs(got3 - want) < 1e-6, f"post-rollback sync: {got3} != {want}"
+
+    # F) ndim > 8 gather rides the dynamically-sized shape buffer (satellite:
+    # the static max_rank=8 buffer used to overflow) — uneven last dim takes
+    # the pad/trim slow path at rank 10
+    local = jnp.full((1,) * 9 + (2 + pid,), float(pid), dtype=jnp.float32)
+    gathered = gather_all_arrays(local)
+    assert [g.shape for g in gathered] == [(1,) * 9 + (2,), (1,) * 9 + (3,)], [g.shape for g in gathered]
+    np.testing.assert_allclose(np.asarray(gathered[1]), np.ones((1,) * 9 + (3,)))
+
+    print(f"rank {pid}: all injected-fault checks passed")
 
 
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
     jax.distributed.initialize(coordinator_address=coord, num_processes=nproc, process_id=pid)
     assert jax.process_count() == nproc, f"process_count={jax.process_count()}"
+    if scenario == "faults":
+        run_fault_scenarios(pid, nproc)
+        return
+    assert scenario == "full", f"unknown scenario {scenario!r}"
 
     import numpy as np
     import jax.numpy as jnp

@@ -3,18 +3,26 @@
 """Checkpoint/resume via pytree serialization (SURVEY §5.4: metric states are
 pytrees, so orbax/msgpack checkpointing comes for free — the analogue of the
 reference's nn.Module state-dict protocol tests,
-``tests/unittests/bases/test_saving_loading.py``)."""
+``tests/unittests/bases/test_saving_loading.py``) plus the ISSUE 2
+self-validating ``save_checkpoint``/``load_checkpoint`` helpers: list-state
+("cat") and wrapper metrics round-trip, and corrupted/mismatched payloads
+raise ``StateRestoreError`` instead of returning garbage."""
+import pickle
+
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
 import torchmetrics_tpu as tm
+from torchmetrics_tpu.classification import BinaryAveragePrecision
 from torchmetrics_tpu.classification.accuracy import MulticlassAccuracy
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
 
 
 def test_orbax_checkpoint_roundtrip(tmp_path):
-    """A metric's state tree checkpoints and restores through orbax."""
+    """A metric's state tree checkpoints and restores through orbax; the
+    update count rides the tree symmetrically (``include_count=True``)."""
     ocp = pytest.importorskip("orbax.checkpoint")
 
     metric = MulticlassAccuracy(num_classes=5)
@@ -23,7 +31,7 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
         metric.update(rng.randint(0, 5, 64), rng.randint(0, 5, 64))
     expected = float(metric.compute())
 
-    ckpt = {"state": metric.state_tree(), "update_count": metric._update_count}
+    ckpt = {"state": metric.state_tree(include_count=True)}
     checkpointer = ocp.PyTreeCheckpointer()
     path = tmp_path / "metric_ckpt"
     checkpointer.save(str(path), ckpt)
@@ -31,7 +39,7 @@ def test_orbax_checkpoint_roundtrip(tmp_path):
     restored = checkpointer.restore(str(path))
     fresh = MulticlassAccuracy(num_classes=5)
     fresh.load_state_tree({k: jnp.asarray(v) for k, v in restored["state"].items()})
-    fresh._update_count = int(restored["update_count"])
+    assert fresh._update_count == 3
     np.testing.assert_allclose(float(fresh.compute()), expected, rtol=1e-6)
 
     # resumed metric keeps accumulating correctly
@@ -55,3 +63,130 @@ def test_persistent_state_dict_roundtrip_across_domains():
     fresh.load_state_dict(sd)
     fresh._update_count = 1
     np.testing.assert_allclose(np.asarray(fresh.compute()), expected, rtol=1e-6)
+
+
+def _assert_states_equal(got, want):
+    got_tree, want_tree = got.state_tree(include_count=True), want.state_tree(include_count=True)
+    assert set(got_tree) == set(want_tree)
+    for key, want_val in want_tree.items():
+        got_val = got_tree[key]
+        if isinstance(want_val, list):
+            assert len(got_val) == len(want_val), key
+            for g, w in zip(got_val, want_val):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=key)
+        else:
+            np.testing.assert_array_equal(np.asarray(got_val), np.asarray(want_val), err_msg=key)
+
+
+def test_checkpoint_roundtrip_array_state_bit_for_bit():
+    """accumulate -> checkpoint -> restore -> accumulate equals the unbroken
+    stream bit-for-bit (ISSUE 2 acceptance)."""
+    rng = np.random.RandomState(7)
+    batches = [(rng.randint(0, 5, 48), rng.randint(0, 5, 48)) for _ in range(6)]
+
+    m = MulticlassAccuracy(num_classes=5)
+    for b in batches[:3]:
+        m.update(*b)
+    blob = pickle.dumps(m.save_checkpoint())  # msgpack-/pickle-safe plain dict
+
+    resumed = MulticlassAccuracy(num_classes=5)
+    resumed.load_checkpoint(pickle.loads(blob))
+    for b in batches[3:]:
+        resumed.update(*b)
+
+    unbroken = MulticlassAccuracy(num_classes=5)
+    for b in batches:
+        unbroken.update(*b)
+    _assert_states_equal(resumed, unbroken)
+    assert float(resumed.compute()) == float(unbroken.compute())
+
+
+def test_checkpoint_roundtrip_list_state_metric():
+    """List-state ("cat" reduction) metrics checkpoint too — the gap the
+    previous array-state-only coverage left open."""
+    rng = np.random.RandomState(11)
+    batches = [(rng.rand(16).astype(np.float32), rng.randint(0, 2, 16)) for _ in range(4)]
+
+    ap = BinaryAveragePrecision()
+    for b in batches[:2]:
+        ap.update(*b)
+    ckpt = pickle.loads(pickle.dumps(ap.save_checkpoint()))
+
+    resumed = BinaryAveragePrecision()
+    resumed.load_checkpoint(ckpt)
+    for b in batches[2:]:
+        resumed.update(*b)
+
+    unbroken = BinaryAveragePrecision()
+    for b in batches:
+        unbroken.update(*b)
+    _assert_states_equal(resumed, unbroken)
+    assert float(resumed.compute()) == float(unbroken.compute())
+
+
+def test_checkpoint_roundtrip_wrapper_metric():
+    """Wrapper metrics checkpoint deeply: the child's registry AND host
+    counters (``Running._num_vals_seen``) ride along."""
+    vals = [1.0, 4.0, 2.0, 8.0, 5.0]
+    m = tm.RunningMean(window=3)
+    for v in vals[:3]:
+        m.update(v)
+    ckpt = pickle.loads(pickle.dumps(m.save_checkpoint()))
+
+    resumed = tm.RunningMean(window=3)
+    resumed.load_checkpoint(ckpt)
+    assert resumed._num_vals_seen == 3
+    for v in vals[3:]:
+        resumed.update(v)
+
+    unbroken = tm.RunningMean(window=3)
+    for v in vals:
+        unbroken.update(v)
+    assert float(resumed.compute()) == float(unbroken.compute())
+
+
+def test_checkpoint_truncated_or_corrupted_raises():
+    m = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(0)
+    m.update(rng.randint(0, 5, 32), rng.randint(0, 5, 32))
+    ckpt = m.save_checkpoint()
+
+    fresh = MulticlassAccuracy(num_classes=5)
+    with pytest.raises(StateRestoreError, match="truncated or corrupted"):
+        fresh.load_checkpoint(b"not a checkpoint")
+    truncated = {k: v for k, v in ckpt.items() if k != "metrics"}
+    with pytest.raises(StateRestoreError, match="missing key.*metrics"):
+        fresh.load_checkpoint(truncated)
+    half_entry = pickle.loads(pickle.dumps(ckpt))
+    del half_entry["metrics"][""]["state"]
+    with pytest.raises(StateRestoreError, match="malformed"):
+        fresh.load_checkpoint(half_entry)
+    # a corrupted leaf (wrong-shaped garbage) is named
+    corrupt = pickle.loads(pickle.dumps(ckpt))
+    name = next(iter(corrupt["metrics"][""]["state"]))
+    corrupt["metrics"][""]["state"][name] = np.zeros((13, 13, 13), np.float16)
+    with pytest.raises(StateRestoreError, match=name):
+        fresh.load_checkpoint(corrupt)
+    # future format versions are refused
+    versioned = pickle.loads(pickle.dumps(ckpt))
+    versioned["format_version"] = 99
+    with pytest.raises(StateRestoreError, match="format_version"):
+        fresh.load_checkpoint(versioned)
+    # and after all those failures the target metric is still untouched/usable
+    assert fresh._update_count == 0
+    fresh.load_checkpoint(ckpt)
+    assert float(fresh.compute()) == float(m.compute())
+
+
+def test_checkpoint_num_classes_mismatch_raises():
+    """The acceptance headline: a num_classes=5 checkpoint refuses to restore
+    into a num_classes=7 metric, naming the offending state."""
+    src = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(2)
+    src.update(rng.randint(0, 5, 64), rng.randint(0, 5, 64))
+    ckpt = src.save_checkpoint()
+    dst = MulticlassAccuracy(num_classes=7)
+    with pytest.raises(StateRestoreError, match="expected shape"):
+        dst.load_checkpoint(ckpt)
+    # nothing was half-restored
+    assert dst._update_count == 0

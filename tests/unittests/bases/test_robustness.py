@@ -1,0 +1,363 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Robustness layer (ISSUE 2): validated state restore, fault-tolerant sync,
+and the deterministic fault-injection harness — single-process coverage.
+The real 2-process injected-fault cases live in
+``tests/unittests/_helpers/mp_sync_worker.py`` (``faults`` scenario)."""
+import pickle
+import time
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.classification import BinaryAveragePrecision, MulticlassAccuracy
+from torchmetrics_tpu.robustness import SyncConfig, build_state_specs, faults, spec_fingerprint
+from torchmetrics_tpu.utilities.exceptions import (
+    StateRestoreError,
+    SyncError,
+    SyncWarning,
+    TorchMetricsUserError,
+)
+
+
+class TwoState(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(x.size, jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+def _fake_two_rank_gather(value, group=None):
+    """Single-process stand-in for a 2-process gather: every rank holds the
+    same state, so the reduced result is the doubled accumulation."""
+    return [value, value]
+
+
+# ---------------------------------------------------------------- state specs
+
+
+def test_state_spec_contents():
+    m = TwoState()
+    spec = m.state_spec()
+    states = spec["states"]
+    assert states["total"].kind == "array" and states["total"].dtype == "float32"
+    assert states["total"].shape == () and states["total"].reduction == "sum"
+    assert states["count"].dtype == "int32"
+    assert spec["_update_count"] == 0
+    m.update([1.0, 2.0])
+    assert m.state_spec()["_update_count"] == 1
+
+    ap = BinaryAveragePrecision()
+    ap_states = build_state_specs(ap)
+    assert ap_states["preds"].kind == "list" and ap_states["preds"].reduction == "cat"
+
+
+def test_spec_fingerprint_stability_and_sensitivity():
+    # same config -> same fingerprint, across instances
+    assert spec_fingerprint(MulticlassAccuracy(num_classes=5)) == spec_fingerprint(MulticlassAccuracy(num_classes=5))
+    # different shape (num_classes), different class -> different fingerprint
+    assert spec_fingerprint(MulticlassAccuracy(num_classes=5)) != spec_fingerprint(MulticlassAccuracy(num_classes=7))
+    assert spec_fingerprint(TwoState()) != spec_fingerprint(BinaryAveragePrecision())
+
+
+# ------------------------------------------------------- load_state_tree strict
+
+
+def test_load_state_tree_rejects_unknown_and_missing_keys():
+    m = TwoState()
+    good = m.state_tree()
+    with pytest.raises(StateRestoreError, match="Unknown metric state.*bogus"):
+        m.load_state_tree({**good, "bogus": jnp.asarray(1.0)})
+    with pytest.raises(StateRestoreError, match="Missing metric state.*count"):
+        m.load_state_tree({"total": good["total"]})
+    # non-strict: unknown dropped, missing allowed
+    m.load_state_tree({"total": jnp.asarray(3.0), "bogus": jnp.asarray(1.0)}, strict=False)
+    assert float(m.total) == 3.0
+
+
+def test_load_state_tree_rejects_kind_mismatch():
+    m = TwoState()
+    with pytest.raises(StateRestoreError, match="total.*expected an array"):
+        m.load_state_tree({"total": [jnp.asarray(1.0)], "count": m.count})
+    ap = BinaryAveragePrecision()
+    tree = ap.state_tree()
+    tree["preds"] = jnp.zeros((3,))
+    with pytest.raises(StateRestoreError, match="preds.*expected a list"):
+        ap.load_state_tree(tree)
+
+
+def test_load_state_tree_rejects_shape_mismatch():
+    """The headline failure mode: restoring num_classes=5 state into a
+    num_classes=7 metric raises at restore time, naming the state — instead
+    of detonating later inside jit."""
+    src = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(0)
+    src.update(rng.randint(0, 5, 64), rng.randint(0, 5, 64))
+    dst = MulticlassAccuracy(num_classes=7)
+    with pytest.raises(StateRestoreError, match="expected shape"):
+        dst.load_state_tree(src.state_tree())
+
+
+def test_load_state_tree_dtype_strict_and_widening():
+    m = TwoState()
+    tree = {"total": jnp.asarray(1.0), "count": np.asarray(3, np.int16)}
+    with pytest.raises(StateRestoreError, match="count.*expected dtype int32, got int16"):
+        m.load_state_tree(tree)
+    # non-strict coerces the SAFE widening int16 -> int32
+    m.load_state_tree(tree, strict=False)
+    assert int(m.count) == 3 and m.count.dtype == np.int32
+    # lossy narrowing refuses even in non-strict mode
+    with pytest.raises(StateRestoreError, match="total.*cannot coerce"):
+        m.load_state_tree({"total": np.asarray(1.0, np.float64)}, strict=False)
+
+
+def test_load_state_tree_carries_update_count():
+    m = TwoState()
+    m.update([1.0, 2.0])
+    tree = m.state_tree(include_count=True)
+    assert tree["_update_count"] == 1
+    fresh = TwoState()
+    fresh.load_state_tree(tree)
+    assert fresh._update_count == 1 and float(fresh.compute()) == 1.5
+
+
+# ------------------------------------------------------- fault-tolerant sync
+
+
+def test_sync_transient_failure_retries_with_backoff():
+    m = TwoState(
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=_fake_two_rank_gather,
+        sync_config=SyncConfig(retries=2, backoff_base_s=0.05, backoff_factor=1.0),
+    )
+    m.update([1.0, 3.0])
+    t0 = time.monotonic()
+    with faults.inject(faults.Fault("fail", "sync.attempt", count=2)):
+        val = float(m.compute())
+    assert val == 2.0  # doubled sum / doubled count
+    assert time.monotonic() - t0 >= 0.08  # two backoff sleeps happened
+    assert not m._is_synced and m._cache is None  # unsync restored local state
+
+
+def test_sync_exhausted_retries_raise_sync_error_and_roll_back():
+    m = TwoState(distributed_available_fn=lambda: True, dist_sync_fn=_fake_two_rank_gather)
+    m.update([1.0, 3.0])
+    before = m.state_tree(include_count=True)
+    with faults.inject(faults.Fault("fail", "sync.attempt")):
+        with pytest.raises(SyncError, match="TwoState.sync..*failed after 1 attempt"):
+            m.sync()
+    after = m.state_tree(include_count=True)
+    for key in before:
+        np.testing.assert_array_equal(np.asarray(after[key]), np.asarray(before[key]))
+    assert not m._is_synced and m._cache is None
+
+
+def test_sync_on_error_local_degrades_with_one_warning():
+    m = TwoState(
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=_fake_two_rank_gather,
+        sync_config=SyncConfig(on_error="local"),
+    )
+    m.update([1.0, 3.0])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with faults.inject(faults.Fault("fail", "sync.attempt")):
+            val = float(m.compute())
+    assert val == 2.0  # local-only value
+    assert sum(issubclass(w.category, SyncWarning) for w in caught) == 1
+    # local state intact: without the fault the next compute syncs normally
+    m._computed = None
+    assert float(m.compute()) == 2.0  # mean is scale-free; sync path exercised
+    assert not m._is_synced
+
+
+def test_sync_mid_apply_failure_never_half_syncs():
+    m = TwoState(distributed_available_fn=lambda: True, dist_sync_fn=_fake_two_rank_gather)
+    m.update([1.0, 3.0])
+    before = {k: np.asarray(v) for k, v in m.state_tree(include_count=True).items()}
+    # first state applies (overwritten with the doubled value), second dies
+    with faults.inject(faults.Fault("fail", "sync.state_apply", after=1, count=1)):
+        with pytest.raises(SyncError):
+            m.sync(dist_sync_fn=_fake_two_rank_gather)
+    after = m.state_tree(include_count=True)
+    for key, val in before.items():
+        np.testing.assert_array_equal(np.asarray(after[key]), val, err_msg=f"half-synced state {key!r}")
+    # a clean sync afterwards works
+    m.sync(dist_sync_fn=_fake_two_rank_gather)
+    assert float(m.total) == 8.0 and m._is_synced
+    m.unsync()
+    assert float(m.total) == 4.0
+
+
+def test_sync_timeout_raises_instead_of_hanging():
+    def _hanging_gather(value, group=None):
+        time.sleep(5.0)
+        return [value]
+
+    m = TwoState(
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=_hanging_gather,
+        sync_config=SyncConfig(timeout_s=0.2),
+    )
+    m.update([1.0])
+    t0 = time.monotonic()
+    with pytest.raises(SyncError, match="timed out after 0.2s"):
+        m.sync(dist_sync_fn=_hanging_gather)
+    assert time.monotonic() - t0 < 2.0
+    assert not m._is_synced and m._cache is None
+
+
+def test_sync_double_sync_still_guarded():
+    m = TwoState(distributed_available_fn=lambda: True, dist_sync_fn=_fake_two_rank_gather)
+    m.update([2.0])
+    m.sync()
+    with pytest.raises(TorchMetricsUserError, match="already been synced"):
+        m.sync()
+
+
+# ------------------------------------------------------ object-gather integrity
+
+
+def test_object_gather_crc_roundtrip_and_faults():
+    from torchmetrics_tpu.utilities.distributed import _gather_objects_via_bytes
+
+    payload = {"size": [7, 9], "counts": bytes(range(64))}
+    assert _gather_objects_via_bytes(payload) == [payload]
+    with faults.inject(faults.Fault("corrupt", "gather_bytes.payload", arg=8)):
+        with pytest.raises(SyncError, match="rank 0.*corrupt"):
+            _gather_objects_via_bytes(payload)
+    with faults.inject(faults.Fault("truncate", "gather_bytes.payload", arg=16)):
+        with pytest.raises(SyncError, match="rank 0.*truncated"):
+            _gather_objects_via_bytes(payload)
+    # harness off again: the path is clean
+    assert _gather_objects_via_bytes(payload) == [payload]
+
+
+# -------------------------------------------------------------- fault harness
+
+
+def test_fault_injection_is_deterministic_and_scoped():
+    fault = faults.Fault("fail", "sync.attempt", after=1, count=2)
+    with faults.inject(fault):
+        faults.fire("sync.attempt")  # hit 0: skipped (after=1)
+        for _ in range(2):  # hits 1-2: fire
+            with pytest.raises(faults.FaultInjected):
+                faults.fire("sync.attempt")
+        faults.fire("sync.attempt")  # hit 3: count exhausted
+        faults.fire("other.point")  # never matches
+    faults.fire("sync.attempt")  # uninstalled after the context
+    assert not faults.active() and fault._hits == 0  # counters reset on exit
+
+
+def test_inject_removes_by_identity_not_equality():
+    """Exiting an inject() scope must not evict a distinct-but-equal fault
+    installed elsewhere (e.g. via TM_TPU_FAULTS)."""
+    env_fault = faults.Fault("fail", "some.point")
+    faults.install(env_fault)
+    try:
+        with faults.inject(faults.Fault("fail", "some.point")):
+            assert len(faults._ACTIVE) == 2
+        assert len(faults._ACTIVE) == 1 and faults._ACTIVE[0] is env_fault
+    finally:
+        faults.clear()
+
+
+class ObjCounter(Metric):
+    """Metric with a non-serializable host counter (PerceptualPathLength's
+    generator pattern) next to a plain one."""
+
+    full_state_update = False
+    _host_counters = ("_obj", "_n")
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._obj = lambda: None  # unpicklable runtime object
+        self._n = 2
+
+    def update(self, v):
+        self.x = self.x + jnp.asarray(v, jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+def test_checkpoint_host_counters_plain_only_and_declared_only():
+    m = ObjCounter()
+    m.update(1.0)
+    m._n = 5
+    # non-plain counters are skipped on save: the checkpoint stays picklable
+    ckpt = pickle.loads(pickle.dumps(m.save_checkpoint()))
+    assert ckpt["metrics"][""]["host_counters"] == {"_n": 5}
+    fresh = ObjCounter()
+    fresh.load_checkpoint(ckpt)
+    assert fresh._n == 5 and callable(fresh._obj)  # _obj untouched
+    # a corrupted payload cannot clobber undeclared attributes via setattr
+    evil = pickle.loads(pickle.dumps(ckpt))
+    evil["metrics"][""]["host_counters"] = {"_defaults": {}}
+    with pytest.raises(StateRestoreError, match="host counter"):
+        fresh.load_checkpoint(evil)
+    assert fresh._defaults  # registry intact
+    # non-strict: the undeclared counter is dropped, the rest restores
+    fresh._n = 0
+    evil["metrics"][""]["host_counters"]["_n"] = 7
+    fresh.load_checkpoint(evil, strict=False)
+    assert fresh._n == 7 and fresh._defaults
+
+
+def test_fault_env_spec_parsing():
+    installed = faults.install_from_env("fail:sync.attempt:count=2;delay:gather_bytes.pre:rank=1:arg=0.5")
+    try:
+        assert [f.kind for f in installed] == ["fail", "delay"]
+        assert installed[0].count == 2 and installed[0].rank is None
+        assert installed[1].rank == 1 and installed[1].arg == 0.5
+    finally:
+        faults.clear()
+    with pytest.raises(ValueError, match="malformed"):
+        faults.install_from_env("justonefield")
+    with pytest.raises(ValueError, match="unknown TM_TPU_FAULTS option"):
+        faults.install_from_env("fail:p:bogus=1")
+    faults.clear()
+
+
+def test_simulated_preemption_checkpoint_drill():
+    """Preemption between updates: the in-flight update's contribution is
+    lost with the host; restoring the checkpoint and replaying the stream
+    reproduces the unbroken run bit-for-bit."""
+    rng = np.random.RandomState(3)
+    batches = [(rng.randint(0, 5, 32), rng.randint(0, 5, 32)) for _ in range(4)]
+
+    m = MulticlassAccuracy(num_classes=5)
+    m.update(*batches[0])
+    m.update(*batches[1])
+    ckpt = m.save_checkpoint()
+    with faults.inject(faults.Fault("preempt", "update.preempt", count=1)):
+        with pytest.raises(faults.SimulatedPreemption):
+            m.update(*batches[2])  # host dies mid-stream
+
+    resumed = MulticlassAccuracy(num_classes=5)
+    resumed.load_checkpoint(ckpt)
+    resumed.update(*batches[2])
+    resumed.update(*batches[3])
+
+    unbroken = MulticlassAccuracy(num_classes=5)
+    for b in batches:
+        unbroken.update(*b)
+    want = unbroken.state_tree(include_count=True)
+    got = resumed.state_tree(include_count=True)
+    for key in want:
+        np.testing.assert_array_equal(np.asarray(got[key]), np.asarray(want[key]), err_msg=key)
+    assert float(resumed.compute()) == float(unbroken.compute())

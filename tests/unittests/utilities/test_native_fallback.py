@@ -1,0 +1,65 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Native-extension degradation (ISSUE 2 satellite): with
+``TM_TPU_DISABLE_NATIVE=1`` or a broken compiler the WER/EditDistance kernels
+and the RLE codec fall back to numpy — silently when disabled deliberately,
+with EXACTLY ONE warning per extension when compilation fails."""
+import warnings
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.native as native
+from torchmetrics_tpu.functional.detection import mask_utils
+from torchmetrics_tpu.functional.text.helper import _batch_edit_distance
+
+
+@pytest.fixture()
+def fresh_lib_cache(monkeypatch):
+    """Isolate the per-process library cache so this test neither sees nor
+    clobbers libraries loaded by other tests."""
+    monkeypatch.setattr(native, "_libs", {})
+
+
+def _exercise_fallbacks():
+    """Run the numpy fallbacks of both extensions and check their results."""
+    dists = _batch_edit_distance([list("kitten"), list("flaw")], [list("sitting"), list("lawn")])
+    np.testing.assert_array_equal(np.asarray(dists), [3, 2])
+    mask = np.zeros((6, 9), np.uint8)
+    mask[1:4, 2:7] = 1
+    rle = mask_utils.encode(mask)
+    np.testing.assert_array_equal(mask_utils.decode(rle), mask)
+    assert float(mask_utils.area(rle)) == mask.sum()
+
+
+def test_disable_native_env_is_silent(fresh_lib_cache, monkeypatch):
+    monkeypatch.setenv("TM_TPU_DISABLE_NATIVE", "1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # ANY warning fails the test
+        assert native.get_rle_library() is None
+        assert native.get_edit_library() is None
+        assert native.native_available() is False
+        _exercise_fallbacks()
+    # toggling back re-enables native resolution in-process (no stale cache)
+    monkeypatch.setenv("TM_TPU_DISABLE_NATIVE", "0")
+    assert native._native_disabled() is False
+
+
+def test_compile_failure_warns_exactly_once_per_extension(fresh_lib_cache, monkeypatch, tmp_path):
+    """g++ gone: every call degrades to numpy with one warning per extension,
+    not one per call (and not a hard failure)."""
+    monkeypatch.delenv("TM_TPU_DISABLE_NATIVE", raising=False)
+    # point the .so cache at an empty dir and hide g++ so the real build path
+    # runs and fails (FileNotFoundError inside _build_library)
+    monkeypatch.setenv("TM_TPU_NATIVE_CACHE", str(tmp_path))
+    monkeypatch.setenv("PATH", str(tmp_path))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for _ in range(3):  # repeated calls: the None is cached, no re-warn
+            assert native.get_edit_library() is None
+            assert native.get_rle_library() is None
+            _exercise_fallbacks()
+    messages = [str(w.message) for w in caught if "native extension" in str(w.message)]
+    assert len(messages) == 2, messages
+    assert any("edit_distance" in m for m in messages) and any("rle_codec" in m for m in messages)
+    assert all("TM_TPU_DISABLE_NATIVE" in m for m in messages)

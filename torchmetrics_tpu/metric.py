@@ -41,9 +41,11 @@ from torchmetrics_tpu.utilities.data import (
     dim_zero_min,
     dim_zero_sum,
 )
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.robustness.sync_config import DEFAULT_SYNC_CONFIG, SyncConfig
 from torchmetrics_tpu.utilities.distributed import distributed_available as _dist_available
 from torchmetrics_tpu.utilities.distributed import gather_all_arrays
-from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.exceptions import SyncError, SyncWarning, TorchMetricsUserError
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -135,6 +137,9 @@ class Metric:
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         if not isinstance(self.compute_with_cache, bool):
             raise ValueError(f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}")
+        self.sync_config = kwargs.pop("sync_config", None)
+        if self.sync_config is not None and not isinstance(self.sync_config, SyncConfig):
+            raise ValueError(f"Expected keyword argument `sync_config` to be a `SyncConfig` but got {self.sync_config}")
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -216,24 +221,69 @@ class Metric:
         """Current values of all registered states."""
         return {attr: getattr(self, attr) for attr in self._defaults}
 
-    def state_tree(self) -> Dict[str, Any]:
-        """The state registry as a pytree — the bridge into jitted code."""
-        return {attr: getattr(self, attr) for attr in self._defaults}
+    def state_tree(self, include_count: bool = False) -> Dict[str, Any]:
+        """The state registry as a pytree — the bridge into jitted code.
 
-    def load_state_tree(self, tree: Dict[str, Any]) -> None:
-        """Install a pytree of (possibly traced) values as the current state.
-
-        The reserved key ``"_update_count"`` (threaded by
-        ``parallel.make_jit_update`` so ``"mean"`` states merge as a weighted
-        running average) restores the update counter instead of a state.
+        With ``include_count=True`` the tree also carries the update counter
+        under the reserved key ``"_update_count"``, symmetrically with what
+        :meth:`load_state_tree` accepts — so checkpoint/fold call sites never
+        reach into the private counter by hand.
         """
+        tree = {attr: getattr(self, attr) for attr in self._defaults}
+        if include_count:
+            tree["_update_count"] = self._update_count
+        return tree
+
+    def state_spec(self) -> Dict[str, Any]:
+        """Declared schema of every state plus a stable registry fingerprint.
+
+        Returns ``{"states": {name: StateSpec}, "fingerprint": str,
+        "_update_count": int}`` — the contract :meth:`load_state_tree`
+        validates restores against and :meth:`save_checkpoint` embeds so
+        orbax/msgpack round-trips are self-validating.
+        """
+        from torchmetrics_tpu.robustness.spec import build_state_specs, spec_fingerprint
+
+        return {
+            "states": build_state_specs(self),
+            "fingerprint": spec_fingerprint(self),
+            "_update_count": self._update_count,
+        }
+
+    def load_state_tree(self, tree: Dict[str, Any], strict: bool = True) -> None:
+        """Validate and install a pytree of (possibly traced) values as the
+        current state.
+
+        Every leaf is checked against the :meth:`add_state` registry — key
+        set, list-vs-array kind, dtype, shape compatibility — and a violation
+        raises :class:`~torchmetrics_tpu.utilities.exceptions.StateRestoreError`
+        naming the state and expected-vs-got, *before* any state is touched.
+        ``strict=False`` tolerates missing/unknown keys and coerces safe
+        dtype widenings only. The reserved key ``"_update_count"`` (threaded
+        by ``parallel.make_jit_update`` so ``"mean"`` states merge as a
+        weighted running average) restores the update counter instead of a
+        state.
+        """
+        from torchmetrics_tpu.robustness.spec import validate_state_tree
+
+        tree = dict(tree)
+        count = tree.pop("_update_count", None)
+        validated = validate_state_tree(self, tree, strict=strict)
+        for attr, value in validated.items():
+            setattr(self, attr, value)
+        if count is not None:
+            self._update_count = int(count)
+
+    def _install_state_tree(self, tree: Dict[str, Any]) -> None:
+        """Install a tree WITHOUT validation — only for trees this metric
+        produced itself (forward/unsync snapshots, sync rollback) or that were
+        validated moments ago (checkpoint phase 2): self-snapshots are valid
+        by construction and these restores sit on per-batch hot paths."""
         for attr, value in tree.items():
             if attr == "_update_count":
                 self._update_count = int(value)
-                continue
-            if attr not in self._defaults:
-                raise KeyError(f"Unknown metric state {attr!r}")
-            setattr(self, attr, value)
+            else:
+                setattr(self, attr, value)
 
     def _copy_state_dict(self) -> Dict[str, Any]:
         """Snapshot the current state. Arrays are immutable so refs suffice;
@@ -268,6 +318,8 @@ class Metric:
                 update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
+            if faults._ACTIVE:  # simulated preemption between updates (checkpoint drills)
+                faults.fire("update.preempt")
 
         return wrapped_func
 
@@ -338,8 +390,8 @@ class Metric:
         self.update(*args, **kwargs)
         batch_val = self.compute()
 
-        # restore context
-        self.load_state_tree(cache)
+        # restore context (self-snapshot: trusted installer, no validation)
+        self._install_state_tree(cache)
         self._update_count = _update_count
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
@@ -417,12 +469,16 @@ class Metric:
 
         output_dict: Dict[str, Any] = {}
         for attr, value in input_dict.items():
+            if faults._ACTIVE:  # mid-sync fault point: earlier states are already gathered
+                faults.fire("sync.state_gather")
             if isinstance(value, list):
                 output_dict[attr] = [dist_sync_fn(v, group=self.process_group if process_group is None else process_group) for v in value]
             else:
                 output_dict[attr] = dist_sync_fn(value, group=self.process_group if process_group is None else process_group)
 
         for attr, reduction_fn in self._reductions.items():
+            if faults._ACTIVE:  # mid-apply fault point: earlier states are already overwritten
+                faults.fire("sync.state_apply")
             gathered = output_dict[attr]
             if isinstance(gathered, list) and len(gathered) == 0:
                 setattr(self, attr, [])
@@ -438,14 +494,57 @@ class Metric:
             reduced = reduction_fn(gathered) if reduction_fn is not None else gathered
             setattr(self, attr, reduced)
 
+    def _sync_dist_bounded(self, dist_sync_fn: Callable, process_group: Optional[Any], timeout_s: Optional[float]) -> None:
+        """Run ``_sync_dist``, optionally under a wall-clock budget.
+
+        With a timeout the collectives run on a daemon worker thread and a
+        straggler raises :class:`SyncError` instead of hanging forever. The
+        abandoned attempt cannot be cancelled — if it ever completes it may
+        still write states, which the caller's cache-restore then overwrites;
+        a timed-out group should be considered poisoned (see ``SyncConfig``).
+        """
+        if not timeout_s:
+            self._sync_dist(dist_sync_fn, process_group=process_group)
+            return
+        import threading
+
+        box: Dict[str, Any] = {}
+
+        def _runner() -> None:
+            try:
+                self._sync_dist(dist_sync_fn, process_group=process_group)
+            except BaseException as err:  # surface EVERYTHING to the waiting thread
+                box["err"] = err
+
+        worker = threading.Thread(target=_runner, daemon=True, name=f"tm-tpu-sync-{type(self).__name__}")
+        worker.start()
+        worker.join(timeout_s)
+        if worker.is_alive():
+            raise SyncError(
+                f"{type(self).__name__}.sync() timed out after {timeout_s}s — straggler rank or lost host?"
+            )
+        if "err" in box:
+            raise box["err"]
+
     def sync(
         self,
         dist_sync_fn: Optional[Callable] = None,
         process_group: Optional[Any] = None,
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
+        sync_config: Optional[SyncConfig] = None,
     ) -> None:
-        """Sync state across processes (reference ``metric.py:507-549``)."""
+        """Sync state across processes (reference ``metric.py:507-549``),
+        fault-tolerantly.
+
+        Attempts are governed by ``sync_config`` (argument, else the metric's
+        ``sync_config`` kwarg, else :data:`DEFAULT_SYNC_CONFIG`): each failed
+        attempt rolls the states back to the pre-sync cache — a mid-gather
+        failure can never leave the metric half-synced — then retries with
+        exponential backoff. Exhausted attempts raise :class:`SyncError`, or,
+        with ``on_error="local"``, degrade to the local-only state with a
+        single :class:`SyncWarning` so best-effort eval logging keeps flowing.
+        """
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
         if distributed_available is None and self.distributed_available_fn is not None:
@@ -455,10 +554,39 @@ class Metric:
             return
         if dist_sync_fn is None:
             dist_sync_fn = gather_all_arrays
+        cfg = sync_config or self.sync_config or DEFAULT_SYNC_CONFIG
         # cache prior state so accumulation can continue locally after unsync
+        # AND so any failed attempt can roll back cleanly
         self._cache = self._copy_state_dict()
-        self._sync_dist(dist_sync_fn, process_group=process_group or self.process_group)
-        self._is_synced = True
+        group = process_group or self.process_group
+        last_err: Optional[BaseException] = None
+        for attempt in range(cfg.attempts):
+            try:
+                if faults._ACTIVE:
+                    faults.fire("sync.attempt")
+                self._sync_dist_bounded(dist_sync_fn, group, cfg.timeout_s)
+                self._is_synced = True
+                return
+            except Exception as err:
+                # roll back any partial overwrite before retrying/surfacing;
+                # fresh list copies so a later attempt cannot alias the cache
+                self._install_state_tree({k: list(v) if isinstance(v, list) else v for k, v in self._cache.items()})
+                last_err = err
+                if attempt + 1 < cfg.attempts:
+                    import time
+
+                    time.sleep(cfg.backoff(attempt))
+        self._cache = None
+        if cfg.on_error == "local":
+            rank_zero_warn(
+                f"{type(self).__name__}.sync() failed after {cfg.attempts} attempt(s) ({last_err}); falling back"
+                " to local-only state (SyncConfig.on_error='local') — reported values cover this process only.",
+                SyncWarning,
+            )
+            return
+        raise SyncError(
+            f"{type(self).__name__}.sync() failed after {cfg.attempts} attempt(s): {last_err}"
+        ) from last_err
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore the cached pre-sync local state (reference ``metric.py:551-571``)."""
@@ -468,7 +596,7 @@ class Metric:
             raise TorchMetricsUserError("The Metric has already been un-synced.")
         if self._cache is None:
             raise TorchMetricsUserError("The internal cache should exist to unsync the Metric.")
-        self.load_state_tree(self._cache)
+        self._install_state_tree(self._cache)  # self-snapshot: trusted
         self._is_synced = False
         self._cache = None
 
@@ -501,6 +629,28 @@ class Metric:
         return deepcopy(self)
 
     # -------------------------------------------------------------- serialization
+    def save_checkpoint(self) -> Dict[str, Any]:
+        """Snapshot the metric (wrapper children included) as one plain dict of
+        host numpy arrays plus spec fingerprint, format version and update
+        count — self-validating through orbax/msgpack/pickle round-trips.
+        See :mod:`torchmetrics_tpu.robustness.checkpoint`."""
+        from torchmetrics_tpu.robustness.checkpoint import save_checkpoint
+
+        return save_checkpoint(self)
+
+    def load_checkpoint(self, checkpoint: Dict[str, Any], strict: bool = True) -> None:
+        """Validate a :meth:`save_checkpoint` dict end-to-end, then install it.
+
+        A truncated/corrupted payload or a schema mismatch (e.g. different
+        ``num_classes``) raises
+        :class:`~torchmetrics_tpu.utilities.exceptions.StateRestoreError`
+        naming the offending state, and the live metric keeps its previous
+        state — never a half-restored metric.
+        """
+        from torchmetrics_tpu.robustness.checkpoint import load_checkpoint
+
+        load_checkpoint(self, checkpoint, strict=strict)
+
     def state_dict(self, destination: Optional[Dict] = None, prefix: str = "", keep_vars: bool = False) -> Dict[str, Any]:
         """State-dict of persistent states as host numpy arrays (reference ``metric.py:858-890``)."""
         destination = {} if destination is None else destination

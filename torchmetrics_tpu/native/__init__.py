@@ -7,7 +7,9 @@
 
 Each source is compiled on first use with the system ``g++`` into a cached
 shared object (keyed by source hash) and bound via ``ctypes``. Pure-numpy
-fallbacks keep everything working where no compiler exists.
+fallbacks keep everything working where no compiler exists — an involuntary
+fallback warns exactly once per extension; set ``TM_TPU_DISABLE_NATIVE=1``
+to skip native compilation deliberately (and silently).
 """
 from __future__ import annotations
 
@@ -21,6 +23,15 @@ from typing import Dict, Optional, Sequence
 
 _HERE = Path(__file__).parent
 _libs: Dict[str, Optional[ctypes.CDLL]] = {}
+
+#: operator escape hatch: force the numpy fallbacks without touching g++
+_DISABLE_ENV = "TM_TPU_DISABLE_NATIVE"
+
+
+def _native_disabled() -> bool:
+    # read per call (not at import) so tests and operators can toggle live;
+    # callers hit this at most a handful of times per metric evaluation
+    return os.environ.get(_DISABLE_ENV, "0") == "1"
 
 
 def _build_library(stem: str, extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
@@ -70,10 +81,23 @@ def _bind_edit(lib: ctypes.CDLL) -> None:
 
 def _get_library(stem: str, bind, extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
     """Build/load + bind prototypes once per process, cached by stem."""
+    if _native_disabled():
+        return None  # checked before the cache so re-enabling works in-process
     if stem not in _libs:
         lib = _build_library(stem, extra_flags)
         if lib is not None:
             bind(lib)
+        else:
+            # warn exactly once per extension (the None is cached): every
+            # subsequent call silently uses the numpy fallback
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"native extension {stem!r} is unavailable (g++ missing or compilation failed); falling back to"
+                f" the numpy implementation. Set {_DISABLE_ENV}=1 to opt out of native compilation and silence"
+                " this warning.",
+                UserWarning,
+            )
         _libs[stem] = lib
     return _libs[stem]
 

@@ -133,7 +133,7 @@ def infer_cat_layout(metric: Any, example_batch: Tuple[Any, ...]) -> dict:
             tree = metric.state_tree()
             return {k: [jnp.atleast_1d(x) for x in v] for k, v in tree.items() if isinstance(v, list)}
         finally:
-            metric.load_state_tree(saved)
+            metric._install_state_tree(saved)  # self-snapshot: trusted
             metric._update_count = saved_count
             metric._computed = saved_computed
 

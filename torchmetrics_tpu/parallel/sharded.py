@@ -248,17 +248,15 @@ def fold_jit_state(metric: "Any", state: Dict[str, Any]) -> None:
     Converts :class:`CatBuffer` states to the metric's host-side list states
     (raising if any buffer overflowed) and restores the update count.
     """
-    state = dict(state)
-    count = state.pop("_update_count", None)
     tree = {}
     for k, v in state.items():
         if isinstance(v, CatBuffer):
             tree[k] = [cat_buffer_values(v)]
         else:
             tree[k] = v
+    # "_update_count" rides the tree's reserved key symmetrically with
+    # state_tree(include_count=True) — load_state_tree restores the counter
     metric.load_state_tree(tree)
-    if count is not None:
-        metric._update_count = int(count)
     metric._computed = None
 
 
@@ -392,7 +390,7 @@ def _deep_snapshot(metric: "Any") -> list:
 
 def _deep_restore(snapshot: list) -> None:
     for m, state, count, computed, counters in snapshot:
-        m.load_state_tree(state)
+        m._install_state_tree(state)  # self-snapshot: trusted, no validation
         m._update_count = count
         m._computed = computed
         for attr, val in counters.items():
@@ -429,7 +427,7 @@ def _batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> D
         metric.update(*args, **kwargs)
         return metric.state_tree()
     finally:
-        metric.load_state_tree(saved)
+        metric._install_state_tree(saved)  # self-snapshot: trusted
         metric._update_count = saved_count
         metric._computed = saved_computed
 

@@ -1,0 +1,40 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Robustness layer: validated state restore, fault-tolerant sync, fault injection.
+
+Three fronts (ARCHITECTURE.md §9):
+
+- :mod:`~torchmetrics_tpu.robustness.spec` — per-state specs, a stable
+  registry fingerprint, and restore-time validation behind
+  ``Metric.load_state_tree(strict=...)``.
+- :mod:`~torchmetrics_tpu.robustness.checkpoint` — self-validating
+  ``Metric.save_checkpoint()`` / ``load_checkpoint()`` dict helpers.
+- :mod:`~torchmetrics_tpu.robustness.sync_config` /
+  :mod:`~torchmetrics_tpu.robustness.faults` — :class:`SyncConfig`
+  (timeout/retries/backoff/degrade-to-local) threaded through
+  ``Metric.sync()``, plus the deterministic fault-injection harness the
+  tests drive it with.
+"""
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.robustness.checkpoint import (
+    CHECKPOINT_FORMAT_VERSION,
+    checkpoint_fingerprint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from torchmetrics_tpu.robustness.spec import StateSpec, build_state_specs, spec_fingerprint, validate_state_tree
+from torchmetrics_tpu.robustness.sync_config import DEFAULT_SYNC_CONFIG, SyncConfig
+
+__all__ = [
+    "CHECKPOINT_FORMAT_VERSION",
+    "DEFAULT_SYNC_CONFIG",
+    "StateSpec",
+    "SyncConfig",
+    "build_state_specs",
+    "checkpoint_fingerprint",
+    "faults",
+    "load_checkpoint",
+    "save_checkpoint",
+    "spec_fingerprint",
+    "validate_state_tree",
+]

@@ -1,0 +1,193 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Deterministic fault injection for the sync and restore paths.
+
+Production TPU fleets lose hosts, corrupt DCN payloads, and preempt workers
+mid-epoch; code that only ever runs on the happy path is untested exactly
+where it matters most. This module plants **zero-cost-when-off** injection
+points inside ``Metric.sync()`` / ``utilities/distributed.py`` /
+``Metric.update`` so tests (single-process and the real 2-process
+``jax.distributed`` suite) can rehearse those failures deterministically.
+
+Injection points
+----------------
+
+=========================  =====================  ==================================
+point                      kinds                  fires
+=========================  =====================  ==================================
+``sync.attempt``           fail, delay            at the start of every ``Metric.sync`` attempt
+``sync.state_gather``      fail, delay            before each state's gather inside ``_sync_dist``
+                                                  (use ``after=`` to leave earlier states
+                                                  overwritten — a genuine mid-sync failure)
+``gather_bytes.pre``       fail, delay            before the object-gather collective
+``gather_bytes.payload``   corrupt, truncate      on the wire buffer of ``_gather_objects_via_bytes``
+``update.preempt``         preempt                after a completed ``Metric.update`` (raises
+                                                  :class:`SimulatedPreemption` — checkpoint/restore drills)
+=========================  =====================  ==================================
+
+Faults are scoped with the :func:`inject` context manager (in-process tests)
+or installed from the ``TM_TPU_FAULTS`` environment variable (subprocess
+workers), e.g.::
+
+    TM_TPU_FAULTS="corrupt:gather_bytes.payload:rank=1;fail:sync.attempt:count=2"
+
+Grammar: ``;``-separated faults, each ``kind:point[:key=value]*`` with keys
+``rank`` (only that ``jax.process_index()``; default all), ``after`` (skip
+the first N matching hits), ``count`` (fire at most N times; default
+unbounded), ``arg`` (seconds for ``delay``, bytes for ``corrupt``/``truncate``).
+All injection is deterministic — no randomness — so 2-process scenarios stay
+in lockstep and failures reproduce bit-for-bit.
+"""
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional
+
+_KINDS = ("fail", "delay", "corrupt", "truncate", "preempt")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``fail`` fault — stands in for a transient transport error."""
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised by a ``preempt`` fault — stands in for host preemption between updates."""
+
+
+@dataclass
+class Fault:
+    """One deterministic fault at one injection point."""
+
+    kind: str
+    point: str
+    rank: Optional[int] = None  # None = every process
+    after: int = 0  # skip the first `after` matching hits
+    count: Optional[int] = None  # fire at most `count` times (None = unbounded)
+    arg: float = 1.0  # delay seconds / corrupt-truncate byte count
+    _hits: int = field(default=0, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; expected one of {_KINDS}")
+        if self.after < 0 or (self.count is not None and self.count < 0):
+            raise ValueError("`after` and `count` must be non-negative")
+
+    def _should_fire(self, point: str, rank: int) -> bool:
+        """Match + hit accounting: a matching call counts as a hit whether or
+        not it fires, so ``after``/``count`` windows are deterministic."""
+        if point != self.point or (self.rank is not None and rank != self.rank):
+            return False
+        hit = self._hits
+        self._hits = hit + 1
+        if hit < self.after:
+            return False
+        return self.count is None or hit < self.after + self.count
+
+
+#: the live fault list. Hot paths guard with ``if faults._ACTIVE:`` — one
+#: attribute load + truth test when no faults are installed.
+_ACTIVE: List[Fault] = []
+
+
+def active() -> bool:
+    """True when any fault is installed."""
+    return bool(_ACTIVE)
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def install(*faults: Fault) -> None:
+    """Install faults for the rest of the process (tests prefer :func:`inject`)."""
+    _ACTIVE.extend(faults)
+
+
+def clear() -> None:
+    """Remove every installed fault and reset hit counters."""
+    for f in _ACTIVE:
+        f._hits = 0
+    del _ACTIVE[:]
+
+
+@contextmanager
+def inject(*faults: Fault) -> Iterator[None]:
+    """Scope faults to a ``with`` block; counters reset on exit."""
+    _ACTIVE.extend(faults)
+    try:
+        yield
+    finally:
+        for f in faults:
+            f._hits = 0
+            # remove by IDENTITY: dataclass equality would match (and evict)
+            # a distinct but equal fault installed by e.g. TM_TPU_FAULTS
+            for i, installed in enumerate(_ACTIVE):
+                if installed is f:
+                    del _ACTIVE[i]
+                    break
+
+
+def fire(point: str) -> None:
+    """Trigger ``fail``/``delay``/``preempt`` faults registered at ``point``."""
+    if not _ACTIVE:
+        return
+    rank = _rank()
+    for f in _ACTIVE:
+        if f.kind in ("fail", "delay", "preempt") and f._should_fire(point, rank):
+            if f.kind == "delay":
+                time.sleep(f.arg)
+            elif f.kind == "preempt":
+                raise SimulatedPreemption(f"injected preemption at {point!r} (rank {rank})")
+            else:
+                raise FaultInjected(f"injected failure at {point!r} (rank {rank})")
+
+
+def mutate_bytes(point: str, data: bytes, header_len: int = 0) -> bytes:
+    """Apply ``corrupt``/``truncate`` faults registered at ``point`` to a wire
+    buffer, leaving the first ``header_len`` bytes intact (corruption strikes
+    the payload, so integrity headers can detect it)."""
+    if not _ACTIVE:
+        return data
+    rank = _rank()
+    for f in _ACTIVE:
+        if f.kind in ("corrupt", "truncate") and f._should_fire(point, rank):
+            n = max(1, int(f.arg))
+            if f.kind == "truncate":
+                keep = max(header_len, len(data) - n)
+                data = data[:keep]
+            elif len(data) > header_len:
+                lo = header_len + (len(data) - header_len) // 2
+                window = data[lo : lo + n]
+                data = data[:lo] + bytes(b ^ 0xFF for b in window) + data[lo + len(window) :]
+    return data
+
+
+def install_from_env(value: Optional[str] = None) -> List[Fault]:
+    """Parse ``TM_TPU_FAULTS`` (or ``value``) and install the faults it names."""
+    spec = os.environ.get("TM_TPU_FAULTS", "") if value is None else value
+    faults: List[Fault] = []
+    for item in filter(None, (part.strip() for part in spec.split(";"))):
+        fields = item.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"malformed TM_TPU_FAULTS entry {item!r}: expected 'kind:point[:key=value]*'")
+        kind, point, kwargs = fields[0], fields[1], {}
+        for opt in fields[2:]:
+            key, _, val = opt.partition("=")
+            if key not in ("rank", "after", "count", "arg"):
+                raise ValueError(f"unknown TM_TPU_FAULTS option {key!r} in {item!r}")
+            kwargs[key] = float(val) if key == "arg" else int(val)
+        faults.append(Fault(kind=kind, point=point, **kwargs))
+    install(*faults)
+    return faults
+
+
+if os.environ.get("TM_TPU_FAULTS"):
+    install_from_env()

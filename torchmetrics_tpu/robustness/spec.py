@@ -1,0 +1,164 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""State-registry specs and restore-time validation.
+
+``Metric.add_state`` declares each state's kind (fixed-shape array vs
+append/"cat" list), dtype, shape and distributed reduction — a complete
+schema. This module turns that schema into:
+
+- :class:`StateSpec` / :func:`build_state_specs` — the per-state spec,
+- :func:`spec_fingerprint` — a stable digest of the whole registry, embedded
+  in checkpoints so schema drift is caught at restore time,
+- :func:`validate_state_tree` — leaf-by-leaf validation of an incoming
+  pytree against the registry, raising
+  :class:`~torchmetrics_tpu.utilities.exceptions.StateRestoreError` that
+  names the offending state and expected-vs-got.
+
+A ``num_classes=5`` confusion matrix restored into a ``num_classes=7``
+metric fails HERE with a readable message instead of detonating later inside
+jit with an opaque shape error.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+
+#: reductions that preserve the accumulator shape; their states must match
+#: the default shape exactly. "cat"/None/custom states grow along a leading
+#: axis (concatenate/stack), so only trailing dims are pinned.
+_ELEMENTWISE_REDUCTIONS = ("sum", "mean", "min", "max")
+
+
+class StateSpec(NamedTuple):
+    """Declared contract of one metric state."""
+
+    kind: str  # "array" | "list"
+    dtype: Optional[str]  # None for list states (element dtype is per-append)
+    shape: Optional[Tuple[int, ...]]  # None for list states
+    reduction: str  # reduction name, "none", or the callable's qualname
+
+
+def _reduction_token(reduction: Any) -> str:
+    if isinstance(reduction, str):
+        return reduction
+    if reduction is None:
+        return "none"
+    return getattr(reduction, "__qualname__", getattr(reduction, "__name__", "callable"))
+
+
+def build_state_specs(metric: Any) -> Dict[str, StateSpec]:
+    """Per-state :class:`StateSpec` for every registered state of ``metric``."""
+    specs: Dict[str, StateSpec] = {}
+    for name, default in metric._defaults.items():
+        token = _reduction_token(metric._reductions.get(name))
+        if isinstance(default, list):
+            specs[name] = StateSpec("list", None, None, token)
+        else:
+            specs[name] = StateSpec("array", str(default.dtype), tuple(int(d) for d in default.shape), token)
+    return specs
+
+
+def spec_fingerprint(metric: Any) -> str:
+    """Stable digest of the metric's class name + full state registry.
+
+    Two metrics share a fingerprint iff a state tree of one is schema-valid
+    for the other: same state names, kinds, dtypes, shapes and reductions.
+    """
+    specs = build_state_specs(metric)
+    canon = [type(metric).__name__] + [
+        [name, spec.kind, spec.dtype, list(spec.shape) if spec.shape is not None else None, spec.reduction]
+        for name, spec in sorted(specs.items())
+    ]
+    return hashlib.sha256(json.dumps(canon, separators=(",", ":")).encode()).hexdigest()[:16]
+
+
+def _shape_compatible(got: Tuple[int, ...], want: Tuple[int, ...], elementwise: bool) -> bool:
+    """Default dims of size 0 are wildcards (empty-accumulator conventions);
+    non-elementwise states grow along leading axes, so only the trailing
+    ``len(want)`` dims are pinned."""
+    if elementwise:
+        return len(got) == len(want) and all(w in (g, 0) for g, w in zip(got, want))
+    if len(got) < len(want):
+        return False
+    tail = got[len(got) - len(want) :]
+    return all(w in (g, 0) for g, w in zip(tail, want))
+
+
+def _dtype_safe_widening(got: Any, want: Any) -> bool:
+    try:
+        return bool(np.can_cast(got, want, casting="safe"))
+    except TypeError:  # extension dtypes (bfloat16, ...) outside numpy's lattice
+        return False
+
+
+def validate_state_tree(metric: Any, tree: Dict[str, Any], strict: bool = True) -> Dict[str, Any]:
+    """Validate ``tree`` against ``metric``'s state registry.
+
+    Returns the (possibly dtype-coerced) tree to install; never mutates the
+    metric, so callers can validate a whole checkpoint before applying any of
+    it. Strict mode demands the exact registry key set and exact dtypes;
+    non-strict mode drops unknown keys, allows missing ones, and coerces only
+    SAFE dtype widenings (``int32 -> int64``, ``float16 -> float32``, ...) —
+    lossy narrowing always raises.
+    """
+    cls = type(metric).__name__
+    defaults = metric._defaults
+    unknown = sorted(k for k in tree if k not in defaults)
+    if unknown and strict:
+        raise StateRestoreError(
+            f"Unknown metric state(s) {unknown} for {cls}: the registry declares {sorted(defaults)}"
+        )
+    if strict:
+        missing = sorted(k for k in defaults if k not in tree)
+        if missing:
+            raise StateRestoreError(
+                f"Missing metric state(s) {missing} for {cls}: a strict restore must cover every registered state"
+            )
+
+    out: Dict[str, Any] = {}
+    for name, value in tree.items():
+        if name not in defaults:
+            continue  # non-strict: ignore unknown leaves
+        default = defaults[name]
+        reduction = metric._reductions.get(name)
+        token = _reduction_token(reduction)
+        if isinstance(default, list):
+            if not isinstance(value, (list, tuple)):
+                raise StateRestoreError(
+                    f"state {name!r} of {cls}: expected a list ('{token}') state, got {type(value).__name__}"
+                )
+            out[name] = list(value)
+            continue
+        if isinstance(value, (list, tuple)):
+            raise StateRestoreError(
+                f"state {name!r} of {cls}: expected an array (shape {tuple(default.shape)}, dtype {default.dtype}),"
+                f" got a {type(value).__name__} of {len(value)} element(s)"
+            )
+        if not hasattr(value, "dtype") or not hasattr(value, "shape"):
+            value = np.asarray(value)
+        got_shape = tuple(int(d) for d in value.shape)
+        want_shape = tuple(int(d) for d in default.shape)
+        if not _shape_compatible(got_shape, want_shape, token in _ELEMENTWISE_REDUCTIONS):
+            raise StateRestoreError(
+                f"state {name!r} of {cls}: expected shape {want_shape} (reduction {token!r}),"
+                f" got shape {got_shape} — was this checkpoint written by a differently-configured metric?"
+            )
+        if value.dtype != default.dtype:
+            if strict:
+                raise StateRestoreError(
+                    f"state {name!r} of {cls}: expected dtype {default.dtype}, got {value.dtype}"
+                    " (strict restore; pass strict=False to allow safe widenings)"
+                )
+            if not _dtype_safe_widening(value.dtype, default.dtype):
+                raise StateRestoreError(
+                    f"state {name!r} of {cls}: cannot coerce dtype {value.dtype} to {default.dtype} —"
+                    " only safe widenings are allowed in non-strict restore"
+                )
+            value = value.astype(default.dtype)
+        out[name] = value
+    return out

@@ -18,11 +18,16 @@ re-designed for JAX's two distribution regimes:
 """
 from __future__ import annotations
 
+import struct
+import zlib
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.utilities.exceptions import SyncError
 
 Array = jax.Array
 
@@ -82,16 +87,20 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
         return [result]
     from jax.experimental import multihost_utils
 
+    if faults._ACTIVE:
+        faults.fire("gather_arrays.pre")
     result = jnp.asarray(result)
     local_shape = np.asarray(result.shape, dtype=np.int32)
     ndim = np.int32(result.ndim)
-    # gather every process's shape (pad rank to max 8 dims for a static gather)
-    max_rank = 8
+    # gather every process's rank FIRST and size the shape buffer from the
+    # global max, so arbitrary-ndim arrays gather cleanly (a static max_rank=8
+    # buffer used to overflow on ndim > 8 with an opaque broadcast error)
+    ranks = np.asarray(multihost_utils.process_allgather(jnp.asarray([ndim])))
+    max_rank = max(1, int(ranks.max()))
     shape_buf = np.zeros((max_rank,), dtype=np.int32)
     shape_buf[: local_shape.size] = local_shape
     all_shapes = np.asarray(multihost_utils.process_allgather(jnp.asarray(shape_buf)))
     n_proc = all_shapes.shape[0]
-    ranks = np.asarray(multihost_utils.process_allgather(jnp.asarray([ndim])))
     all_true_shapes = [tuple(int(d) for d in all_shapes[p][: int(ranks[p][0])]) for p in range(n_proc)]
     # fast path: all shapes equal
     if all(s == all_true_shapes[0] for s in all_true_shapes):
@@ -122,16 +131,52 @@ def gather_all_objects(obj: Any) -> List[Any]:
     return list(multihost_utils.broadcast_one_to_all_and_gather(obj)) if hasattr(multihost_utils, "broadcast_one_to_all_and_gather") else _gather_objects_via_bytes(obj)
 
 
+#: wire header of the object-gather protocol: u64 payload length + u32 CRC32.
+#: The CRC turns a corrupt or truncated payload into a :class:`SyncError`
+#: naming the offending rank instead of an opaque ``pickle.loads`` failure
+#: (or, worse, silently wrong deserialized state).
+_OBJ_HEADER = struct.Struct("<QI")
+
+
 def _gather_objects_via_bytes(obj: Any) -> List[Any]:
     import pickle
 
     from jax.experimental import multihost_utils
 
-    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    size = jnp.asarray([payload.size], dtype=jnp.int32)
+    if faults._ACTIVE:
+        faults.fire("gather_bytes.pre")
+    payload = pickle.dumps(obj)
+    wire = _OBJ_HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+    if faults._ACTIVE:
+        wire = faults.mutate_bytes("gather_bytes.payload", wire, header_len=_OBJ_HEADER.size)
+    buf_local = np.frombuffer(wire, dtype=np.uint8)
+    size = jnp.asarray([buf_local.size], dtype=jnp.int32)
     sizes = np.asarray(multihost_utils.process_allgather(size)).reshape(-1)
     max_size = int(sizes.max())
     buf = np.zeros((max_size,), dtype=np.uint8)
-    buf[: payload.size] = payload
-    gathered = np.asarray(multihost_utils.process_allgather(jnp.asarray(buf)))
-    return [pickle.loads(gathered[p][: int(sizes[p])].tobytes()) for p in range(gathered.shape[0])]
+    buf[: buf_local.size] = buf_local
+    # single-process allgather returns the bare (n,) buffer; normalize to the
+    # (n_proc, n) layout so the integrity checks below are regime-agnostic
+    gathered = np.atleast_2d(np.asarray(multihost_utils.process_allgather(jnp.asarray(buf))))
+    out: List[Any] = []
+    for p in range(gathered.shape[0]):
+        total = int(sizes[p])
+        if total < _OBJ_HEADER.size:
+            raise SyncError(
+                f"object gather: rank {p} sent {total} byte(s), smaller than the {_OBJ_HEADER.size}-byte header —"
+                " truncated payload"
+            )
+        length, crc = _OBJ_HEADER.unpack(gathered[p][: _OBJ_HEADER.size].tobytes())
+        data = gathered[p][_OBJ_HEADER.size : _OBJ_HEADER.size + length].tobytes()
+        if len(data) != length or _OBJ_HEADER.size + length > total:
+            raise SyncError(
+                f"object gather: rank {p} declared {length} payload byte(s) but sent {total - _OBJ_HEADER.size} —"
+                " truncated payload"
+            )
+        if zlib.crc32(data) & 0xFFFFFFFF != crc:
+            raise SyncError(f"object gather: payload from rank {p} failed its CRC32 integrity check — corrupt payload")
+        try:
+            out.append(pickle.loads(data))
+        except Exception as err:
+            raise SyncError(f"object gather: payload from rank {p} passed CRC but failed to unpickle: {err}") from err
+    return out

@@ -12,3 +12,32 @@ class TorchMetricsUserError(Exception):
 
 class TorchMetricsUserWarning(UserWarning):
     """Warning raised for recoverable misuses of the metrics API."""
+
+
+class StateRestoreError(TorchMetricsUserError):
+    """A checkpoint / state tree failed validation against the metric's state registry.
+
+    Raised by :meth:`Metric.load_state_tree` (strict mode) and
+    :meth:`Metric.load_checkpoint` when a restored pytree carries unknown or
+    missing states, a list-vs-array kind mismatch, an incompatible dtype or
+    shape (e.g. a ``num_classes=5`` state restored into a ``num_classes=7``
+    metric), or a truncated/corrupted checkpoint payload. The message always
+    names the offending state and expected-vs-got so the failure is debuggable
+    at restore time instead of detonating later inside jit.
+    """
+
+
+class SyncError(TorchMetricsUserError):
+    """Multi-host state synchronization failed.
+
+    Raised by :meth:`Metric.sync` when all attempts are exhausted (see
+    :class:`~torchmetrics_tpu.robustness.SyncConfig`) and by the object-gather
+    protocol in ``utilities/distributed.py`` when a payload arrives truncated
+    or fails its CRC32 integrity check — naming the offending rank instead of
+    surfacing an opaque ``pickle.loads`` failure.
+    """
+
+
+class SyncWarning(TorchMetricsUserWarning):
+    """Warning raised when a sync failure degrades to local-only state
+    (``SyncConfig(on_error="local")``)."""
