@@ -454,3 +454,40 @@ def test_sharded_update_child_swap_invalidates_cached_step():
     # the old child kept exactly its first-batch fold — untouched by call two
     assert np.allclose(float(old_child.total), np.arange(16.0).sum())
     assert float(old_child.count) == 16.0
+
+
+def test_sharded_cache_eviction_leaves_one_live_entry():
+    """Superseded-fingerprint entries are evicted (not silently leaked): after
+    any number of invalidating flips, exactly one live entry remains per
+    (metric, mesh, axis) triple — and the eviction emits its counter."""
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.parallel.sharded import _SHARDED_FN_CACHE
+
+    metric = _ChildWrapper()
+    mesh = _mesh()
+    triple = (id(metric), id(mesh), "data")
+
+    def live_entries():
+        return [k for k in _SHARDED_FN_CACHE if k[:3] == triple]
+
+    with obs.tracing():
+        sharded_update(metric, mesh, jnp.arange(16.0))
+        assert len(live_entries()) == 1
+        assert obs.snapshot()["counters"]["sharded.cache.miss"] == 1
+
+        # swap the child twice: each flip changes the walk fingerprint, so a
+        # stale key would accumulate without the eviction sweep
+        for start in (16.0, 32.0):
+            metric.child = _SumPairs()
+            sharded_update(metric, mesh, jnp.arange(start, start + 16.0))
+            assert len(live_entries()) == 1, "stale fingerprint keys must be evicted"
+
+        snap = obs.snapshot()["counters"]
+        assert snap["sharded.cache.evict"] == 2
+        assert snap["sharded.cache.miss"] == 3
+        assert "sharded.cache.hit" not in snap
+
+        # a repeat call with an unchanged walk is a hit on the single entry
+        sharded_update(metric, mesh, jnp.arange(48.0, 64.0))
+        assert obs.snapshot()["counters"]["sharded.cache.hit"] == 1
+        assert len(live_entries()) == 1

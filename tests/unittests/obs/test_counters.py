@@ -1,0 +1,60 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Unit tests for the counter/gauge registry (``torchmetrics_tpu.obs.counters``)."""
+import threading
+
+import pytest
+
+from torchmetrics_tpu.obs import counters
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    counters.clear()
+    yield
+    counters.clear()
+
+
+def test_inc_and_get():
+    assert counters.get("a.b.c") == 0
+    counters.inc("a.b.c")
+    counters.inc("a.b.c", 4)
+    assert counters.get("a.b.c") == 5
+
+
+def test_gauge_keeps_latest_value():
+    counters.set_gauge("a.b.level", 2)
+    counters.set_gauge("a.b.level", 7.5)
+    assert counters.snapshot()["gauges"] == {"a.b.level": 7.5}
+
+
+def test_snapshot_is_stable_and_detached():
+    counters.inc("z.last", 1)
+    counters.inc("a.first", 2)
+    snap = counters.snapshot()
+    assert list(snap["counters"]) == ["a.first", "z.last"]  # sorted keys
+    assert snap == counters.snapshot()  # same state -> equal snapshots
+    snap["counters"]["a.first"] = 999  # a copy, not a view
+    assert counters.get("a.first") == 2
+
+
+def test_clear_resets_everything():
+    counters.inc("x.y.z")
+    counters.set_gauge("x.y.g", 1)
+    counters.clear()
+    assert counters.snapshot() == {"counters": {}, "gauges": {}}
+
+
+def test_concurrent_increments_do_not_lose_updates():
+    n_threads, n_inc = 8, 500
+
+    def work():
+        for _ in range(n_inc):
+            counters.inc("race.counter")
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counters.get("race.counter") == n_threads * n_inc

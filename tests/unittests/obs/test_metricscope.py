@@ -1,0 +1,98 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tests for the ``tools/metricscope.py`` CLI — the ISSUE 3 acceptance path:
+``summary`` on a trace recorded from a jitted + synced ``MetricCollection``
+run must show per-metric update/compute/sync spans, compile spans, and
+nonzero ``_SHARDED_FN_CACHE`` hit/miss counters."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchmetrics_tpu.obs import counters, trace
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+CLI_PATH = os.path.join(REPO_ROOT, "tools", "metricscope.py")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    trace.disable()
+    trace.clear()
+    counters.clear()
+    yield
+    trace.disable()
+    trace.clear()
+    counters.clear()
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location("metricscope_cli", CLI_PATH)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def demo_trace(tmp_path_factory):
+    cli = _load_cli()
+    path = str(tmp_path_factory.mktemp("metricscope") / "demo.trace.jsonl")
+    cli.record_demo_trace(path)
+    return path
+
+
+def test_summary_shows_spans_and_cache_counters(demo_trace, capsys):
+    cli = _load_cli()
+    assert cli.main(["summary", demo_trace]) == 0
+    out = capsys.readouterr().out
+    # per-metric update/compute/sync spans ...
+    for span_name in ("metric.update", "metric.compute", "metric.sync"):
+        assert span_name in out, f"summary lacks {span_name}:\n{out}"
+    for metric_name in ("MeanMetric", "SumMetric"):
+        assert metric_name in out
+    # ... compile spans ...
+    assert "sharded.compile" in out and "sharded.jit_build" in out
+    # ... and nonzero _SHARDED_FN_CACHE hit/miss counters
+    hit = int(out.split("sharded.cache.hit = ")[1].splitlines()[0])
+    miss = int(out.split("sharded.cache.miss = ")[1].splitlines()[0])
+    assert hit > 0 and miss > 0
+    # compute-group dedup is visible too
+    assert "collection.group_update" in out
+
+
+def test_chrome_conversion(demo_trace, tmp_path, capsys):
+    cli = _load_cli()
+    out_path = str(tmp_path / "demo.chrome.json")
+    assert cli.main(["chrome", demo_trace, "-o", out_path]) == 0
+    chrome = json.load(open(out_path))
+    assert chrome["traceEvents"], "no trace events exported"
+    assert all(e["ph"] in ("X", "i") for e in chrome["traceEvents"])
+    spans = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    assert all("dur" in e and "ts" in e for e in spans)
+    assert chrome["otherData"]["counters"]["sharded.cache.hit"] > 0
+
+
+def test_summary_standalone_does_not_import_jax(tmp_path):
+    """The summary/chrome subcommands load obs from its files — a trace can be
+    inspected on a machine (or in a shell) without paying the jax import."""
+    path = str(tmp_path / "tiny.trace.jsonl")
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"type": "span", "name": "metric.update", "ts": 0, "dur": 1000000,
+                             "tid": 1, "depth": 0, "args": {"metric": "Accuracy", "n": 1}}) + "\n")
+        fh.write(json.dumps({"type": "counters", "counters": {"sharded.cache.hit": 2}, "gauges": {}}) + "\n")
+    # a poisoned jax module on PYTHONPATH turns any jax import into a crash
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text("raise ImportError('metricscope summary must not import jax')\n")
+    env = dict(os.environ, PYTHONPATH=str(poison))
+    result = subprocess.run(
+        [sys.executable, "-c", "import runpy, sys; sys.argv=[sys.argv[1]]+sys.argv[2:];"
+         " runpy.run_path(sys.argv[0], run_name='__main__')", CLI_PATH, "summary", path],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "Accuracy" in result.stdout
+    assert "sharded.cache.hit = 2" in result.stdout

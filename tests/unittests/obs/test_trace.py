@@ -1,0 +1,201 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Unit tests for the span recorder (``torchmetrics_tpu.obs.trace``) and the
+export formats (``torchmetrics_tpu.obs.export``)."""
+import json
+import threading
+
+import pytest
+
+from torchmetrics_tpu.obs import counters, export, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Every test starts and ends with a disabled, empty recorder."""
+    trace.disable()
+    trace.clear()
+    counters.clear()
+    yield
+    trace.disable()
+    trace.configure(65536)
+    trace.clear()
+    counters.clear()
+
+
+def test_span_records_name_duration_args():
+    trace.enable()
+    with trace.span("unit.work", metric="Thing", n=3):
+        pass
+    events = trace.get_trace()
+    assert len(events) == 1
+    (event,) = events
+    assert event["type"] == "span"
+    assert event["name"] == "unit.work"
+    assert event["args"] == {"metric": "Thing", "n": 3}
+    assert event["dur"] >= 0
+    assert event["tid"] == threading.get_ident()
+
+
+def test_spans_nest_with_depth():
+    trace.enable()
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+    # inner exits (and records) first
+    inner, outer = trace.get_trace()
+    assert inner["name"] == "inner" and inner["depth"] == 1
+    assert outer["name"] == "outer" and outer["depth"] == 0
+    # the inner span lies within the outer's interval
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+
+
+def test_disabled_records_nothing():
+    with trace.span("ghost"):
+        pass
+    trace.instant("ghost.event")
+    assert trace.get_trace() == []
+    assert trace.dropped_events() == 0
+
+
+def test_ring_buffer_bounds_and_counts_drops():
+    trace.configure(4)
+    trace.enable()
+    for i in range(10):
+        with trace.span(f"s{i}"):
+            pass
+    events = trace.get_trace()
+    assert len(events) == 4
+    assert [e["name"] for e in events] == ["s6", "s7", "s8", "s9"]  # newest kept
+    assert trace.dropped_events() == 6
+
+
+def test_configure_shrink_keeps_newest():
+    trace.enable()
+    for i in range(6):
+        trace.instant(f"e{i}")
+    trace.configure(2)
+    assert [e["name"] for e in trace.get_trace()] == ["e4", "e5"]
+
+
+def test_tracing_context_restores_flag_and_clears():
+    trace.enable()
+    trace.instant("before")
+    with trace.tracing():  # clears by default
+        assert trace.is_enabled()
+        trace.instant("inside")
+    assert trace.is_enabled()  # was enabled before -> stays enabled
+    assert [e["name"] for e in trace.get_trace()] == ["inside"]
+
+    trace.disable()
+    with trace.tracing(clear_first=False):
+        assert trace.is_enabled()
+    assert not trace.is_enabled()  # restored to disabled
+
+
+def test_tracing_context_restores_on_exception():
+    with pytest.raises(RuntimeError):
+        with trace.tracing():
+            raise RuntimeError("boom")
+    assert not trace.is_enabled()
+
+
+def test_threaded_spans_keep_their_own_stack():
+    trace.enable()
+    barrier = threading.Barrier(2)
+
+    def work(tag):
+        barrier.wait()
+        with trace.span(f"outer.{tag}"):
+            with trace.span(f"inner.{tag}"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = trace.get_trace()
+    assert len(events) == 4
+    by_tid = {}
+    for e in events:
+        by_tid.setdefault(e["tid"], []).append(e)
+    assert len(by_tid) == 2
+    for recorded in by_tid.values():
+        assert sorted(e["depth"] for e in recorded) == [0, 1]
+
+
+def test_jsonl_round_trip(tmp_path):
+    trace.enable()
+    with trace.span("a.b", metric="M"):
+        pass
+    trace.instant("a.event", reason="x")
+    counters.inc("layer.comp.event", 3)
+    counters.set_gauge("layer.comp.level", 1.5)
+    path = str(tmp_path / "t.jsonl")
+    export.write_jsonl(path)
+    events, ctrs, gauges, meta = export.read_jsonl(path)
+    assert [e["name"] for e in events] == ["a.event", "a.b"] or [e["name"] for e in events] == ["a.b", "a.event"]
+    assert ctrs == {"layer.comp.event": 3}
+    assert gauges == {"layer.comp.level": 1.5}
+    # trailing meta line carries drop accounting
+    assert meta == {"dropped": 0}
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[-1] == {"type": "meta", "dropped": 0}
+
+
+def test_jsonl_surfaces_drops(tmp_path):
+    trace.configure(2)
+    trace.enable()
+    for i in range(5):
+        trace.instant(f"e{i}")
+    path = str(tmp_path / "drop.jsonl")
+    export.write_jsonl(path)
+    events, ctrs, gauges, meta = export.read_jsonl(path)
+    assert meta["dropped"] == 3
+    text = export.summarize(events, ctrs, gauges, dropped=meta["dropped"])
+    assert "3 event(s) dropped" in text and "partial" in text
+    # an explicitly passed recording does NOT inherit the live buffer's count
+    export.write_jsonl(path, events=events, counter_snapshot={"counters": {}, "gauges": {}})
+    assert export.read_jsonl(path)[3] == {"dropped": 0}
+    export.write_jsonl(path, events=events, counter_snapshot={"counters": {}, "gauges": {}}, dropped=7)
+    assert export.read_jsonl(path)[3] == {"dropped": 7}
+
+
+def test_chrome_trace_format(tmp_path):
+    trace.enable()
+    with trace.span("phase", metric="M"):
+        pass
+    trace.instant("tick")
+    counters.inc("c.x.y")
+    chrome = export.to_chrome_trace()
+    assert chrome["otherData"]["counters"] == {"c.x.y": 1}
+    spans = [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+    instants = [e for e in chrome["traceEvents"] if e["ph"] == "i"]
+    assert len(spans) == 1 and len(instants) == 1
+    raw = trace.get_trace()
+    raw_span = next(e for e in raw if e["type"] == "span")
+    assert spans[0]["ts"] == pytest.approx(raw_span["ts"] / 1000.0)  # ns -> us
+    assert spans[0]["dur"] == pytest.approx(raw_span["dur"] / 1000.0)
+    assert instants[0]["s"] == "t"
+    path = str(tmp_path / "c.json")
+    export.write_chrome_trace(path)
+    assert json.load(open(path))["displayTimeUnit"] == "ms"
+
+
+def test_summarize_aggregates_per_metric_per_phase():
+    trace.enable()
+    for _ in range(3):
+        with trace.span("metric.update", metric="Accuracy"):
+            pass
+    with trace.span("metric.update", metric="MeanMetric"):
+        pass
+    counters.inc("sharded.cache.hit", 2)
+    rows = export.aggregate(trace.get_trace())
+    by_key = {(r["metric"], r["span"]): r for r in rows}
+    assert by_key[("Accuracy", "metric.update")]["count"] == 3
+    assert by_key[("MeanMetric", "metric.update")]["count"] == 1
+    text = export.summarize(trace.get_trace(), counters.snapshot()["counters"])
+    assert "Accuracy" in text and "metric.update" in text
+    assert "sharded.cache.hit = 2" in text

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metricscope CLI — render recorded metric traces.
+
+Usage::
+
+    python tools/metricscope.py summary /tmp/metrics.trace.jsonl
+    python tools/metricscope.py chrome /tmp/metrics.trace.jsonl -o /tmp/trace.json
+    python tools/metricscope.py demo -o /tmp/metrics.trace.jsonl
+
+``summary`` prints the per-metric/per-phase span table (count, total/mean/max
+ms), instant events (sync retries, cache evictions, ...) and the counter
+snapshot embedded in the trace file. ``chrome`` converts the JSON-lines
+recording to Chrome trace format for ``chrome://tracing`` / Perfetto.
+``demo`` records a trace from a small jitted + synced ``MetricCollection``
+run and writes it — a self-contained way to see the whole pipeline.
+
+Record a trace in your own run with ``TM_TPU_TRACE=1`` (then call
+``torchmetrics_tpu.obs.write_jsonl(path)``) or the ``obs.tracing()`` context
+manager. ``summary``/``chrome`` load the obs package directly from its files,
+so they never pay the full ``torchmetrics_tpu`` (jax) import.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_obs_module():
+    """Import ``torchmetrics_tpu.obs`` WITHOUT importing ``torchmetrics_tpu``
+    (whose __init__ pulls in jax and all 200+ metric modules)."""
+    if "torchmetrics_tpu" in sys.modules:  # already paid (e.g. demo) — reuse
+        import torchmetrics_tpu.obs
+
+        return torchmetrics_tpu.obs
+    pkg_dir = os.path.join(_REPO_ROOT, "torchmetrics_tpu", "obs")
+    spec = importlib.util.spec_from_file_location(
+        "metricscope_obs", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["metricscope_obs"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _cmd_summary(args) -> int:
+    obs = _load_obs_module()
+    events, counters, gauges, meta = obs.read_jsonl(args.trace)
+    print(obs.summarize(events, counters, gauges, dropped=meta.get("dropped", 0)))
+    return 0
+
+
+def _cmd_chrome(args) -> int:
+    obs = _load_obs_module()
+    events, counters, gauges, meta = obs.read_jsonl(args.trace)
+    out = args.output or (os.path.splitext(args.trace)[0] + ".chrome.json")
+    obs.write_chrome_trace(out, events, {"counters": counters, "gauges": gauges})
+    dropped = meta.get("dropped", 0)
+    if dropped:
+        print(f"WARNING: {dropped} event(s) were dropped by the ring buffer — the trace is partial")
+    print(f"wrote {out} — open in chrome://tracing or https://ui.perfetto.dev")
+    return 0
+
+
+def record_demo_trace(path: str) -> None:
+    """Record a trace of a jitted + synced ``MetricCollection`` run to ``path``.
+
+    Exercises every instrumented layer: per-metric update/compute/sync spans,
+    compute-group dedup spans, sharded jit-build/compile spans with
+    ``_SHARDED_FN_CACHE`` hit/miss counters, and a checkpoint round-trip.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric, obs
+    from torchmetrics_tpu.parallel import sharded_update
+    from jax.sharding import Mesh
+
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    with obs.tracing():
+        collection = MetricCollection({"mean": MeanMetric(), "mean2": MeanMetric(), "sum": SumMetric()})
+        sharded = SumMetric()
+        for step in range(4):
+            batch = jnp.arange(step, step + n_dev, dtype=jnp.float32)
+            collection.update(batch)
+            sharded_update(sharded, mesh, batch)  # miss+compile on step 0, hits after
+        collection.compute()
+        sharded.compute()
+        sharded.load_checkpoint(sharded.save_checkpoint())
+        obs.write_jsonl(path)
+
+
+def _cmd_demo(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if _REPO_ROOT not in sys.path:  # script lives in tools/; import the repo package
+        sys.path.insert(0, _REPO_ROOT)
+    record_demo_trace(args.output)
+    print(f"wrote {args.output} — render with: python tools/metricscope.py summary {args.output}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="metricscope", description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_summary = sub.add_parser("summary", help="per-metric/per-phase table + counters from a trace file")
+    p_summary.add_argument("trace", help="JSON-lines trace file (obs.write_jsonl)")
+    p_summary.set_defaults(fn=_cmd_summary)
+
+    p_chrome = sub.add_parser("chrome", help="convert a trace file to Chrome trace format")
+    p_chrome.add_argument("trace", help="JSON-lines trace file (obs.write_jsonl)")
+    p_chrome.add_argument("-o", "--output", default=None, help="output path (default: <trace>.chrome.json)")
+    p_chrome.set_defaults(fn=_cmd_chrome)
+
+    p_demo = sub.add_parser("demo", help="record a demo trace from a jitted + synced MetricCollection run")
+    p_demo.add_argument("-o", "--output", default="/tmp/metrics.trace.jsonl", help="trace file to write")
+    p_demo.set_defaults(fn=_cmd_demo)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # summary piped into head/less that exited early
+        os._exit(0)

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -197,7 +199,20 @@ class MetricCollection(dict):
         if self._enable_compute_groups and self._groups_checked:
             for cg in self._groups.values():
                 m0 = dict.__getitem__(self, cg[0])
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
+                if _obs_trace.ENABLED:
+                    # one span per compute group: the leader does the work, the
+                    # `shares_with` tag names the members riding on it
+                    with _obs_trace.span(
+                        "collection.group_update",
+                        metric=type(m0).__name__,
+                        leader=cg[0],
+                        shares_with=",".join(cg[1:]),
+                    ):
+                        m0.update(*args, **m0._filter_kwargs(**kwargs))
+                    if len(cg) > 1:
+                        _obs_counters.inc("collection.update.dedup_skipped", len(cg) - 1)
+                else:
+                    m0.update(*args, **m0._filter_kwargs(**kwargs))
                 for k in cg[1:]:
                     m = dict.__getitem__(self, k)
                     m._update_count = m0._update_count
@@ -321,6 +336,9 @@ class MetricCollection(dict):
         return self.forward(*args, **kwargs)
 
     def compute(self) -> Dict[str, Any]:
+        if _obs_trace.ENABLED:
+            with _obs_trace.span("collection.compute", metric=type(self).__name__, size=len(self)):
+                return self._compute_and_reduce("compute")
         return self._compute_and_reduce("compute")
 
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:

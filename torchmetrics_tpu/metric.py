@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import time
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -41,6 +42,8 @@ from torchmetrics_tpu.utilities.data import (
     dim_zero_min,
     dim_zero_sum,
 )
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness import faults
 from torchmetrics_tpu.robustness.sync_config import DEFAULT_SYNC_CONFIG, SyncConfig
 from torchmetrics_tpu.utilities.distributed import distributed_available as _dist_available
@@ -314,8 +317,15 @@ class Metric:
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
             self._update_count += 1
-            with _trace_annotation(self, "update"):
-                update(*args, **kwargs)
+            # disabled-tracing path: a single module-level flag check — the
+            # span (and its tag dict) is only ever allocated inside the branch
+            if _obs_trace.ENABLED:
+                with _obs_trace.span("metric.update", metric=type(self).__name__, n=self._update_count):
+                    with _trace_annotation(self, "update"):
+                        update(*args, **kwargs)
+            else:
+                with _trace_annotation(self, "update"):
+                    update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
             if faults._ACTIVE:  # simulated preemption between updates (checkpoint drills)
@@ -347,12 +357,20 @@ class Metric:
                 )
             if self._computed is not None:
                 return self._computed
-            with self.sync_context(
-                dist_sync_fn=self.dist_sync_fn,
-                should_sync=self._to_sync,
-                should_unsync=self._should_unsync,
-            ), _trace_annotation(self, "compute"):
-                value = _squeeze_if_scalar(compute(*args, **kwargs))
+            if _obs_trace.ENABLED:
+                with _obs_trace.span("metric.compute", metric=type(self).__name__, n=self._update_count), self.sync_context(
+                    dist_sync_fn=self.dist_sync_fn,
+                    should_sync=self._to_sync,
+                    should_unsync=self._should_unsync,
+                ), _trace_annotation(self, "compute"):
+                    value = _squeeze_if_scalar(compute(*args, **kwargs))
+            else:
+                with self.sync_context(
+                    dist_sync_fn=self.dist_sync_fn,
+                    should_sync=self._to_sync,
+                    should_unsync=self._should_unsync,
+                ), _trace_annotation(self, "compute"):
+                    value = _squeeze_if_scalar(compute(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
             return value
@@ -371,7 +389,13 @@ class Metric:
         """Accumulate globally AND return the batch-local value (reference ``metric.py:283-314``)."""
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``forward``")
-        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+        full = self.full_state_update or self.full_state_update is None or self.dist_sync_on_step
+        if _obs_trace.ENABLED:
+            with _obs_trace.span("metric.forward", metric=type(self).__name__, full_state=bool(full)):
+                if full:
+                    return self._forward_full_state_update(*args, **kwargs)
+                return self._forward_reduce_state_update(*args, **kwargs)
+        if full:
             return self._forward_full_state_update(*args, **kwargs)
         return self._forward_reduce_state_update(*args, **kwargs)
 
@@ -545,6 +569,19 @@ class Metric:
         with ``on_error="local"``, degrade to the local-only state with a
         single :class:`SyncWarning` so best-effort eval logging keeps flowing.
         """
+        if _obs_trace.ENABLED:
+            with _obs_trace.span("metric.sync", metric=type(self).__name__, n=self._update_count):
+                return self._sync_impl(dist_sync_fn, process_group, should_sync, distributed_available, sync_config)
+        return self._sync_impl(dist_sync_fn, process_group, should_sync, distributed_available, sync_config)
+
+    def _sync_impl(
+        self,
+        dist_sync_fn: Optional[Callable],
+        process_group: Optional[Any],
+        should_sync: bool,
+        distributed_available: Optional[Callable],
+        sync_config: Optional[SyncConfig],
+    ) -> None:
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
         if distributed_available is None and self.distributed_available_fn is not None:
@@ -564,6 +601,8 @@ class Metric:
             try:
                 if faults._ACTIVE:
                     faults.fire("sync.attempt")
+                if _obs_trace.ENABLED:
+                    _obs_counters.inc("metric.sync.attempt")
                 self._sync_dist_bounded(dist_sync_fn, group, cfg.timeout_s)
                 self._is_synced = True
                 return
@@ -572,18 +611,46 @@ class Metric:
                 # fresh list copies so a later attempt cannot alias the cache
                 self._install_state_tree({k: list(v) if isinstance(v, list) else v for k, v in self._cache.items()})
                 last_err = err
+                if _obs_trace.ENABLED:
+                    _obs_counters.inc("metric.sync.rollback")
+                    _obs_trace.instant(
+                        "metric.sync.rollback",
+                        metric=type(self).__name__,
+                        attempt=attempt,
+                        error=type(err).__name__,
+                        reason=str(err)[:200],
+                    )
                 if attempt + 1 < cfg.attempts:
-                    import time
-
-                    time.sleep(cfg.backoff(attempt))
+                    backoff_s = cfg.backoff(attempt)
+                    if _obs_trace.ENABLED:
+                        _obs_trace.instant(
+                            "metric.sync.retry", metric=type(self).__name__, attempt=attempt + 1, backoff_s=backoff_s
+                        )
+                    time.sleep(backoff_s)
         self._cache = None
         if cfg.on_error == "local":
+            if _obs_trace.ENABLED:
+                _obs_counters.inc("metric.sync.degrade")
+                _obs_trace.instant(
+                    "metric.sync.degrade",
+                    metric=type(self).__name__,
+                    attempts=cfg.attempts,
+                    error=type(last_err).__name__,
+                )
             rank_zero_warn(
                 f"{type(self).__name__}.sync() failed after {cfg.attempts} attempt(s) ({last_err}); falling back"
                 " to local-only state (SyncConfig.on_error='local') — reported values cover this process only.",
                 SyncWarning,
             )
             return
+        if _obs_trace.ENABLED:
+            _obs_counters.inc("metric.sync.failure")
+            _obs_trace.instant(
+                "metric.sync.failure",
+                metric=type(self).__name__,
+                attempts=cfg.attempts,
+                error=type(last_err).__name__,
+            )
         raise SyncError(
             f"{type(self).__name__}.sync() failed after {cfg.attempts} attempt(s): {last_err}"
         ) from last_err
@@ -614,6 +681,12 @@ class Metric:
     # ------------------------------------------------------------------ reset
     def reset(self) -> None:
         """Reset all states to their defaults (reference ``metric.py:692``)."""
+        if _obs_trace.ENABLED:
+            with _obs_trace.span("metric.reset", metric=type(self).__name__):
+                return self._reset_impl()
+        self._reset_impl()
+
+    def _reset_impl(self) -> None:
         self._update_count = 0
         self._computed = None
         for attr, default in self._defaults.items():

@@ -1,0 +1,79 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""``torchmetrics_tpu.obs`` — opt-in, near-zero-overhead-when-disabled
+observability: nestable spans into a bounded ring buffer
+(:mod:`~torchmetrics_tpu.obs.trace`), named monotonic counters and gauges
+(:mod:`~torchmetrics_tpu.obs.counters`), JSON-lines / Chrome-trace export and
+per-metric summaries (:mod:`~torchmetrics_tpu.obs.export`).
+
+Quick start::
+
+    from torchmetrics_tpu import obs
+
+    with obs.tracing():
+        metric.update(preds, target)
+        metric.compute()
+    obs.write_jsonl("/tmp/metrics.trace.jsonl")
+    # then: python tools/metricscope.py summary /tmp/metrics.trace.jsonl
+
+Or set ``TM_TPU_TRACE=1`` in the environment to trace the whole process.
+This package is standalone (no jax import) so tooling can load it without
+paying the full library import.
+"""
+from . import counters as _counters_mod
+from . import trace as _trace_mod
+from .counters import clear as counter_clear
+from .counters import get as counter_get
+from .counters import inc as counter_inc
+from .counters import set_gauge, snapshot
+from .export import (
+    aggregate,
+    read_jsonl,
+    summarize,
+    to_chrome_trace,
+    write_chrome_trace,
+    write_jsonl,
+)
+from .trace import (
+    configure,
+    disable,
+    dropped_events,
+    enable,
+    get_trace,
+    instant,
+    is_enabled,
+    span,
+    tracing,
+)
+
+def clear() -> None:
+    """Reset the whole recorder: span ring buffer AND counters/gauges — the
+    manual ``enable()``/``disable()`` flow's analogue of what ``tracing()``
+    clears on entry. Use ``trace.clear()``/``counter_clear()`` for one side."""
+    _trace_mod.clear()
+    _counters_mod.clear()
+
+
+__all__ = [
+    "aggregate",
+    "clear",
+    "configure",
+    "counter_clear",
+    "counter_get",
+    "counter_inc",
+    "disable",
+    "dropped_events",
+    "enable",
+    "get_trace",
+    "instant",
+    "is_enabled",
+    "read_jsonl",
+    "set_gauge",
+    "snapshot",
+    "span",
+    "summarize",
+    "to_chrome_trace",
+    "tracing",
+    "write_chrome_trace",
+    "write_jsonl",
+]

@@ -1,0 +1,72 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Named monotonic counters and gauges for the observability layer.
+
+Names follow the ``layer.component.event`` convention (ARCHITECTURE.md §10):
+
+==================================  ==============================================
+name                                incremented when
+==================================  ==============================================
+``sharded.cache.hit``               ``_SHARDED_FN_CACHE`` serves a compiled step
+``sharded.cache.miss``              no cached step for the (metric, mesh, axis,
+                                    fingerprint) key — a jit build follows
+``sharded.cache.invalidated``       an entry existed but its weakrefs went stale
+                                    (id reuse after gc) — rebuilt
+``sharded.cache.evict``             a superseded-fingerprint entry is deleted
+``metric.sync.attempt``             a ``Metric.sync()`` attempt starts
+``metric.sync.rollback``            a failed attempt rolled states back
+``metric.sync.degrade``             sync exhausted attempts and fell back to
+                                    local-only state (``on_error="local"``)
+``metric.sync.failure``             sync exhausted attempts and raised
+``collection.update.dedup_skipped`` a compute-group member skipped its update
+                                    (the group leader updated for it)
+``checkpoint.save`` / ``.load``     a checkpoint was saved / restored
+==================================  ==============================================
+
+Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the
+disabled path allocates nothing. The module itself is dependency-free (no
+jax) and thread-safe.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+_gauges: Dict[str, float] = {}
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Add ``n`` (default 1) to the monotonic counter ``name``."""
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def set_gauge(name: str, value: Union[int, float]) -> None:
+    """Set the gauge ``name`` to its latest observed value."""
+    with _lock:
+        _gauges[name] = value
+
+
+def get(name: str) -> int:
+    """Current value of counter ``name`` (0 if never incremented)."""
+    with _lock:
+        return _counters.get(name, 0)
+
+
+def snapshot() -> Dict[str, Dict[str, Union[int, float]]]:
+    """Stable point-in-time copy: ``{"counters": {...}, "gauges": {...}}``,
+    keys sorted so repeated snapshots of the same state compare equal."""
+    with _lock:
+        return {
+            "counters": {k: _counters[k] for k in sorted(_counters)},
+            "gauges": {k: _gauges[k] for k in sorted(_gauges)},
+        }
+
+
+def clear() -> None:
+    """Reset every counter and gauge."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()

@@ -1,0 +1,194 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Trace export and summarization.
+
+Two on-disk formats:
+
+- **JSON-lines** (:func:`write_jsonl` / :func:`read_jsonl`): one recorded
+  event per line plus one trailing ``{"type": "counters", ...}`` line with
+  the counter/gauge snapshot and a ``{"type": "meta", ...}`` line with drop
+  accounting — a trace file is self-contained.
+- **Chrome trace** (:func:`to_chrome_trace` / :func:`write_chrome_trace`):
+  the Catapult JSON Object Format — load the file in ``chrome://tracing`` or
+  https://ui.perfetto.dev. Spans become complete (``"ph": "X"``) events,
+  instants become ``"ph": "i"``, counters ride in ``otherData``.
+
+:func:`summarize` aggregates a recorded trace into the per-metric/per-phase
+table ``tools/metricscope.py summary`` prints. This module is standalone (no
+jax import) so the CLI can load it without paying the package import.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import counters as _counters
+from . import trace as _trace
+
+
+def write_jsonl(path: str, events: Optional[List[Dict[str, Any]]] = None,
+                counter_snapshot: Optional[Dict[str, Any]] = None,
+                dropped: Optional[int] = None) -> None:
+    """Write a self-contained JSON-lines trace file.
+
+    Defaults to the live ring buffer and the live counter registry; pass
+    ``events``/``counter_snapshot`` explicitly to export a saved recording —
+    the meta line's drop count then comes from ``dropped`` (a saved recording
+    must carry its own accounting; the live buffer's count only applies to
+    the live buffer's events).
+    """
+    if dropped is None:
+        dropped = _trace.dropped_events() if events is None else 0
+    events = _trace.get_trace() if events is None else events
+    snap = _counters.snapshot() if counter_snapshot is None else counter_snapshot
+    with open(path, "w") as fh:
+        for event in events:
+            fh.write(json.dumps(event, separators=(",", ":")) + "\n")
+        fh.write(json.dumps({"type": "counters", **snap}, separators=(",", ":")) + "\n")
+        fh.write(json.dumps({"type": "meta", "dropped": dropped}, separators=(",", ":")) + "\n")
+
+
+def read_jsonl(path: str) -> Tuple[List[Dict[str, Any]], Dict[str, Any], Dict[str, Any], Dict[str, Any]]:
+    """Parse a :func:`write_jsonl` file -> (events, counters, gauges, meta)."""
+    events: List[Dict[str, Any]] = []
+    counters: Dict[str, Any] = {}
+    gauges: Dict[str, Any] = {}
+    meta: Dict[str, Any] = {}
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            record = json.loads(line)
+            kind = record.get("type")
+            if kind in ("span", "instant"):
+                events.append(record)
+            elif kind == "counters":
+                counters = record.get("counters", {})
+                gauges = record.get("gauges", {})
+            elif kind == "meta":
+                meta = {k: v for k, v in record.items() if k != "type"}
+    return events, counters, gauges, meta
+
+
+def to_chrome_trace(events: Optional[List[Dict[str, Any]]] = None,
+                    counter_snapshot: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The recording as a ``chrome://tracing`` JSON object."""
+    events = _trace.get_trace() if events is None else events
+    snap = _counters.snapshot() if counter_snapshot is None else counter_snapshot
+    pid = os.getpid()
+    trace_events = []
+    for event in events:
+        out = {
+            "name": event["name"],
+            "cat": "tm_tpu",
+            "ph": "X" if event.get("type") == "span" else "i",
+            # Catapult timestamps are microseconds; the buffer records ns
+            "ts": event["ts"] / 1000.0,
+            "pid": pid,
+            "tid": event.get("tid", 0),
+        }
+        if out["ph"] == "X":
+            out["dur"] = event.get("dur", 0) / 1000.0
+        else:
+            out["s"] = "t"  # instant scoped to its thread
+        if event.get("args"):
+            out["args"] = event["args"]
+        trace_events.append(out)
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        "otherData": {"counters": snap.get("counters", {}), "gauges": snap.get("gauges", {})},
+    }
+
+
+def write_chrome_trace(path: str, events: Optional[List[Dict[str, Any]]] = None,
+                       counter_snapshot: Optional[Dict[str, Any]] = None) -> None:
+    with open(path, "w") as fh:
+        json.dump(to_chrome_trace(events, counter_snapshot), fh, indent=1)
+
+
+# ----------------------------------------------------------------- summary
+
+
+def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Aggregate span events into per-(metric, span-name) rows.
+
+    The grouping key is the span's ``metric`` arg (instrumented spans tag the
+    metric class; untagged spans group under ``"-"``). Rows carry count,
+    total/mean/max duration in ms, sorted by total time descending.
+    """
+    stats: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    for event in events:
+        if event.get("type") != "span":
+            continue
+        args = event.get("args") or {}
+        key = (str(args.get("metric", "-")), event["name"])
+        row = stats.get(key)
+        if row is None:
+            row = stats[key] = {"metric": key[0], "span": key[1], "count": 0, "total_ns": 0, "max_ns": 0}
+        row["count"] += 1
+        row["total_ns"] += event.get("dur", 0)
+        row["max_ns"] = max(row["max_ns"], event.get("dur", 0))
+    rows = []
+    for row in stats.values():
+        rows.append(
+            {
+                "metric": row["metric"],
+                "span": row["span"],
+                "count": row["count"],
+                "total_ms": row["total_ns"] / 1e6,
+                "mean_ms": row["total_ns"] / row["count"] / 1e6,
+                "max_ms": row["max_ns"] / 1e6,
+            }
+        )
+    rows.sort(key=lambda r: (-r["total_ms"], r["metric"], r["span"]))
+    return rows
+
+
+def summarize(events: List[Dict[str, Any]], counters: Optional[Dict[str, Any]] = None,
+              gauges: Optional[Dict[str, Any]] = None, dropped: int = 0) -> str:
+    """Render the per-metric/per-phase summary table plus counters as text.
+
+    A nonzero ``dropped`` (the ring buffer discarded that many oldest events)
+    is surfaced up front — a truncated profile must not read as complete.
+    """
+    rows = aggregate(events)
+    header = ("metric", "span", "count", "total_ms", "mean_ms", "max_ms")
+    table = [header] + [
+        (r["metric"], r["span"], str(r["count"]), f"{r['total_ms']:.3f}", f"{r['mean_ms']:.3f}", f"{r['max_ms']:.3f}")
+        for r in rows
+    ]
+    widths = [max(len(row[i]) for row in table) for i in range(len(header))]
+    lines = []
+    if dropped:
+        lines.append(f"WARNING: {dropped} event(s) dropped by the bounded ring buffer — totals are partial"
+                     " (raise TM_TPU_TRACE_BUFFER)")
+        lines.append("")
+    for i, row in enumerate(table):
+        lines.append("  ".join(cell.ljust(widths[j]) for j, cell in enumerate(row)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    if not rows:
+        lines.append("(no spans recorded)")
+
+    instants = [e for e in events if e.get("type") == "instant"]
+    if instants:
+        lines.append("")
+        lines.append("events:")
+        for event in instants:
+            args = event.get("args") or {}
+            detail = " ".join(f"{k}={v}" for k, v in args.items())
+            lines.append(f"  {event['name']}" + (f"  {detail}" if detail else ""))
+
+    counters = counters or {}
+    gauges = gauges or {}
+    if counters or gauges:
+        lines.append("")
+        lines.append("counters:")
+        for name in sorted(counters):
+            lines.append(f"  {name} = {counters[name]}")
+        for name in sorted(gauges):
+            lines.append(f"  {name} = {gauges[name]} (gauge)")
+    return "\n".join(lines)

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.parallel.cat_buffer import (
     CatBuffer,
     cat_buffer_append,
@@ -187,6 +189,17 @@ def make_jit_update(
     running average (reference ``metric.py:317``) instead of decaying
     pairwise means.
     """
+    if _obs_trace.ENABLED:
+        with _obs_trace.span("parallel.jit_build", metric=type(metric).__name__):
+            return _make_jit_update(metric, cat_capacity, example_batch)
+    return _make_jit_update(metric, cat_capacity, example_batch)
+
+
+def _make_jit_update(
+    metric: "Any",
+    cat_capacity: Optional[int] = None,
+    example_batch: Optional[Tuple[Any, ...]] = None,
+) -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
     walk = _walk_metrics(metric)
     for path, m in walk:
         reason = getattr(m, "_sharded_update_unsupported", None)
@@ -481,7 +494,8 @@ def make_sharded_update(
     def sharded(*args: Any) -> Dict[str, Any]:
         specs = in_specs if in_specs is not None else build_specs(args)
         key = tuple(specs)
-        if key not in fn_cache:
+        cold = key not in fn_cache
+        if cold:
             fn_cache[key] = jax.jit(
                 shard_map(
                     per_device,
@@ -491,6 +505,11 @@ def make_sharded_update(
                     check_rep=False,
                 )
             )
+        if cold and _obs_trace.ENABLED:
+            # jax.jit is lazy: trace + XLA compile happen on the first call,
+            # so this span's duration IS the compile time for these specs
+            with _obs_trace.span("sharded.compile", metric=type(metric).__name__, specs=str(key)):
+                return fn_cache[key](*args)
         return fn_cache[key](*args)
 
     return sharded
@@ -522,16 +541,35 @@ def sharded_update(
     # fold-target resolution) stay cached.
     key = (id(metric), id(mesh), axis_name, _walk_fingerprint(metric))
     entry = _SHARDED_FN_CACHE.get(key)
-    if entry is None or entry[0]() is not metric or entry[1]() is not mesh:
+    cold = entry is None or entry[0]() is not metric or entry[1]() is not mesh
+    if cold:
+        if _obs_trace.ENABLED:
+            # a live-looking entry whose weakrefs went stale is an id-reuse
+            # invalidation, not a plain miss — count them apart
+            _obs_counters.inc("sharded.cache.miss" if entry is None else "sharded.cache.invalidated")
+            with _obs_trace.span("sharded.jit_build", metric=type(metric).__name__, axis=axis_name):
+                built = make_sharded_update(metric, mesh, axis_name=axis_name)
+        else:
+            built = make_sharded_update(metric, mesh, axis_name=axis_name)
         ref_m, ref_mesh = weakref.ref(metric), weakref.ref(mesh)
-        entry = (ref_m, ref_mesh, make_sharded_update(metric, mesh, axis_name=axis_name), _fold_targets(metric))
+        entry = (ref_m, ref_mesh, built, _fold_targets(metric))
         # evict superseded fingerprints of the same (metric, mesh, axis) so
         # repeated child swaps do not grow the cache without bound
-        for old in [k for k in _SHARDED_FN_CACHE if k[:3] == key[:3] and k != key]:
+        stale = [k for k in _SHARDED_FN_CACHE if k[:3] == key[:3] and k != key]
+        for old in stale:
             del _SHARDED_FN_CACHE[old]
+        if stale and _obs_trace.ENABLED:
+            _obs_counters.inc("sharded.cache.evict", len(stale))
+            _obs_trace.instant("sharded.cache.evict", metric=type(metric).__name__, evicted=len(stale))
         _SHARDED_FN_CACHE[key] = entry
+    elif _obs_trace.ENABLED:
+        _obs_counters.inc("sharded.cache.hit")
     update_fn, walk = entry[2], entry[3]
-    merged = update_fn(*args)
+    if _obs_trace.ENABLED:
+        with _obs_trace.span("sharded.update_step", metric=type(metric).__name__, cold=cold):
+            merged = update_fn(*args)
+    else:
+        merged = update_fn(*args)
     for path, m in walk:
         prev_count = m._update_count
         m._computed = None

@@ -20,6 +20,8 @@ from typing import Any, Dict, List, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness.spec import spec_fingerprint, validate_state_tree
 from torchmetrics_tpu.utilities.exceptions import StateRestoreError
 
@@ -52,6 +54,14 @@ def checkpoint_fingerprint(metric: Any) -> str:
 
 def save_checkpoint(metric: Any) -> Dict[str, Any]:
     """Snapshot ``metric`` (deep: wrapper children included) as a plain dict."""
+    if _obs_trace.ENABLED:
+        with _obs_trace.span("checkpoint.save", metric=type(metric).__name__):
+            _obs_counters.inc("checkpoint.save")
+            return _save_checkpoint(metric)
+    return _save_checkpoint(metric)
+
+
+def _save_checkpoint(metric: Any) -> Dict[str, Any]:
     metrics: Dict[str, Any] = {}
     for path, m in _walk(metric):
         tree = m.state_tree(include_count=True)
@@ -83,6 +93,14 @@ def load_checkpoint(metric: Any, checkpoint: Dict[str, Any], strict: bool = True
     Validation runs over EVERY entry before any state is applied, so a bad
     checkpoint leaves the metric untouched.
     """
+    if _obs_trace.ENABLED:
+        with _obs_trace.span("checkpoint.load", metric=type(metric).__name__, strict=strict):
+            _obs_counters.inc("checkpoint.load")
+            return _load_checkpoint(metric, checkpoint, strict=strict)
+    return _load_checkpoint(metric, checkpoint, strict=strict)
+
+
+def _load_checkpoint(metric: Any, checkpoint: Dict[str, Any], strict: bool = True) -> None:
     if not isinstance(checkpoint, dict):
         raise StateRestoreError(
             f"checkpoint for {type(metric).__name__} must be a dict, got {type(checkpoint).__name__} —"
