@@ -319,12 +319,16 @@ def main() -> None:
             bench_coco_map_scale,
             bench_fid50k,
             bench_retrieval_ndcg,
+            bench_sketch_quantile,
             bench_ssim,
             bench_wer,
         )
 
         for name, fn, args, est_s in (
             ("wer", bench_wer, (max(512, n_batches * 256),), 45),
+            # bounded-memory sketch throughput + peak-state-bytes vs the
+            # equivalent cat-state metric (ISSUE 4): cheap, runs early
+            ("sketch_quantile_throughput", bench_sketch_quantile, (max(16, n_batches),), 40),
             ("fid50k", bench_fid50k, (), 120),
             ("coco_map_scale", bench_coco_map_scale, (), 180),
             # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;

@@ -27,6 +27,14 @@ retry/backoff, ``on_error="local"`` degrades to local-only state, a mid-sync
 failure rolls back cleanly, and an ``ndim > 8`` array gathers through the
 dynamically-sized shape buffer.
 
+A third scenario, ``sketch``, exercises the ``dist_reduce_fx="merge"``
+regime (the bounded-memory sketch subsystem): a ``Quantile`` metric's KLL
+sketch state is gathered leaf-wise and pairwise-merged across the ranks,
+the synced result matches the single-process quantiles within the sketch's
+deterministic rank-error bound, and a fault-injected structurally-corrupt
+sketch payload raises ``SyncError`` naming the offending rank on BOTH ranks
+(with clean rollback: the metric heals and syncs once the fault clears).
+
 Usage: ``python mp_sync_worker.py <process_id> <num_processes> <coord_addr> [scenario]``
 """
 from __future__ import annotations
@@ -146,6 +154,69 @@ def run_fault_scenarios(pid: int, nproc: int) -> None:
     print(f"rank {pid}: all injected-fault checks passed")
 
 
+def run_sketch_scenario(pid: int, nproc: int) -> None:
+    """REAL 2-process merge-reduction sync of a sketch ("merge") state."""
+    import numpy as np
+
+    from torchmetrics_tpu import Quantile
+    from torchmetrics_tpu.robustness import SyncConfig, faults
+    from torchmetrics_tpu.sketch import kll_error_bound, kll_quantile
+    from torchmetrics_tpu.utilities.exceptions import SyncError
+
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 40_000
+    data = rng.randn(n_total).astype(np.float32)
+    bounds = [0, 27_000, n_total]  # uneven split
+    lo, hi = bounds[pid], bounds[pid + 1]
+    qs = np.asarray([0.1, 0.5, 0.9], np.float32)
+
+    # A) compute() syncs by pairwise merge: the capacity forces real
+    # compactions on both ranks, so this is the approximate regime — assert
+    # the RANK of each reported quantile stays inside the deterministic bound
+    metric = Quantile(q=qs, capacity=256, levels=14)
+    metric.update(data[lo:hi])
+    metric.sync()
+    assert int(metric.sketch.count) == n_total, f"merged count {int(metric.sketch.count)}"
+    merged_est = np.asarray(kll_quantile(metric.sketch, qs))
+    bound = float(kll_error_bound(metric.sketch))
+    assert np.isfinite(bound) and bound < 0.05 * n_total, f"bound {bound}"
+    for q, est in zip(qs, merged_est):
+        rank_err = abs(float((data <= est).sum()) - q * n_total)
+        assert rank_err <= bound + 1, f"q={q}: rank error {rank_err} > bound {bound}"
+    metric.unsync()
+    assert int(metric.sketch.count) == hi - lo, "unsync did not restore the local sketch"
+
+    # B) exact regime: below capacity the merged sketch IS the sorted union,
+    # so the synced median equals numpy's on the concatenated data
+    exact = Quantile(q=0.5, capacity=4096, levels=14)
+    exact.update(data[lo:hi][:1500])
+    got = float(exact.compute())
+    both = np.concatenate([data[0:1500], data[27_000 : 27_000 + 1500]])
+    # the sketch reports the ceil(q*n)-th order statistic (inverted-CDF
+    # convention), not numpy's default interpolated quantile
+    want = float(np.sort(both)[int(np.ceil(0.5 * both.size)) - 1])
+    assert abs(got - want) < 1e-6, f"exact-regime merge sync: {got} != {want}"
+
+    # C) structurally-corrupt sketch payload from rank 1: both ranks mangle
+    # the same gathered payload (lockstep) and raise SyncError NAMING rank 1
+    bad = Quantile(q=0.5, capacity=256, sync_config=SyncConfig(retries=0))
+    bad.update(data[lo:hi])
+    before = int(bad.sketch.count)
+    with faults.inject(faults.Fault("corrupt", "sync.sketch_state", arg=1, count=1)):
+        try:
+            bad.sync()
+            raise AssertionError("corrupt sketch gather did not raise")
+        except SyncError as err:
+            assert "rank 1" in str(err) and "sketch" in str(err), f"bad SyncError message: {err}"
+    assert not bad._is_synced and int(bad.sketch.count) == before, "rollback failed"
+    # the fault was count=1: the group is healthy and the next sync heals
+    bad._computed = None
+    healed = float(bad.compute())
+    assert abs(healed - float(np.quantile(data, 0.5))) <= 0.05, f"post-fault sync: {healed}"
+
+    print(f"rank {pid}: all sketch merge-sync checks passed")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -153,6 +224,9 @@ def main() -> None:
     assert jax.process_count() == nproc, f"process_count={jax.process_count()}"
     if scenario == "faults":
         run_fault_scenarios(pid, nproc)
+        return
+    if scenario == "sketch":
+        run_sketch_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

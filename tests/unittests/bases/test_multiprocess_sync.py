@@ -72,6 +72,19 @@ def test_two_process_replica_sync():
 
 
 @pytest.mark.timeout(240)
+def test_two_process_sketch_merge_sync():
+    """A REAL 2-process merge-reduction sync of a ``dist_reduce_fx="merge"``
+    sketch state (ISSUE 4 satellite): the KLL sketch gathers leaf-wise and
+    pairwise-merges across ranks (synced quantiles inside the deterministic
+    rank-error bound; exact below capacity), and a fault-injected
+    structurally-corrupt sketch payload raises ``SyncError`` naming the rank
+    on both ranks with clean rollback."""
+    for pid, (p, out) in enumerate(_run_workers("sketch", timeout=180)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: all sketch merge-sync checks passed" in out, out
+
+
+@pytest.mark.timeout(240)
 def test_two_process_injected_faults():
     """The robustness layer under REAL injected faults across the group: a
     corrupt object-gather payload raises ``SyncError`` naming the rank, a

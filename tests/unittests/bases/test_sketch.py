@@ -1,0 +1,447 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Property suite for the bounded-memory sketch subsystem (ISSUE 4
+acceptance): merge associativity/commutativity up to numerical tolerance,
+jit shape preservation via ``jax.eval_shape``, the KLL deterministic
+rank-error bound on a 1e6-sample stream, ``Quantile``/``Median`` metric
+behavior through every runtime layer (forward, merge-sync, jitted update
+loop, sharded step), and ``SpearmanCorrCoef(num_bins=...)`` agreement with
+exact Spearman while sharded ≡ replicated holds for all ``"merge"`` states."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import sketch as sk
+from torchmetrics_tpu.parallel import ShardedMetric
+from torchmetrics_tpu.parallel.sharded import fold_jit_state, make_jit_update
+from torchmetrics_tpu.utilities.exceptions import SyncError
+
+from tests.unittests._helpers.tester import MetricPropertyTester
+
+_RNG = np.random.default_rng(1234)
+QS = np.asarray([0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99], np.float32)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _kll_parts(chunks, capacity=256, levels=14):
+    return [sk.kll_update(sk.kll_init(capacity, levels), c) for c in chunks]
+
+
+# --------------------------------------------------------------- merge algebra
+
+
+class TestMergeAlgebra:
+    """merge is associative/commutative up to numerical tolerance — asserted
+    on QUERY results (compaction boundaries may differ; answers must not,
+    beyond the error bound)."""
+
+    def test_kll_commutative(self):
+        a, b = _kll_parts(np.split(_RNG.normal(size=20_000).astype(np.float32), 2))
+        ab, ba = sk.kll_merge(a, b), sk.kll_merge(b, a)
+        # sorted combine makes the deterministic compactor fully symmetric
+        np.testing.assert_allclose(np.asarray(sk.kll_quantile(ab, QS)), np.asarray(sk.kll_quantile(ba, QS)))
+        assert int(ab.count) == int(ba.count) == 20_000
+
+    def test_kll_associative_within_bound(self):
+        data = _RNG.normal(size=30_000).astype(np.float32)
+        a, b, c = _kll_parts(np.split(data, 3))
+        left = sk.kll_merge(sk.kll_merge(a, b), c)
+        right = sk.kll_merge(a, sk.kll_merge(b, c))
+        n = data.size
+        tol = (float(sk.kll_error_bound(left)) + float(sk.kll_error_bound(right))) / n
+        for q, lv, rv in zip(QS, np.asarray(sk.kll_quantile(left, QS)), np.asarray(sk.kll_quantile(right, QS))):
+            # both answers' ranks sit inside their own bound of q*n, so they
+            # can differ by at most the summed bound in rank space
+            assert abs((data <= lv).sum() - (data <= rv).sum()) <= tol * n + 2
+        assert int(left.count) == int(right.count) == n
+
+    def test_histogram_exactly_associative_commutative(self):
+        chunks = np.split(_RNG.normal(size=9_000).astype(np.float32), 3)
+        parts = [sk.hist_update(sk.hist_init(64, -4.0, 4.0), c) for c in chunks]
+        left = sk.hist_merge(sk.hist_merge(parts[0], parts[1]), parts[2])
+        right = sk.hist_merge(parts[0], sk.hist_merge(parts[1], parts[2]))
+        swapped = sk.hist_merge(parts[1], parts[0])
+        np.testing.assert_array_equal(np.asarray(left.counts), np.asarray(right.counts))
+        np.testing.assert_array_equal(
+            np.asarray(sk.hist_merge(parts[0], parts[1]).counts), np.asarray(swapped.counts)
+        )
+
+    def test_reservoir_sample_set_commutative(self):
+        data = _RNG.normal(size=2_000).astype(np.float32)
+        a = sk.reservoir_update(sk.reservoir_init(64, seed=1), data[:1000])
+        b = sk.reservoir_update(sk.reservoir_init(64, seed=2), data[1000:])
+        ab, ba = sk.reservoir_merge(a, b), sk.reservoir_merge(b, a)
+        # the kept (tag, value) set is exactly symmetric; only the threaded
+        # key (future randomness) may differ
+        np.testing.assert_array_equal(np.sort(np.asarray(ab.values)), np.sort(np.asarray(ba.values)))
+        assert int(ab.count) == int(ba.count) == 2_000
+        vals, valid = sk.reservoir_sample(ab)
+        assert int(valid.sum()) == 64
+        assert np.isin(np.asarray(vals), data).all()
+
+    def test_moments_associative_commutative_within_tolerance(self):
+        data = _RNG.normal(size=3_000).astype(np.float32) * 3 + 1
+        parts = [sk.moments_update(sk.moments_init(()), c) for c in np.split(data, 3)]
+        left = sk.moments_merge(sk.moments_merge(parts[0], parts[1]), parts[2])
+        right = sk.moments_merge(parts[0], sk.moments_merge(parts[1], parts[2]))
+        np.testing.assert_allclose(float(sk.moments_mean(left)), float(sk.moments_mean(right)), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(sk.moments_variance(left)), float(sk.moments_variance(right)), rtol=1e-5
+        )
+        np.testing.assert_allclose(float(sk.moments_mean(left)), data.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(sk.moments_variance(left, ddof=1)), data.var(ddof=1), rtol=1e-4)
+
+
+# ------------------------------------------------------- jit shape preservation
+
+
+class TestJitShapePreservation:
+    """update and merge are jit-compatible and shape-preserving, asserted via
+    ``jax.eval_shape`` (the acceptance wording) AND a real jit execution."""
+
+    CASES = [
+        ("kll", lambda: sk.kll_init(128, 12), sk.kll_update, sk.kll_merge),
+        ("hist", lambda: sk.hist_init(32, -3.0, 3.0), sk.hist_update, sk.hist_merge),
+        ("reservoir", lambda: sk.reservoir_init(32, seed=0), sk.reservoir_update, sk.reservoir_merge),
+        ("moments", lambda: sk.moments_init(()), sk.moments_update, sk.moments_merge),
+    ]
+
+    @staticmethod
+    def _spec(tree):
+        return [(leaf.shape, leaf.dtype) for leaf in jax.tree_util.tree_leaves(tree)]
+
+    @pytest.mark.parametrize("name,init,update,merge", CASES, ids=[c[0] for c in CASES])
+    def test_eval_shape_update_and_merge(self, name, init, update, merge):
+        state = init()
+        batch = jnp.asarray(_RNG.normal(size=500).astype(np.float32))
+        out_update = jax.eval_shape(update, state, batch)
+        assert self._spec(out_update) == self._spec(state), f"{name}: update changed the state spec"
+        out_merge = jax.eval_shape(merge, state, state)
+        assert self._spec(out_merge) == self._spec(state), f"{name}: merge changed the state spec"
+
+    @pytest.mark.parametrize("name,init,update,merge", CASES, ids=[c[0] for c in CASES])
+    def test_jit_execution_matches_eager(self, name, init, update, merge):
+        batch = jnp.asarray(_RNG.normal(size=500).astype(np.float32))
+        eager = merge(update(init(), batch), update(init(), batch))
+        jitted = jax.jit(merge)(jax.jit(update)(init(), batch), jax.jit(update)(init(), batch))
+        for a, b in zip(jax.tree_util.tree_leaves(eager), jax.tree_util.tree_leaves(jitted)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+# ------------------------------------------------------------- KLL error bound
+
+
+def test_kll_rank_error_within_bound_1e6_stream():
+    """Acceptance: on a 1e6-sample stream the measured rank error of every
+    queried quantile stays under the sketch's own deterministic bound, and
+    the bound stays under the configured eps."""
+    eps = 0.01
+    capacity, levels = sk.kll_geometry(eps, max_n=2e6)
+    state = sk.kll_init(capacity, levels)
+    n = 1_000_000
+    rng = np.random.default_rng(7)
+    data = rng.standard_normal(n).astype(np.float32)
+    for chunk in np.split(data, 20):  # one traced shape, 20 executions
+        state = sk.kll_update(state, chunk)
+    assert int(state.count) == n and not bool(state.overflow)
+    bound = float(sk.kll_error_bound(state))
+    assert bound <= eps * n, f"bound {bound} exceeds eps*n = {eps * n}"
+    data.sort()
+    estimates = np.asarray(sk.kll_quantile(state, QS))
+    for q, est in zip(QS, estimates):
+        rank = np.searchsorted(data, est, side="right")
+        assert abs(rank - q * n) <= bound + 1, f"q={q}: rank error {abs(rank - q * n)} > bound {bound}"
+    # endpoints are exact
+    assert float(sk.kll_quantile(state, 0.0)) == data[0]
+    assert float(sk.kll_quantile(state, 1.0)) == data[-1]
+
+
+def test_kll_overflow_latches_and_voids_bound():
+    tiny = sk.kll_init(4, 2)  # holds at most 4*2 = 8 weight
+    state = tiny
+    for _ in range(8):
+        state = sk.kll_update(state, np.arange(4, dtype=np.float32))
+    assert bool(state.overflow)
+    assert np.isinf(float(sk.kll_error_bound(state)))
+
+
+# ----------------------------------------------------- Quantile/Median metrics
+
+
+def test_quantile_metric_property_suite():
+    """The shared framework contract pass. Below capacity the sketch is
+    exact (sorted union), so streaming == single-shot and sharded == plain
+    hold to float tolerance; the 8-device sharded equivalence covers the
+    'sharded ≡ replicated for all "merge" states' acceptance clause."""
+    batches = [(_RNG.normal(size=64).astype(np.float32),) for _ in range(3)]
+    MetricPropertyTester.run(
+        tm.Quantile,
+        {"q": 0.5, "capacity": 512, "levels": 12},
+        batches,
+        test_sharded=True,
+    )
+
+
+def test_median_matches_numpy_order_statistic():
+    data = _RNG.normal(size=501).astype(np.float32)
+    m = tm.Median(capacity=1024)
+    m.update(data)
+    want = np.sort(data)[int(np.ceil(0.5 * data.size)) - 1]
+    assert float(m.compute()) == pytest.approx(float(want))
+
+
+def test_quantile_vector_q_and_error_bound():
+    data = _RNG.normal(size=40_000).astype(np.float32)
+    m = tm.Quantile(q=[0.1, 0.5, 0.9], capacity=256, levels=14)
+    for chunk in np.split(data, 8):
+        m.update(chunk)
+    est = np.asarray(m.compute())
+    bound = float(m.error_bound())
+    assert np.isfinite(bound) and bound > 0
+    for q, e in zip([0.1, 0.5, 0.9], est):
+        assert abs((data <= e).sum() - q * data.size) <= bound + 1
+
+
+def test_quantile_invalid_q_raises():
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        tm.Quantile(q=1.5)
+
+
+def test_quantile_nan_strategy_ignore():
+    """Eager 'ignore' truly drops NaNs (a sketch point has no weight channel
+    to zero); the count proves they never entered the sketch."""
+    m = tm.Quantile(q=0.5, capacity=512, nan_strategy="ignore")
+    vals = np.asarray([1.0, np.nan, 2.0, 3.0, np.nan], np.float32)
+    m.update(vals)
+    assert int(m.sketch.count) == 3
+    assert float(m.compute()) == pytest.approx(2.0)
+
+
+def test_quantile_merge_sync_equals_pairwise_merge():
+    """Emulated 2-rank replica sync: the synced sketch is the pairwise merge
+    of both ranks' sketches (leaf-wise gather + reduce_merge_states), and
+    unsync restores the local state — the PR-2 cache/rollback path."""
+    data = _RNG.normal(size=8_000).astype(np.float32)
+    m0 = tm.Quantile(q=0.5, capacity=256, levels=14)
+    m1 = tm.Quantile(q=0.5, capacity=256, levels=14)
+    m0.update(data[:5_000])
+    m1.update(data[5_000:])
+    expected = sk.kll_merge(m0.sketch, m1.sketch)
+
+    leaves1 = jax.tree_util.tree_leaves(m1.sketch)
+    leaf_iter = iter(leaves1)
+
+    def fake_gather(value, group=None):
+        return [value, next(leaf_iter)]
+
+    m0.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+    for got, want in zip(jax.tree_util.tree_leaves(m0.sketch), jax.tree_util.tree_leaves(expected)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(m0.sketch.count) == 8_000
+    m0.unsync()
+    assert int(m0.sketch.count) == 5_000
+
+
+def test_quantile_corrupt_merge_payload_raises_syncerror_naming_rank():
+    """The sync.sketch_state fault point: a structurally-corrupt gathered
+    sketch raises SyncError naming state and rank, and the retry loop rolls
+    the local state back untouched."""
+    from torchmetrics_tpu.robustness import SyncConfig, faults
+
+    m = tm.Quantile(q=0.5, capacity=256, sync_config=SyncConfig(retries=0))
+    m.update(_RNG.normal(size=1_000).astype(np.float32))
+    before = int(m.sketch.count)
+
+    def self_gather(value, group=None):
+        return [value, value]
+
+    with faults.inject(faults.Fault("corrupt", "sync.sketch_state", arg=1, count=1)):
+        with pytest.raises(SyncError, match="rank 1") as err:
+            m.sync(dist_sync_fn=self_gather, distributed_available=lambda: True)
+    assert "sketch" in str(err.value)
+    assert not m._is_synced and int(m.sketch.count) == before
+
+
+def test_quantile_jitted_update_loop():
+    """make_jit_update: the whole streaming loop compiles with the sketch
+    pytree riding the state dict; fold_jit_state restores it to the metric."""
+    data = _RNG.normal(size=16_000).astype(np.float32)
+    metric = tm.Quantile(q=0.5, capacity=256, levels=14)
+    step, state = make_jit_update(metric)
+    for chunk in np.split(data, 8):
+        state = step(state, chunk)
+    fold_jit_state(metric, state)
+    assert metric._update_count == 8 and int(metric.sketch.count) == data.size
+    eager = tm.Quantile(q=0.5, capacity=256, levels=14)
+    for chunk in np.split(data, 8):
+        eager.update(chunk)
+    assert float(metric.compute()) == pytest.approx(float(eager.compute()))
+
+
+def test_quantile_sharded_compacting_regime_within_bound():
+    """Sharded ≡ replicated beyond the exact regime: with real compactions
+    the two answers may differ, but both must stay inside the summed
+    deterministic rank-error bound."""
+    data = _RNG.normal(size=16_000).astype(np.float32)
+    plain = tm.Quantile(q=QS, capacity=128, levels=14)
+    shard = ShardedMetric(tm.Quantile(q=QS, capacity=128, levels=14), _mesh())
+    for chunk in np.split(data, 4):
+        plain.update(chunk)
+        shard.update(chunk)
+    bound = float(plain.error_bound()) + float(shard.error_bound())
+    pv, sv = np.asarray(plain.compute()), np.asarray(shard.compute())
+    for q, a, b in zip(QS, pv, sv):
+        assert abs((data <= a).sum() - (data <= b).sum()) <= bound + 2
+
+
+def test_add_state_merge_contract_errors():
+    """add_state rejects merge without a sketch AND sketches without merge,
+    with the reduction list in the generic error generated from the map."""
+    m = tm.MeanMetric()
+    with pytest.raises(ValueError, match="registered\\s+mergeable sketch state|registered mergeable"):
+        m.add_state("bad", jnp.zeros(3), dist_reduce_fx="merge")
+    with pytest.raises(ValueError, match="dist_reduce_fx='merge'"):
+        m.add_state("bad2", sk.kll_init(32, 4), dist_reduce_fx="sum")
+    with pytest.raises(ValueError, match="'merge'"):
+        m.add_state("bad3", jnp.zeros(3), dist_reduce_fx="avg")
+
+
+def test_obs_counters_cover_host_merges():
+    """Host-side merges are observable: the sync-path reduction bumps
+    sketch.merge under obs tracing."""
+    from torchmetrics_tpu.obs import counters as obs_counters
+    from torchmetrics_tpu.obs import trace as obs_trace
+
+    a = sk.kll_update(sk.kll_init(64, 8), np.arange(32, dtype=np.float32))
+    b = sk.kll_update(sk.kll_init(64, 8), np.arange(32, dtype=np.float32))
+    with obs_trace.tracing():
+        before = obs_counters.get("sketch.merge")
+        sk.reduce_merge_states([a, b, a])
+        assert obs_counters.get("sketch.merge") == before + 2
+
+
+# -------------------------------------------------------- bounded Spearman
+
+
+def test_bounded_spearman_matches_exact_within_tolerance():
+    """Acceptance: SpearmanCorrCoef(num_bins=...) agrees with exact Spearman
+    within the documented tolerance (0.05 at num_bins=64) across correlation
+    strengths, in a fraction of the state."""
+    n = 12_000
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal(n).astype(np.float32)
+    for rho_target in (0.9, -0.5):
+        noise = np.sqrt(max(1 - rho_target**2, 1e-6))
+        y = (rho_target * x + noise * rng.standard_normal(n)).astype(np.float32)
+        exact = tm.SpearmanCorrCoef()
+        bounded = tm.SpearmanCorrCoef(num_bins=64)
+        for i in range(6):
+            sl = slice(i * 2_000, (i + 1) * 2_000)
+            exact.update(x[sl], y[sl])
+            bounded.update(x[sl], y[sl])
+        ev, bv = float(exact.compute()), float(bounded.compute())
+        assert abs(ev - bv) <= 0.05, f"target {rho_target}: exact {ev} vs bounded {bv}"
+
+
+def test_bounded_spearman_monotone_transform_invariance():
+    """Spearman is rank-based: a monotone transform of the inputs must leave
+    the bounded estimate (which ranks through the sketch CDF) unchanged up
+    to binning noise."""
+    n = 8_000
+    rng = np.random.default_rng(12)
+    x = rng.standard_normal(n).astype(np.float32)
+    y = (0.7 * x + 0.5 * rng.standard_normal(n)).astype(np.float32)
+    plain = tm.SpearmanCorrCoef(num_bins=64)
+    warped = tm.SpearmanCorrCoef(num_bins=64)
+    plain.update(x, y)
+    warped.update(np.exp(x), np.tanh(y) * 7)
+    assert abs(float(plain.compute()) - float(warped.compute())) <= 0.02
+
+
+def test_bounded_spearman_sharded_equals_replicated():
+    """All three bounded-Spearman states are fixed-shape, so the metric runs
+    in the sharded step; parity with the replicated path within binning
+    tolerance (the per-device sketch CDFs differ slightly by construction)."""
+    n = 4_000
+    rng = np.random.default_rng(13)
+    x = rng.standard_normal(n).astype(np.float32)
+    y = (0.6 * x + 0.6 * rng.standard_normal(n)).astype(np.float32)
+    plain = tm.SpearmanCorrCoef(num_bins=32)
+    shard = ShardedMetric(tm.SpearmanCorrCoef(num_bins=32), _mesh())
+    for i in range(2):
+        sl = slice(i * 2_000, (i + 1) * 2_000)
+        plain.update(x[sl], y[sl])
+        shard.update(x[sl], y[sl])
+    assert abs(float(plain.compute()) - float(shard.compute())) <= 0.03
+    # bounded state stays bounded: the joint grid is num_bins^2 regardless of n
+    assert plain.joint.shape == (32, 32)
+
+
+def test_bounded_spearman_rejects_multioutput():
+    with pytest.raises(ValueError, match="num_outputs=1"):
+        tm.SpearmanCorrCoef(num_outputs=3, num_bins=32)
+
+
+def test_bounded_spearman_exact_mode_unchanged():
+    """num_bins=None keeps the exact cat-state regime byte-for-byte."""
+    n = 500
+    rng = np.random.default_rng(14)
+    x = rng.standard_normal(n).astype(np.float32)
+    y = (0.5 * x + rng.standard_normal(n)).astype(np.float32)
+    m = tm.SpearmanCorrCoef()
+    m.update(x, y)
+    assert isinstance(m.preds, list)  # still the cat-state regime
+    from scipy import stats
+
+    want = stats.spearmanr(x, y).statistic
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
+
+
+def test_quantile_explicit_capacity_sizes_levels_from_it():
+    """Review regression: with an explicit capacity but default levels, the
+    level count must be derived from the GIVEN capacity (a smaller buffer
+    needs MORE levels to absorb max_n before the overflow latch voids the
+    eps contract)."""
+    m = tm.Quantile(q=0.5, capacity=256)
+    levels, cap = m.sketch.items.shape
+    assert cap == 256
+    assert cap * 2 ** (levels - 1) >= 1e8  # default max_n fits pre-overflow
+
+
+def test_kll_init_rejects_count_wrapping_geometry():
+    """count is int32: a geometry whose weight capacity exceeds 2**31-1 would
+    wrap count before the overflow latch fires — refused at init."""
+    with pytest.raises(ValueError, match="int32"):
+        sk.kll_init(2048, 24)
+    with pytest.raises(ValueError, match="int32|max_n"):
+        sk.kll_geometry(0.01, max_n=1e10)
+
+
+def test_moments_count_is_exact_int():
+    """Review regression: an int32 count cannot stall at 2**24 the way a
+    float32 one does; single-observation streams keep counting exactly."""
+    state = sk.moments_init(())
+    assert state.count.dtype == jnp.int32
+    for v in range(5):
+        state = sk.moments_update(state, np.float32(v))
+    assert int(state.count) == 5
+    np.testing.assert_allclose(float(sk.moments_mean(state)), 2.0, rtol=1e-6)
+
+
+def test_reservoir_rank_decorrelates_tags():
+    """Review regression: distinct ranks fold into the init key, so two
+    ranks' reservoirs draw different tag sequences and their merge is a
+    genuine union sample (same (seed, rank) would tie every tag pairwise)."""
+    data = _RNG.normal(size=200).astype(np.float32)
+    r0 = sk.reservoir_update(sk.reservoir_init(100, seed=0, rank=0), data)
+    r1 = sk.reservoir_update(sk.reservoir_init(100, seed=0, rank=1), data)
+    assert not np.array_equal(np.asarray(r0.tags), np.asarray(r1.tags))
+    same = sk.reservoir_update(sk.reservoir_init(100, seed=0, rank=0), data)
+    np.testing.assert_array_equal(np.asarray(r0.tags), np.asarray(same.tags))

@@ -28,11 +28,14 @@ from torchmetrics_tpu.metric import Metric
 
 
 class SeededBadMetric(Metric):
+    full_state_update = False
+
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("rows", [], dist_reduce_fx="mean")
         self.add_state("oops", jnp.asarray(0.0), dist_reduce_fx="avg")
+        self.add_state("stream", [], dist_reduce_fx="cat")
         self.pool = {SeededBadMetric()}
 
     def update(self, values):
@@ -96,6 +99,38 @@ def test_seeded_violation_details(seeded_file, tmp_path):
     assert any("'mean'" in v.message for v in by_rule["ML003"])
     assert any("np.cumsum" in v.message for v in by_rule["ML004"])
     assert any("set/frozenset" in v.message for v in by_rule["ML005"])
+    assert any("sketch" in v.message for v in by_rule["ML006"])
+
+
+def test_ml003_message_tracks_runtime_reductions():
+    """The accepted-literal list is read from _reduction_names.py — the same
+    source metric.py builds _REDUCTION_MAP from — so 'merge' is valid and the
+    two can never drift again (satellite of the sketch subsystem PR)."""
+    from torchmetrics_tpu.lint.rules import _VALID_REDUCTIONS
+
+    from torchmetrics_tpu._reduction_names import VALID_REDUCTION_NAMES
+
+    assert _VALID_REDUCTIONS == tuple(VALID_REDUCTION_NAMES)
+    assert "merge" in _VALID_REDUCTIONS
+
+
+def test_ml006_not_flagged_without_bounded_claim(tmp_path):
+    """A cat state on a class that does NOT claim full_state_update=False is
+    the documented exact regime — ML006 must stay quiet."""
+    path = tmp_path / "cat_ok.py"
+    path.write_text(
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n\n\n"
+        "class ExactCatMetric(Metric):\n"
+        "    def __init__(self, **kwargs):\n"
+        "        super().__init__(**kwargs)\n"
+        "        self.add_state(\"rows\", [], dist_reduce_fx=\"cat\")\n\n"
+        "    def update(self, values):\n"
+        "        self.rows.append(values)\n\n"
+        "    def compute(self):\n"
+        "        return jnp.concatenate(self.rows)\n"
+    )
+    assert lint_paths([str(path)], root=str(tmp_path)) == []
 
 
 def test_registered_state_assignment_is_not_flagged(tmp_path):

@@ -153,6 +153,24 @@ _EXAMPLES = {
     >>> np.asarray(metric.compute()).tolist()
     [1.0, 2.0, 3.0]
     """,
+    # below the sketch capacity the KLL state is exact: the q-quantile is the
+    # ceil(q*n)-th order statistic, so these pins are analytic
+    "aggregation.Quantile": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import Quantile
+    >>> metric = Quantile(q=[0.25, 0.75])
+    >>> metric.update(np.array([1.0, 4.0, 2.0, 3.0]))
+    >>> np.asarray(metric.compute()).tolist()
+    [1.0, 3.0]
+    """,
+    "aggregation.Median": """
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import Median
+    >>> metric = Median()
+    >>> metric.update(np.array([7.0, 1.0, 3.0]))
+    >>> round(float(metric.compute()), 4)
+    3.0
+    """,
     # -------------------------------------------------------------------- text
     "text.metrics.WordErrorRate": """
     >>> from torchmetrics_tpu import WordErrorRate

@@ -1,0 +1,16 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""The canonical ``dist_reduce_fx`` name list — the ONE source of truth.
+
+Deliberately dependency-free (no jax, no package imports) so both the
+runtime (``metric.py`` builds ``_REDUCTION_MAP`` and its ``add_state`` error
+message from it) and the stdlib-only static checker (``lint/rules.py`` loads
+this file BY PATH, bypassing the package ``__init__``) read the same tuple.
+Adding a reduction here without a ``_REDUCTION_MAP`` entry fails loudly at
+import time in ``metric.py``; the days of a hard-coded literal list silently
+drifting from the map are over.
+"""
+
+#: every string ``Metric.add_state`` accepts for ``dist_reduce_fx``
+#: (callables and ``None`` are additionally always accepted)
+VALID_REDUCTION_NAMES = ("sum", "mean", "cat", "min", "max", "merge")

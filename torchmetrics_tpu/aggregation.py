@@ -5,16 +5,27 @@
 Capability parity with reference ``src/torchmetrics/aggregation.py`` (727 LoC):
 ``BaseAggregator`` with NaN strategies, ``MaxMetric``/``MinMetric``/
 ``SumMetric``/``CatMetric``/``MeanMetric`` (weighted), and windowed
-``RunningMean``/``RunningSum``.
+``RunningMean``/``RunningSum`` — plus the bounded-memory ``Quantile``/
+``Median`` built on the KLL sketch (``torchmetrics_tpu.sketch``,
+ARCHITECTURE.md §11): the streaming answer to ``CatMetric`` +
+``jnp.quantile``, in O(1) state.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Union
+from typing import Any, Callable, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.sketch import (
+    kll_error_bound,
+    kll_geometry,
+    kll_init,
+    kll_levels_for,
+    kll_quantile,
+    kll_update,
+)
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.wrappers.running import Running
 
@@ -166,6 +177,87 @@ class MeanMetric(BaseAggregator):
 
     def compute(self) -> Array:
         return self.mean_value / self.weight
+
+
+class Quantile(BaseAggregator):
+    """Streaming quantile(s) in bounded memory via a KLL sketch.
+
+    The ``dist_reduce_fx="merge"`` counterpart of ``CatMetric`` +
+    ``jnp.quantile``: the state is a fixed-shape
+    :class:`~torchmetrics_tpu.sketch.KLLSketch` (so it jits, shards, syncs by
+    pairwise merge, and checkpoints like any elementwise state) and every
+    query's rank error is bounded by ``eps * n`` — the live bound for the
+    current stream is :meth:`error_bound`.
+
+    Args:
+        q: quantile (or sequence of quantiles) in ``[0, 1]`` to report.
+        eps: target worst-case rank-error fraction; the sketch geometry is
+            sized from it (ignored when ``capacity``/``levels`` are given).
+        max_n: stream length the ``eps`` sizing must hold for.
+        capacity/levels: explicit sketch geometry override.
+        nan_strategy: as every aggregator (``"error"|"warn"|"ignore"|float``).
+    """
+
+    full_state_update = False
+
+    def __init__(
+        self,
+        q: Union[float, Sequence[float]] = 0.5,
+        eps: float = 0.01,
+        max_n: float = 1e8,
+        capacity: Optional[int] = None,
+        levels: Optional[int] = None,
+        nan_strategy: Union[str, float] = "warn",
+        **kwargs: Any,
+    ) -> None:
+        q_arr = jnp.asarray(q, jnp.float32)
+        if bool(jnp.any((q_arr < 0) | (q_arr > 1))):
+            raise ValueError(f"Expected quantile(s) `q` in [0, 1], but got {q}")
+        if capacity is None:
+            sized_capacity, sized_levels = kll_geometry(eps, max_n)
+            capacity = sized_capacity
+            levels = sized_levels if levels is None else levels
+        elif levels is None:
+            # levels must be derived from the GIVEN capacity: a smaller
+            # buffer needs MORE levels to absorb the same max_n before the
+            # overflow latch voids every guarantee
+            levels = kll_levels_for(capacity, max_n)
+        super().__init__("merge", kll_init(capacity=capacity, levels=levels), nan_strategy, state_name="sketch", **kwargs)
+        self.q = q_arr
+        self.eps = eps
+
+    def update(self, value: Union[float, Array]) -> None:
+        if self.nan_strategy == "ignore":
+            # the other aggregators mask NaNs to zero WEIGHT, but a sketch
+            # point has no weight channel — truly dropping them needs a
+            # data-dependent size, which only the eager (host) path can do
+            value = jnp.asarray(value, dtype=jnp.float32).ravel()
+            if isinstance(value, jax.core.Tracer):
+                raise ValueError(
+                    "Quantile(nan_strategy='ignore') cannot run inside a traced update (dropping"
+                    " NaNs is data-dependent-shape); use a float imputation strategy or pre-filter"
+                )
+            value = value[~jnp.isnan(value)]
+        else:
+            value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.sketch = kll_update(self.sketch, value)
+
+    def compute(self) -> Array:
+        """The ``q``-quantile(s) of everything streamed so far."""
+        return kll_quantile(self.sketch, self.q)
+
+    def error_bound(self) -> Array:
+        """Hard deterministic bound on the rank error of :meth:`compute`
+        (``sum_l compactions[l] * 2**l``; divide by ``n`` for the fraction)."""
+        return kll_error_bound(self.sketch)
+
+
+class Median(Quantile):
+    """Streaming median in bounded memory — ``Quantile(q=0.5)``."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(q=0.5, nan_strategy=nan_strategy, **kwargs)
 
 
 class RunningMean(Running):

@@ -31,6 +31,7 @@ import numpy as np
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.obs import counters as _obs_counters
 from torchmetrics_tpu.obs import trace as _obs_trace
+from torchmetrics_tpu.sketch.registry import is_sketch_state as _is_sketch_state
 from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -283,6 +284,11 @@ class MetricCollection(dict):
             red_tok = red if isinstance(red, (str, type(None))) else getattr(red, "__name__", repr(red))
             if isinstance(val, list):
                 parts.append((key, "list", tuple((tuple(v.shape), str(v.dtype)) for v in val), red_tok))
+            elif _is_sketch_state(val):
+                leaf_spec = tuple(
+                    (tuple(leaf.shape), str(leaf.dtype)) for leaf in jax.tree_util.tree_leaves(val)
+                )
+                parts.append((key, f"merge:{type(val).__name__}", leaf_spec, red_tok))
             else:
                 parts.append((key, "array", tuple(val.shape), str(val.dtype), red_tok))
         return tuple(parts)
@@ -303,6 +309,15 @@ class MetricCollection(dict):
                 if len(state1) != len(state2):
                     return False
                 if not all(allclose(s1, s2) for s1, s2 in zip(state1, state2)):
+                    return False
+            elif _is_sketch_state(state1):
+                leaves1 = jax.tree_util.tree_leaves(state1)
+                leaves2 = jax.tree_util.tree_leaves(state2)
+                if len(leaves1) != len(leaves2):
+                    return False
+                if not all(
+                    l1.shape == l2.shape and allclose(l1, l2) for l1, l2 in zip(leaves1, leaves2)
+                ):
                     return False
             else:
                 if state1.shape != state2.shape or not allclose(state1, state2):

@@ -21,9 +21,30 @@ RULES: Dict[str, str] = {
     "ML003": "add_state reduction/default contract violation",
     "ML004": "numpy op on a traced value where a jnp equivalent exists",
     "ML005": "Metric stored in a container _walk_metrics cannot traverse",
+    "ML006": "unbounded cat-list state on a metric claiming full_state_update=False",
 }
 
-_VALID_REDUCTIONS = ("sum", "mean", "cat", "min", "max")
+
+def _load_valid_reductions() -> tuple:
+    """The accepted-literal list for ML003, read from the runtime's canonical
+    ``_reduction_names.py`` — loaded BY FILE PATH so the linter keeps its
+    no-jax guarantee (a package import would execute ``torchmetrics_tpu``'s
+    ``__init__``). Falls back to the last-known list only if the file is gone
+    (a vendored/partial checkout)."""
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), os.pardir, "_reduction_names.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_tm_tpu_reduction_names", path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return tuple(module.VALID_REDUCTION_NAMES)
+    except Exception:  # pragma: no cover - partial checkouts only
+        return ("sum", "mean", "cat", "min", "max", "merge")
+
+
+_VALID_REDUCTIONS = _load_valid_reductions()
 
 # jnp equivalents for ML004 — hardcoded (stable numpy/jnp common surface) so
 # the linter never has to import jax
@@ -68,6 +89,7 @@ class ClassInfo:
     dynamic_states: bool  # add_state with a non-literal name anywhere
     host_counters: Set[str]
     host_only: bool  # sets _sharded_update_unsupported (never on the jit path)
+    fsu_false: bool = False  # declares a literal `full_state_update = False`
 
 
 def _base_name(node: ast.expr) -> Optional[str]:
@@ -104,6 +126,7 @@ def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
     dynamic = False
     host_counters: Set[str] = set()
     host_only = False
+    fsu_false = False
     for stmt in ast.walk(node):
         if isinstance(stmt, ast.Call) and _is_self_call(stmt, "add_state"):
             name_arg = _call_arg(stmt, 0, "name")
@@ -123,6 +146,10 @@ def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
                     value = stmt.value
                     if not (isinstance(value, ast.Constant) and value.value is None):
                         host_only = True
+                elif tgt_name == "full_state_update":
+                    value = stmt.value
+                    if isinstance(value, ast.Constant) and value.value is False:
+                        fsu_false = True
                 elif tgt_name == "_host_counters" and stmt.value is not None:
                     for elt in getattr(stmt.value, "elts", []):
                         if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
@@ -136,6 +163,7 @@ def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
         dynamic_states=dynamic,
         host_counters=host_counters,
         host_only=host_only,
+        fsu_false=fsu_false,
     )
 
 
@@ -200,6 +228,12 @@ class ClassIndex:
 
     def classes_in_file(self, path: str) -> List[ClassInfo]:
         return [info for infos in self._by_name.values() for info in infos if info.path == path]
+
+    def claims_fsu_false(self, info: ClassInfo) -> bool:
+        """True when the class (or a non-root ancestor) declares a literal
+        ``full_state_update = False``. The ``Metric`` base's own default is
+        excluded — "claims" means somebody opted the class in explicitly."""
+        return any(cur.fsu_false for cur in self._ancestry(info) if cur.name != "Metric")
 
 
 # ------------------------------------------------------------ taint analysis
@@ -486,6 +520,36 @@ def check_ml003(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
             )
 
 
+def check_ml006(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
+    """Unbounded ``cat`` list state on a metric claiming bounded behavior.
+
+    A ``dist_reduce_fx="cat"`` list state grows without bound with
+    data-dependent shapes — it can never live inside the compiled sharded
+    step, and on a class that also claims ``full_state_update = False`` (the
+    "my state folds cheaply" contract) the combination signals a metric that
+    WANTS to be streaming but holds the whole stream. The bounded-memory
+    sketch subsystem (``torchmetrics_tpu/sketch``, ``dist_reduce_fx="merge"``)
+    is the fix; pre-existing offenders are ratcheted in the baseline."""
+    if not index.claims_fsu_false(info):
+        return
+    for node in ast.walk(info.node):
+        if not (isinstance(node, ast.Call) and _is_self_call(node, "add_state")):
+            continue
+        default = _call_arg(node, 1, "default")
+        fx = _call_arg(node, 2, "dist_reduce_fx")
+        if not (isinstance(fx, ast.Constant) and fx.value == "cat"):
+            continue
+        if not isinstance(default, (ast.List, ast.ListComp)):
+            continue
+        yield Violation(
+            "ML006", info.path, node.lineno, node.col_offset, f"{info.name}.add_state",
+            "dist_reduce_fx='cat' list state on a metric claiming full_state_update=False:"
+            " the state grows without bound and can never enter the compiled sharded step —"
+            " consider a bounded sketch state (torchmetrics_tpu.sketch,"
+            " dist_reduce_fx='merge'), e.g. SpearmanCorrCoef(num_bins=...)",
+        )
+
+
 def check_ml005(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
     """Metric instances placed where ``_walk_metrics`` cannot see them.
 
@@ -546,6 +610,7 @@ def check_file(path: str, tree: ast.Module, index: ClassIndex) -> List[Violation
         violations.extend(check_ml001(info, index))
         violations.extend(check_ml003(info, index))
         violations.extend(check_ml005(info, index))
+        violations.extend(check_ml006(info, index))
         for item in info.node.body:
             if not (isinstance(item, ast.FunctionDef) and item.name in ("update", "compute")):
                 continue

@@ -42,9 +42,11 @@ from torchmetrics_tpu.utilities.data import (
     dim_zero_min,
     dim_zero_sum,
 )
+from torchmetrics_tpu._reduction_names import VALID_REDUCTION_NAMES
 from torchmetrics_tpu.obs import counters as _obs_counters
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.sketch.registry import is_sketch_state, merge_states, reduce_merge_states
 from torchmetrics_tpu.robustness.sync_config import DEFAULT_SYNC_CONFIG, SyncConfig
 from torchmetrics_tpu.utilities.distributed import distributed_available as _dist_available
 from torchmetrics_tpu.utilities.distributed import gather_all_arrays
@@ -85,7 +87,15 @@ _REDUCTION_MAP: Dict[str, Optional[Callable]] = {
     "cat": dim_zero_cat,
     "min": dim_zero_min,
     "max": dim_zero_max,
+    # sketch states: reduce a per-rank/per-device sequence by pairwise merge
+    "merge": reduce_merge_states,
 }
+# the canonical name list (shared with metriclint's ML003) and the map must
+# agree — a reduction added to one without the other fails here at import
+assert tuple(_REDUCTION_MAP) == VALID_REDUCTION_NAMES, (
+    f"_REDUCTION_MAP keys {tuple(_REDUCTION_MAP)} drifted from"
+    f" _reduction_names.VALID_REDUCTION_NAMES {VALID_REDUCTION_NAMES}"
+)
 
 
 class Metric:
@@ -191,11 +201,25 @@ class Metric:
     ) -> None:
         """Register a metric state (reference ``metric.py:197-280``).
 
-        ``default`` must be an array (fixed-shape accumulator) or an empty
-        list (append/``cat`` state). ``dist_reduce_fx`` one of
-        ``"sum"|"mean"|"cat"|"min"|"max"``, a custom callable, or ``None``.
+        ``default`` must be an array (fixed-shape accumulator), an empty
+        list (append/``cat`` state), or — with ``dist_reduce_fx="merge"`` — a
+        registered mergeable sketch state (``torchmetrics_tpu.sketch``).
+        ``dist_reduce_fx`` is one of the names in ``_REDUCTION_MAP``, a custom
+        callable, or ``None``.
         """
-        if not isinstance(default, list) or default:
+        if dist_reduce_fx == "merge":
+            if not is_sketch_state(default):
+                raise ValueError(
+                    f"dist_reduce_fx='merge' requires the default of state {name!r} to be a registered"
+                    " mergeable sketch state (see torchmetrics_tpu.sketch.register_sketch_state),"
+                    f" got {type(default).__name__}"
+                )
+        elif is_sketch_state(default):
+            raise ValueError(
+                f"state {name!r} holds a {type(default).__name__} sketch state — it must be registered"
+                " with dist_reduce_fx='merge' (any other reduction would mangle the pytree)"
+            )
+        elif not isinstance(default, list) or default:
             if isinstance(default, (int, float)):
                 default = jnp.asarray(default, dtype=self._dtype if isinstance(default, float) else None)
             if not isinstance(default, (jnp.ndarray, np.ndarray, jax.Array)):
@@ -210,7 +234,10 @@ class Metric:
                 # hard boundary for low-precision inputs.
                 default = jnp.asarray(default, dtype=default.dtype)
         if dist_reduce_fx is not None and not (dist_reduce_fx in _REDUCTION_MAP or callable(dist_reduce_fx)):
-            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+            # generated from the live map so the message can never drift from
+            # what the runtime actually accepts (it did once, pre-"merge")
+            valid = ", ".join(repr(name_) for name_ in _REDUCTION_MAP)
+            raise ValueError(f"`dist_reduce_fx` must be callable or one of [{valid}, None]")
         if name in ("update", "compute", "forward", "reset"):
             raise ValueError(f"The name `{name}` is reserved and cannot be used for a metric state")
 
@@ -464,6 +491,8 @@ class Metric:
                 reduced = jnp.maximum(global_state, local_state)
             elif reduce_fn == "min":
                 reduced = jnp.minimum(global_state, local_state)
+            elif reduce_fn == "merge":
+                reduced = merge_states(global_state, local_state)
             elif reduce_fn == "cat":
                 if isinstance(global_state, list):
                     reduced = global_state + local_state
@@ -495,15 +524,35 @@ class Metric:
         for attr, value in input_dict.items():
             if faults._ACTIVE:  # mid-sync fault point: earlier states are already gathered
                 faults.fire("sync.state_gather")
-            if isinstance(value, list):
-                output_dict[attr] = [dist_sync_fn(v, group=self.process_group if process_group is None else process_group) for v in value]
+            group = self.process_group if process_group is None else process_group
+            if self._reductions[attr] == "merge":
+                # sketch state: gather leaf-wise (each leaf is a fixed-shape
+                # array, so it rides the same pad/trim array gather as every
+                # other state), then transpose to one state pytree per rank
+                leaves, treedef = jax.tree_util.tree_flatten(value)
+                gathered_leaves = [dist_sync_fn(leaf, group=group) for leaf in leaves]
+                n_ranks = len(gathered_leaves[0]) if gathered_leaves else 1
+                output_dict[attr] = [
+                    treedef.unflatten([g[r] for g in gathered_leaves]) for r in range(n_ranks)
+                ]
+            elif isinstance(value, list):
+                output_dict[attr] = [dist_sync_fn(v, group=group) for v in value]
             else:
-                output_dict[attr] = dist_sync_fn(value, group=self.process_group if process_group is None else process_group)
+                output_dict[attr] = dist_sync_fn(value, group=group)
 
         for attr, reduction_fn in self._reductions.items():
             if faults._ACTIVE:  # mid-apply fault point: earlier states are already overwritten
                 faults.fire("sync.state_apply")
             gathered = output_dict[attr]
+            if reduction_fn == "merge":
+                if faults._ACTIVE:  # deterministic corrupt-payload drill (lockstep on all ranks)
+                    idx = faults.corrupt_index("sync.sketch_state", len(gathered))
+                    if idx is not None:
+                        gathered = list(gathered)
+                        gathered[idx] = _structurally_corrupt_state(gathered[idx])
+                self._validate_merge_gather(attr, input_dict[attr], gathered)
+                setattr(self, attr, reduce_merge_states(gathered))
+                continue
             if isinstance(gathered, list) and len(gathered) == 0:
                 setattr(self, attr, [])
                 continue
@@ -517,6 +566,34 @@ class Metric:
                 raise TypeError("reduction_fn must be callable or None")
             reduced = reduction_fn(gathered) if reduction_fn is not None else gathered
             setattr(self, attr, reduced)
+
+    def _validate_merge_gather(self, attr: str, template: Any, gathered: Sequence[Any]) -> None:
+        """Structurally validate every rank's gathered sketch state against
+        the local one BEFORE merging: a corrupt payload (wrong class, missing
+        leaf, reshaped/re-typed leaf) raises :class:`SyncError` naming the
+        state and the offending rank instead of detonating inside the merge
+        (or, worse, silently merging garbage into every rank's result)."""
+        t_leaves, t_def = jax.tree_util.tree_flatten(template)
+        for rank, state in enumerate(gathered):
+            if type(state) is not type(template):
+                raise SyncError(
+                    f"merge-state gather: state {attr!r} from rank {rank} has class"
+                    f" {type(state).__name__}, expected {type(template).__name__} — corrupt payload"
+                )
+            leaves, treedef = jax.tree_util.tree_flatten(state)
+            if treedef != t_def:
+                raise SyncError(
+                    f"merge-state gather: state {attr!r} from rank {rank} has pytree structure"
+                    f" {treedef}, expected {t_def} — corrupt payload"
+                )
+            for got, want in zip(leaves, t_leaves):
+                got, want = jnp.asarray(got), jnp.asarray(want)
+                if got.shape != want.shape or got.dtype != want.dtype:
+                    raise SyncError(
+                        f"merge-state gather: state {attr!r} from rank {rank} has a leaf of"
+                        f" shape {got.shape}/{got.dtype}, expected {want.shape}/{want.dtype} —"
+                        " corrupt payload"
+                    )
 
     def _sync_dist_bounded(self, dist_sync_fn: Callable, process_group: Optional[Any], timeout_s: Optional[float]) -> None:
         """Run ``_sync_dist``, optionally under a wall-clock budget.
@@ -733,6 +810,8 @@ class Metric:
             current_val = getattr(self, key)
             if isinstance(current_val, list):
                 destination[prefix + key] = [np.asarray(v) for v in current_val]
+            elif is_sketch_state(current_val):
+                destination[prefix + key] = jax.tree_util.tree_map(np.asarray, current_val)
             else:
                 destination[prefix + key] = np.asarray(current_val)
         return destination
@@ -745,6 +824,8 @@ class Metric:
                 value = state_dict[name]
                 if isinstance(value, list):
                     setattr(self, key, [jnp.asarray(v) for v in value])
+                elif is_sketch_state(value):
+                    setattr(self, key, jax.tree_util.tree_map(jnp.asarray, value))
                 else:
                     setattr(self, key, jnp.asarray(value))
             elif strict and self._persistent[key]:
@@ -790,6 +871,10 @@ class Metric:
         for attr, default in self._defaults.items():
             if isinstance(default, jax.Array) and jnp.issubdtype(default.dtype, jnp.floating):
                 self._defaults[attr] = default.astype(dst_type)
+            elif is_sketch_state(default):
+                self._defaults[attr] = jax.tree_util.tree_map(
+                    lambda x: x.astype(dst_type) if jnp.issubdtype(x.dtype, jnp.floating) else x, default
+                )
         return self
 
     def _apply(self, fn: Callable[[Array], Array]) -> None:
@@ -797,6 +882,8 @@ class Metric:
             current = getattr(self, attr)
             if isinstance(current, list):
                 setattr(self, attr, [fn(jnp.asarray(c)) for c in current])
+            elif is_sketch_state(current):
+                setattr(self, attr, jax.tree_util.tree_map(fn, current))
             else:
                 setattr(self, attr, fn(jnp.asarray(current)))
 
@@ -838,6 +925,8 @@ class Metric:
             val = getattr(self, key)
             if isinstance(val, list):
                 hash_vals.extend(np.asarray(v).tobytes() for v in val)
+            elif is_sketch_state(val):
+                hash_vals.extend(np.asarray(leaf).tobytes() for leaf in jax.tree_util.tree_leaves(val))
             else:
                 hash_vals.append(np.asarray(val).tobytes())
         return hash(tuple(hash_vals))
@@ -957,6 +1046,14 @@ class Metric:
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
         return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _structurally_corrupt_state(state: Any) -> Any:
+    """Test-only mutation used by the ``sync.sketch_state`` fault point: give
+    the first leaf a trailing extra axis so structural validation trips."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    leaves[0] = jnp.zeros(tuple(jnp.asarray(leaves[0]).shape) + (2,), jnp.asarray(leaves[0]).dtype)
+    return treedef.unflatten(leaves)
 
 
 def _neg(x: Array) -> Array:

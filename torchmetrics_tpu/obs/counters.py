@@ -21,6 +21,9 @@ name                                incremented when
 ``collection.update.dedup_skipped`` a compute-group member skipped its update
                                     (the group leader updated for it)
 ``checkpoint.save`` / ``.load``     a checkpoint was saved / restored
+``sketch.merge`` (+ ``.<Class>``)   a host-side pairwise sketch-state merge ran
+                                    (cross-rank "merge" sync, forward fold);
+                                    traced merges are excluded, not undercounted
 ==================================  ==============================================
 
 Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the

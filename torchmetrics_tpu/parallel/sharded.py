@@ -45,6 +45,7 @@ from torchmetrics_tpu.parallel.cat_buffer import (
     cat_buffer_values,
     infer_cat_layout,
 )
+from torchmetrics_tpu.sketch.registry import is_sketch_state, merge_states
 
 try:  # jax >= 0.7 top-level export; the experimental path is deprecated
     from jax import shard_map as _shard_map
@@ -86,6 +87,10 @@ def metric_merge(
         return jnp.maximum(a, b)
     if reduction == "min":
         return jnp.minimum(a, b)
+    if reduction == "merge":
+        # sketch states carry their own exact pairwise merge — weights are
+        # irrelevant (the sketch tracks its own counts)
+        return merge_states(a, b)
     if reduction == "cat":
         if isinstance(a, CatBuffer):
             return cat_buffer_merge(a, b)
@@ -149,6 +154,16 @@ def mesh_reduce_tree(reductions: Dict[str, Any], state: Dict[str, Any], axis_nam
             out[key] = jax.lax.pmax(value, axis_name)
         elif reduction == "min":
             out[key] = jax.lax.pmin(value, axis_name)
+        elif reduction == "merge":
+            # per-device partial sketches: all_gather every leaf, then fold
+            # the device axis by pairwise merge (device count is static at
+            # trace time, so the fold unrolls into the compiled program)
+            gathered = jax.tree_util.tree_map(lambda v: jax.lax.all_gather(v, axis_name), value)
+            n_dev = int(jax.tree_util.tree_leaves(gathered)[0].shape[0])
+            merged = jax.tree_util.tree_map(lambda v: v[0], gathered)
+            for d in range(1, n_dev):
+                merged = merge_states(merged, jax.tree_util.tree_map(lambda v, _d=d: v[_d], gathered))
+            out[key] = merged
         elif reduction == "cat":
             out[key] = gather_flat(value)
         elif reduction is None:
@@ -220,7 +235,11 @@ def _make_jit_update(
             " accumulation needs a fixed capacity — pass cat_capacity (max total rows) and an"
             " example_batch."
         )
-    init_state = {k: jnp.asarray(v) for k, v in metric._defaults.items() if k not in list_state_keys}
+    init_state = {
+        k: v if is_sketch_state(v) else jnp.asarray(v)
+        for k, v in metric._defaults.items()
+        if k not in list_state_keys
+    }
     if list_state_keys:
         if example_batch is None:
             raise ValueError("cat_capacity requires example_batch to infer per-state row shapes")

@@ -4,7 +4,7 @@
 ``src/torchmetrics/regression/spearman.py``)."""
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -14,14 +14,32 @@ from torchmetrics_tpu.functional.regression.spearman import (
     _spearman_corrcoef_update,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.sketch import kll_cdf, kll_geometry, kll_init, kll_update
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
 
 
 class SpearmanCorrCoef(Metric):
-    """Spearman rank correlation (reference ``spearman.py:26``); needs the
-    full stream (``cat`` states) since ranks are global."""
+    """Spearman rank correlation (reference ``spearman.py:26``).
+
+    Two regimes:
+
+    - **exact** (default): ranks are global, so the full stream is retained
+      in ``cat`` states — unbounded memory, data-dependent shapes, never
+      jit/shard-able.
+    - **bounded** (``num_bins=``): O(1) state. Two KLL quantile sketches
+      (``torchmetrics_tpu.sketch``) track the marginal CDFs; each batch is
+      ranked THROUGH the sketch CDF into a fixed ``num_bins x num_bins``
+      joint histogram, and compute runs the tied-rank (midrank) Spearman
+      formula over the grid. Every state is fixed-shape, so the metric
+      qualifies for the compiled sharded step and ``"merge"``/``"sum"``
+      cross-rank sync. Accuracy: binning resolves ranks to ~``1/num_bins``
+      and early batches are binned through a CDF estimated from less data,
+      so expect ``|rho_binned - rho_exact|`` of a few times ``1/num_bins``
+      on iid streams — ``num_bins=64`` lands within ~0.03 in the property
+      suite (tested tolerance: 0.05).
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -29,22 +47,70 @@ class SpearmanCorrCoef(Metric):
     plot_lower_bound = -1.0
     plot_upper_bound = 1.0
 
-    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+    def __init__(self, num_outputs: int = 1, num_bins: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         if not isinstance(num_outputs, int) or num_outputs < 1:
             raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if num_bins is not None and (not isinstance(num_bins, int) or num_bins < 2):
+            raise ValueError(f"Expected argument `num_bins` to be an int larger than 1 or None, but got {num_bins}")
+        self.num_bins = num_bins
+        if num_bins is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            if num_outputs != 1:
+                raise ValueError(
+                    "`num_bins` (bounded-state mode) currently supports `num_outputs=1`; run one"
+                    " metric per output for multioutput streams"
+                )
+            # sketch rank error only needs to resolve below the bin width
+            # (1/num_bins); sizing tighter than that doubles the sort cost of
+            # every update for accuracy the binning immediately throws away
+            capacity, levels = kll_geometry(eps=min(0.02, 1.0 / num_bins), max_n=1e8)
+            self.add_state("preds_sketch", default=kll_init(capacity, levels), dist_reduce_fx="merge")
+            self.add_state("target_sketch", default=kll_init(capacity, levels), dist_reduce_fx="merge")
+            self.add_state("joint", default=jnp.zeros((num_bins, num_bins), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
-        """Append a batch (reference ``spearman.py:80``)."""
+        """Append a batch (exact: cat-state append, reference ``spearman.py:80``;
+        bounded: fold into the sketches and sketch-rank into the joint grid)."""
         preds, target = _spearman_corrcoef_update(jnp.asarray(preds), jnp.asarray(target), self.num_outputs)
-        self.preds.append(preds.astype(jnp.float32))
-        self.target.append(target.astype(jnp.float32))
+        preds, target = preds.astype(jnp.float32), target.astype(jnp.float32)
+        if self.num_bins is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            return
+        self.preds_sketch = kll_update(self.preds_sketch, preds)
+        self.target_sketch = kll_update(self.target_sketch, target)
+        # rank via the (just-updated) sketch CDF: values land in the bin of
+        # their approximate global rank fraction
+        bins = self.num_bins
+        ip = jnp.clip((kll_cdf(self.preds_sketch, preds) * bins).astype(jnp.int32), 0, bins - 1)
+        it = jnp.clip((kll_cdf(self.target_sketch, target) * bins).astype(jnp.int32), 0, bins - 1)
+        self.joint = self.joint.at[ip, it].add(1.0)
 
     def compute(self) -> Array:
-        """Rank the full stream and correlate (reference ``spearman.py:88``)."""
-        preds = dim_zero_cat(self.preds)
-        target = dim_zero_cat(self.target)
-        return _spearman_corrcoef_compute(preds, target)
+        """Exact: rank the full stream and correlate (reference
+        ``spearman.py:88``). Bounded: midrank Spearman over the joint grid."""
+        if self.num_bins is None:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return _spearman_corrcoef_compute(preds, target)
+        counts = self.joint
+        n = jnp.sum(counts)
+        marg_p = jnp.sum(counts, axis=1)
+        marg_t = jnp.sum(counts, axis=0)
+        # midrank of every value in bin b: ranks are 1..n in bin order, all
+        # members of a bin tie at the average of the ranks the bin spans
+        rank_p = jnp.cumsum(marg_p) - marg_p + (marg_p + 1.0) / 2.0
+        rank_t = jnp.cumsum(marg_t) - marg_t + (marg_t + 1.0) / 2.0
+        rbar = (n + 1.0) / 2.0
+        dp = jnp.where(marg_p > 0, rank_p - rbar, 0.0)
+        dt = jnp.where(marg_t > 0, rank_t - rbar, 0.0)
+        cov = dp @ counts @ dt
+        var_p = jnp.sum(marg_p * dp * dp)
+        var_t = jnp.sum(marg_t * dt * dt)
+        denom = jnp.sqrt(var_p * var_t)
+        rho = cov / jnp.where(denom > 0, denom, 1.0)
+        return jnp.clip(jnp.where((n > 1) & (denom > 0), rho, jnp.nan), -1.0, 1.0)

@@ -61,14 +61,29 @@ def save_checkpoint(metric: Any) -> Dict[str, Any]:
     return _save_checkpoint(metric)
 
 
+def _serialize_state(value: Any) -> Any:
+    """One state as plain host data: list states -> list of ndarrays, sketch
+    states -> a marked ``{"__sketch__": class, "leaves": {...}}`` dict (so the
+    checkpoint stays a plain serializable dict), arrays -> ndarray."""
+    from torchmetrics_tpu.robustness.spec import SKETCH_PAYLOAD_KEY
+    from torchmetrics_tpu.sketch.registry import is_sketch_state
+
+    if isinstance(value, list):
+        return [np.asarray(x) for x in value]
+    if is_sketch_state(value):
+        return {
+            SKETCH_PAYLOAD_KEY: type(value).__name__,
+            "leaves": {field: np.asarray(leaf) for field, leaf in zip(type(value)._fields, value)},
+        }
+    return np.asarray(value)
+
+
 def _save_checkpoint(metric: Any) -> Dict[str, Any]:
     metrics: Dict[str, Any] = {}
     for path, m in _walk(metric):
         tree = m.state_tree(include_count=True)
         count = int(tree.pop("_update_count"))
-        state = {
-            name: [np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v) for name, v in tree.items()
-        }
+        state = {name: _serialize_state(v) for name, v in tree.items()}
         metrics[path] = {
             "fingerprint": spec_fingerprint(m),
             "update_count": count,
@@ -169,13 +184,20 @@ def _load_checkpoint(metric: Any, checkpoint: Dict[str, Any], strict: bool = Tru
 
     # phase 2: apply — every entry already validated (so this cannot
     # half-fail); the trusted installer skips re-validating what phase 1 did
+    import jax
     import jax.numpy as jnp
 
+    from torchmetrics_tpu.sketch.registry import is_sketch_state
+
+    def _to_device(v: Any) -> Any:
+        if isinstance(v, list):
+            return [jnp.asarray(x) for x in v]
+        if is_sketch_state(v):  # validation already reconstructed the pytree
+            return jax.tree_util.tree_map(jnp.asarray, v)
+        return jnp.asarray(v)
+
     for m, validated, count, counters in staged:
-        tree = {
-            name: [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
-            for name, v in validated.items()
-        }
+        tree = {name: _to_device(v) for name, v in validated.items()}
         tree["_update_count"] = count
         m._install_state_tree(tree)
         m._computed = None

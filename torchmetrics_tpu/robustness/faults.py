@@ -21,6 +21,10 @@ point                      kinds                  fires
                                                   overwritten — a genuine mid-sync failure)
 ``gather_bytes.pre``       fail, delay            before the object-gather collective
 ``gather_bytes.payload``   corrupt, truncate      on the wire buffer of ``_gather_objects_via_bytes``
+``sync.sketch_state``      corrupt                on the per-rank gathered sketch states of a
+                                                  ``dist_reduce_fx="merge"`` sync (``arg`` = which
+                                                  rank's payload to mangle; fires in lockstep on
+                                                  every process so the group agrees on the error)
 ``update.preempt``         preempt                after a completed ``Metric.update`` (raises
                                                   :class:`SimulatedPreemption` — checkpoint/restore drills)
 =========================  =====================  ==================================
@@ -168,6 +172,20 @@ def mutate_bytes(point: str, data: bytes, header_len: int = 0) -> bytes:
                 window = data[lo : lo + n]
                 data = data[:lo] + bytes(b ^ 0xFF for b in window) + data[lo + len(window) :]
     return data
+
+
+def corrupt_index(point: str, n: int) -> Optional[int]:
+    """Index (< ``n``) whose payload a ``corrupt`` fault at ``point`` asks the
+    caller to mangle, or ``None``. ``arg`` selects the payload (rank) index;
+    rank-unscoped faults fire identically on every process, keeping a
+    multi-process group in lockstep about WHICH payload went bad."""
+    if not _ACTIVE:
+        return None
+    rank = _rank()
+    for f in _ACTIVE:
+        if f.kind == "corrupt" and f._should_fire(point, rank):
+            return int(f.arg) % max(n, 1)
+    return None
 
 
 def install_from_env(value: Optional[str] = None) -> List[Fault]:

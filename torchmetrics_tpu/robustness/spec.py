@@ -37,9 +37,9 @@ _ELEMENTWISE_REDUCTIONS = ("sum", "mean", "min", "max")
 class StateSpec(NamedTuple):
     """Declared contract of one metric state."""
 
-    kind: str  # "array" | "list"
-    dtype: Optional[str]  # None for list states (element dtype is per-append)
-    shape: Optional[Tuple[int, ...]]  # None for list states
+    kind: str  # "array" | "list" | "merge" (mergeable sketch pytree)
+    dtype: Optional[str]  # None for list states; sketch CLASS NAME for merge states
+    shape: Optional[Tuple]  # None for list states; per-leaf (field, dtype, shape) for merge states
     reduction: str  # reduction name, "none", or the callable's qualname
 
 
@@ -53,11 +53,21 @@ def _reduction_token(reduction: Any) -> str:
 
 def build_state_specs(metric: Any) -> Dict[str, StateSpec]:
     """Per-state :class:`StateSpec` for every registered state of ``metric``."""
+    from torchmetrics_tpu.sketch.registry import is_sketch_state
+
     specs: Dict[str, StateSpec] = {}
     for name, default in metric._defaults.items():
         token = _reduction_token(metric._reductions.get(name))
         if isinstance(default, list):
             specs[name] = StateSpec("list", None, None, token)
+        elif is_sketch_state(default):
+            # fixed-shape pytree: the spec pins class name + EVERY leaf's
+            # dtype/shape, so a capacity/levels mismatch is a spec mismatch
+            leaves = tuple(
+                (field, str(leaf.dtype), tuple(int(d) for d in leaf.shape))
+                for field, leaf in zip(type(default)._fields, default)
+            )
+            specs[name] = StateSpec("merge", type(default).__name__, leaves, token)
         else:
             specs[name] = StateSpec("array", str(default.dtype), tuple(int(d) for d in default.shape), token)
     return specs
@@ -96,6 +106,75 @@ def _dtype_safe_widening(got: Any, want: Any) -> bool:
         return False
 
 
+#: serialized-sketch payload marker (checkpoints store sketch states as a
+#: plain ``{"__sketch__": class_name, "leaves": {field: ndarray}}`` dict so
+#: the checkpoint stays msgpack/orbax-serializable)
+SKETCH_PAYLOAD_KEY = "__sketch__"
+
+
+def _validate_sketch_state(cls: str, name: str, default: Any, value: Any, strict: bool) -> Any:
+    """Validate (and, for serialized payloads, reconstruct) one mergeable
+    sketch state against its default: class, field set, and every leaf's
+    shape and dtype must match the fixed-shape contract EXACTLY — sketch
+    leaves never grow, so a capacity/levels mismatch is a hard error naming
+    the state and leaf."""
+    from torchmetrics_tpu.sketch.registry import sketch_state_class
+
+    want_cls = type(default)
+    fields = want_cls._fields
+    if isinstance(value, dict):
+        if value.get(SKETCH_PAYLOAD_KEY) != want_cls.__name__:
+            raise StateRestoreError(
+                f"state {name!r} of {cls}: expected a serialized {want_cls.__name__} sketch payload,"
+                f" got {value.get(SKETCH_PAYLOAD_KEY)!r} — was this checkpoint written by a"
+                " differently-configured metric?"
+            )
+        leaves_in = value.get("leaves")
+        if not isinstance(leaves_in, dict) or sorted(leaves_in) != sorted(fields):
+            got = sorted(leaves_in) if isinstance(leaves_in, dict) else type(leaves_in).__name__
+            raise StateRestoreError(
+                f"state {name!r} of {cls}: sketch payload leaves {got} do not match the declared"
+                f" fields {sorted(fields)} — truncated or corrupted payload?"
+            )
+        try:
+            sketch_state_class(want_cls.__name__)
+        except KeyError as err:
+            raise StateRestoreError(f"state {name!r} of {cls}: {err}") from None
+        value = want_cls(*[leaves_in[field] for field in fields])
+    elif type(value) is not want_cls:
+        raise StateRestoreError(
+            f"state {name!r} of {cls}: expected a {want_cls.__name__} sketch state,"
+            f" got {type(value).__name__}"
+        )
+    checked = []
+    for field, want_leaf, got_leaf in zip(fields, default, value):
+        if not hasattr(got_leaf, "dtype") or not hasattr(got_leaf, "shape"):
+            got_leaf = np.asarray(got_leaf)
+        got_shape = tuple(int(d) for d in got_leaf.shape)
+        want_shape = tuple(int(d) for d in want_leaf.shape)
+        if got_shape != want_shape:
+            raise StateRestoreError(
+                f"state {name!r} of {cls}: sketch leaf {field!r} has shape {got_shape}, expected"
+                f" {want_shape} — sketch states are fixed-shape (capacity/levels mismatch?)"
+            )
+        if got_leaf.dtype != want_leaf.dtype:
+            if strict:
+                raise StateRestoreError(
+                    f"state {name!r} of {cls}: sketch leaf {field!r} has dtype {got_leaf.dtype},"
+                    f" expected {want_leaf.dtype} (strict restore; pass strict=False to allow"
+                    " safe widenings)"
+                )
+            if not _dtype_safe_widening(got_leaf.dtype, want_leaf.dtype):
+                raise StateRestoreError(
+                    f"state {name!r} of {cls}: cannot coerce sketch leaf {field!r} dtype"
+                    f" {got_leaf.dtype} to {want_leaf.dtype} — only safe widenings are allowed"
+                    " in non-strict restore"
+                )
+            got_leaf = got_leaf.astype(want_leaf.dtype)
+        checked.append(got_leaf)
+    return want_cls(*checked)
+
+
 def validate_state_tree(metric: Any, tree: Dict[str, Any], strict: bool = True) -> Dict[str, Any]:
     """Validate ``tree`` against ``metric``'s state registry.
 
@@ -120,6 +199,8 @@ def validate_state_tree(metric: Any, tree: Dict[str, Any], strict: bool = True) 
                 f"Missing metric state(s) {missing} for {cls}: a strict restore must cover every registered state"
             )
 
+    from torchmetrics_tpu.sketch.registry import is_sketch_state
+
     out: Dict[str, Any] = {}
     for name, value in tree.items():
         if name not in defaults:
@@ -127,6 +208,9 @@ def validate_state_tree(metric: Any, tree: Dict[str, Any], strict: bool = True) 
         default = defaults[name]
         reduction = metric._reductions.get(name)
         token = _reduction_token(reduction)
+        if is_sketch_state(default):
+            out[name] = _validate_sketch_state(cls, name, default, value, strict)
+            continue
         if isinstance(default, list):
             if not isinstance(value, (list, tuple)):
                 raise StateRestoreError(

@@ -1,0 +1,120 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Bounded-memory mergeable sketches (ARCHITECTURE.md §11).
+
+Every ``dist_reduce_fx="cat"`` metric state accumulates unbounded memory
+with data-dependent shapes — it can never live inside the jit-compiled
+sharded step. The sketches here trade exactness for **O(1) fixed-shape
+state with hard error bounds**, each exposing the same four pure functions:
+
+    init(...) -> State            # fixed-shape pytree (a NamedTuple)
+    update(State, x) -> State     # jit-safe, shape-preserving
+    merge(State, State) -> State  # jit-safe, shape-preserving,
+                                  # associative/commutative (up to fp)
+    query(State, ...) -> value    # quantile/cdf/mean/sample/...
+
+``merge`` is what plugs them into the metric runtime: states registered
+with ``add_state(..., dist_reduce_fx="merge")`` sync across ranks by
+pairwise merge (riding the retry/rollback sync path), reduce across mesh
+devices inside ``shard_map``, and checkpoint/restore with per-leaf
+validation — see :mod:`torchmetrics_tpu.sketch.registry`.
+
+Sketches:
+
+- :class:`KLLSketch` — streaming quantiles/ranks (Karnin-Lang-Liberty
+  compactors, deterministic variant) with an exact queryable rank-error
+  bound (:func:`kll_error_bound`);
+- :class:`HistogramSketch` — fixed-bin streaming histogram (exact merge);
+- :class:`ReservoirSketch` — uniform sample via tagged top-k, PRNG key
+  threaded through the state (no hidden RNG);
+- :class:`MomentsSketch` — Chan/Welford parallel-merge count/mean/M2.
+"""
+from torchmetrics_tpu.sketch.histogram import (
+    HistogramSketch,
+    hist_cdf,
+    hist_counts,
+    hist_init,
+    hist_merge,
+    hist_quantile,
+    hist_update,
+)
+from torchmetrics_tpu.sketch.moments import (
+    MomentsSketch,
+    moments_count,
+    moments_init,
+    moments_mean,
+    moments_merge,
+    moments_std,
+    moments_update,
+    moments_variance,
+)
+from torchmetrics_tpu.sketch.quantile import (
+    MAX_STREAM,
+    KLLSketch,
+    kll_cdf,
+    kll_error_bound,
+    kll_geometry,
+    kll_init,
+    kll_levels_for,
+    kll_merge,
+    kll_quantile,
+    kll_rank,
+    kll_state_bytes,
+    kll_update,
+)
+from torchmetrics_tpu.sketch.registry import (
+    is_sketch_state,
+    merge_states,
+    reduce_merge_states,
+    register_sketch_state,
+    registered_sketch_classes,
+    sketch_state_class,
+)
+from torchmetrics_tpu.sketch.reservoir import (
+    ReservoirSketch,
+    reservoir_init,
+    reservoir_merge,
+    reservoir_sample,
+    reservoir_update,
+)
+
+__all__ = [
+    "HistogramSketch",
+    "KLLSketch",
+    "MAX_STREAM",
+    "MomentsSketch",
+    "ReservoirSketch",
+    "hist_cdf",
+    "hist_counts",
+    "hist_init",
+    "hist_merge",
+    "hist_quantile",
+    "hist_update",
+    "is_sketch_state",
+    "kll_cdf",
+    "kll_error_bound",
+    "kll_geometry",
+    "kll_init",
+    "kll_levels_for",
+    "kll_merge",
+    "kll_quantile",
+    "kll_rank",
+    "kll_state_bytes",
+    "kll_update",
+    "merge_states",
+    "moments_count",
+    "moments_init",
+    "moments_mean",
+    "moments_merge",
+    "moments_std",
+    "moments_update",
+    "moments_variance",
+    "reduce_merge_states",
+    "register_sketch_state",
+    "registered_sketch_classes",
+    "reservoir_init",
+    "reservoir_merge",
+    "reservoir_sample",
+    "reservoir_update",
+    "sketch_state_class",
+]

@@ -1,0 +1,122 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Fixed-bin streaming histogram — the simplest mergeable sketch.
+
+Bin edges are fixed at ``init`` (a data-range decision, like AUROC's binned
+thresholds), so the state is a single count vector plus out-of-range tallies
+and merging is elementwise addition: exactly associative/commutative, and
+the per-value resolution is the bin width — no stream-length dependence.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.registry import register_sketch_state
+
+Array = jax.Array
+
+
+class HistogramSketch(NamedTuple):
+    """Registered pytree state of the fixed-bin histogram."""
+
+    edges: Array  #: (bins+1,) monotonically increasing bin edges (constant)
+    counts: Array  #: (bins,) int32 in-range counts
+    low: Array  #: () int32 count of values < edges[0]
+    high: Array  #: () int32 count of values > edges[-1]
+    count: Array  #: () int32 total values folded in
+
+
+def hist_init(bins: int, lo: float, hi: float, dtype: Union[jnp.dtype, type] = jnp.float32) -> HistogramSketch:
+    """Empty histogram of ``bins`` equal-width bins over ``[lo, hi]``."""
+    if bins < 1:
+        raise ValueError(f"need bins >= 1, got {bins}")
+    if not lo < hi:
+        raise ValueError(f"need lo < hi, got ({lo}, {hi})")
+    return HistogramSketch(
+        edges=jnp.linspace(lo, hi, bins + 1, dtype=jnp.dtype(dtype)),
+        counts=jnp.zeros((bins,), jnp.int32),
+        low=jnp.asarray(0, jnp.int32),
+        high=jnp.asarray(0, jnp.int32),
+        count=jnp.asarray(0, jnp.int32),
+    )
+
+
+def hist_update(state: HistogramSketch, x: Array) -> HistogramSketch:
+    """Fold a batch in (jit-safe scatter-add; shapes preserved)."""
+    x = jnp.ravel(jnp.asarray(x)).astype(state.edges.dtype)
+    if x.size == 0:
+        return state
+    bins = state.counts.shape[0]
+    below = jnp.sum(x < state.edges[0]).astype(jnp.int32)
+    above = jnp.sum(x > state.edges[-1]).astype(jnp.int32)
+    idx = jnp.clip(jnp.searchsorted(state.edges, x, side="right") - 1, 0, bins - 1)
+    in_range = (x >= state.edges[0]) & (x <= state.edges[-1])
+    counts = state.counts.at[idx].add(in_range.astype(jnp.int32))
+    return HistogramSketch(
+        edges=state.edges,
+        counts=counts,
+        low=state.low + below,
+        high=state.high + above,
+        count=state.count + jnp.asarray(x.size, jnp.int32),
+    )
+
+
+def hist_merge(a: HistogramSketch, b: HistogramSketch) -> HistogramSketch:
+    """Exact merge: counts add. Both sketches must share the edge vector
+    (same shape is enforced here; same values are the caller's init contract,
+    validated host-side by the state-spec machinery)."""
+    if a.edges.shape != b.edges.shape:
+        raise ValueError(
+            f"cannot merge histograms with different bin counts: {a.edges.shape} vs {b.edges.shape}"
+        )
+    return HistogramSketch(
+        edges=a.edges,
+        counts=a.counts + b.counts,
+        low=a.low + b.low,
+        high=a.high + b.high,
+        count=a.count + b.count,
+    )
+
+
+def hist_counts(state: HistogramSketch) -> Tuple[Array, Array, Array]:
+    """``(counts, low, high)`` — in-range per-bin counts plus out-of-range tallies."""
+    return state.counts, state.low, state.high
+
+
+def hist_cdf(state: HistogramSketch, v: Union[float, Array]) -> Array:
+    """Approximate CDF at ``v`` (linear interpolation within a bin)."""
+    dtype = state.edges.dtype
+    v = jnp.asarray(v, dtype)
+    cum = jnp.cumsum(state.counts).astype(dtype)
+    padded = jnp.concatenate([jnp.zeros((1,), dtype), cum])
+    bins = state.counts.shape[0]
+    pos = jnp.clip(jnp.searchsorted(state.edges, v, side="right") - 1, 0, bins - 1)
+    width = state.edges[pos + 1] - state.edges[pos]
+    frac = jnp.clip((v - state.edges[pos]) / jnp.where(width > 0, width, 1.0), 0.0, 1.0)
+    below = state.low.astype(dtype) + padded[pos] + frac * state.counts[pos].astype(dtype)
+    below = jnp.where(v < state.edges[0], 0.0, below)
+    below = jnp.where(v >= state.edges[-1], state.count.astype(dtype) - state.high.astype(dtype), below)
+    return below / jnp.maximum(state.count, 1).astype(dtype)
+
+
+def hist_quantile(state: HistogramSketch, q: Union[float, Array]) -> Array:
+    """Approximate ``q``-quantile from the binned CDF (interpolated; clamps
+    to the histogram range; NaN on an empty sketch)."""
+    dtype = state.edges.dtype
+    q = jnp.asarray(q, dtype)
+    total = state.count.astype(dtype)
+    cum = state.low.astype(dtype) + jnp.cumsum(state.counts).astype(dtype)
+    padded = jnp.concatenate([state.low.astype(dtype)[None], cum])
+    target = jnp.clip(q * total, 0.0, total)
+    bins = state.counts.shape[0]
+    pos = jnp.clip(jnp.searchsorted(padded, target, side="left") - 1, 0, bins - 1)
+    binc = state.counts[pos].astype(dtype)
+    frac = jnp.clip((target - padded[pos]) / jnp.where(binc > 0, binc, 1.0), 0.0, 1.0)
+    out = state.edges[pos] + frac * (state.edges[pos + 1] - state.edges[pos])
+    return jnp.where(state.count > 0, out, jnp.asarray(jnp.nan, dtype))
+
+
+register_sketch_state(HistogramSketch, hist_merge)

@@ -1,0 +1,91 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Chan/Welford parallel-merge running moments (count / mean / M2).
+
+The numerically-stable streaming mean+variance state, with Chan et al.'s
+pairwise combine as the merge — the textbook example of a mergeable
+fixed-shape state, and the template every other sketch here follows. Works
+elementwise over any state shape (scalars, per-class vectors, images)."""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.registry import register_sketch_state
+
+Array = jax.Array
+
+
+class MomentsSketch(NamedTuple):
+    """Registered pytree state of the running moments."""
+
+    count: Array  #: () int32 number of points folded in (exact to 2**31-1;
+    #: a float32 count would silently stall at 2**24 on long streams)
+    mean: Array  #: (shape) running mean
+    m2: Array  #: (shape) running sum of squared deviations
+
+
+def moments_init(
+    shape: Tuple[int, ...] = (), dtype: Union[jnp.dtype, type] = jnp.float32
+) -> MomentsSketch:
+    """Empty moments accumulator over values of ``shape``."""
+    dtype = jnp.dtype(dtype)
+    return MomentsSketch(
+        count=jnp.asarray(0, jnp.int32),
+        mean=jnp.zeros(shape, dtype),
+        m2=jnp.zeros(shape, dtype),
+    )
+
+
+def moments_merge(a: MomentsSketch, b: MomentsSketch) -> MomentsSketch:
+    """Chan et al. parallel combine — jit-safe, shape-preserving, exact in
+    count and stable in mean/M2 (no catastrophic cancellation)."""
+    if a.mean.shape != b.mean.shape:
+        raise ValueError(
+            f"cannot merge moments over different shapes: {a.mean.shape} vs {b.mean.shape}"
+        )
+    dtype = a.mean.dtype
+    n = a.count + b.count
+    an, bn = a.count.astype(dtype), b.count.astype(dtype)
+    safe_n = jnp.maximum(n, 1).astype(dtype)
+    delta = b.mean - a.mean
+    mean = a.mean + delta * (bn / safe_n)
+    m2 = a.m2 + b.m2 + jnp.square(delta) * (an * bn / safe_n)
+    return MomentsSketch(count=n, mean=mean, m2=m2)
+
+
+def moments_update(state: MomentsSketch, x: Array) -> MomentsSketch:
+    """Fold a batch (leading axis = batch) in via batch-Welford + Chan merge."""
+    x = jnp.asarray(x, state.mean.dtype)
+    if x.ndim == state.mean.ndim:  # single observation
+        x = x[None]
+    if x.shape[0] == 0:
+        return state
+    n_b = jnp.asarray(x.shape[0], jnp.int32)
+    mean_b = jnp.mean(x, axis=0)
+    m2_b = jnp.sum(jnp.square(x - mean_b), axis=0)
+    return moments_merge(state, MomentsSketch(count=n_b, mean=mean_b, m2=m2_b))
+
+
+def moments_mean(state: MomentsSketch) -> Array:
+    """Running mean (NaN when empty)."""
+    return jnp.where(state.count > 0, state.mean, jnp.nan)
+
+
+def moments_variance(state: MomentsSketch, ddof: int = 0) -> Array:
+    """Running variance with ``ddof`` degrees-of-freedom correction."""
+    denom = (state.count - ddof).astype(state.m2.dtype)
+    return jnp.where(denom > 0, state.m2 / jnp.where(denom > 0, denom, 1.0), jnp.nan)
+
+
+def moments_std(state: MomentsSketch, ddof: int = 0) -> Array:
+    return jnp.sqrt(moments_variance(state, ddof))
+
+
+def moments_count(state: MomentsSketch) -> Array:
+    return state.count
+
+
+register_sketch_state(MomentsSketch, moments_merge)

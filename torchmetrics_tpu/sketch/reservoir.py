@@ -1,0 +1,115 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Counter-based mergeable reservoir sample with explicit PRNG key threading.
+
+The classic "exponential tags" formulation (Efraimidis & Spirakis A-Res with
+unit weights): every incoming value draws a uniform tag and the reservoir
+keeps the ``capacity`` values with the LARGEST tags. That makes the sample
+
+- **uniform** — each point's tag is iid, so the top-``capacity`` set is a
+  uniform sample without replacement;
+- **mergeable** — the merged reservoir is the top-``capacity`` of the tag
+  union: exactly associative and commutative on the ``(value, tag)`` pairs;
+- **jit-safe** — update/merge are a concat + top-k, all fixed shapes.
+
+Randomness is explicit: the PRNG key lives IN the state and every update
+splits it, so replaying the same stream from the same ``init`` seed is
+bit-reproducible — there is no hidden global RNG anywhere (the rule that
+keeps ``Metric`` updates traceable)."""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.registry import register_sketch_state
+
+Array = jax.Array
+
+
+class ReservoirSketch(NamedTuple):
+    """Registered pytree state of the uniform reservoir sample."""
+
+    values: Array  #: (capacity,) sampled values (junk beyond `filled` slots)
+    tags: Array  #: (capacity,) float32 uniform tags; -inf marks an empty slot
+    count: Array  #: () int32 total values seen
+    key: Array  #: (2,) uint32 threaded PRNG key (jax.random.PRNGKey layout)
+
+
+def reservoir_init(
+    capacity: int,
+    seed: int = 0,
+    dtype: Union[jnp.dtype, type] = jnp.float32,
+    rank: int = 0,
+) -> ReservoirSketch:
+    """Empty reservoir of ``capacity`` slots, randomness rooted at ``seed``.
+
+    **Multi-rank/multi-replica use MUST pass a distinct ``rank``** (e.g.
+    ``jax.process_index()``): two reservoirs initialized from the same
+    ``(seed, rank)`` draw bit-identical tag sequences, so a merge of their
+    samples selects the SAME stream positions on both sides — a perfectly
+    correlated "sample" that silently voids the uniformity guarantee
+    :func:`reservoir_merge` relies on. ``rank`` is folded into the key here
+    (rather than auto-read from the backend) so building a sketch never
+    touches — or blocks on — device initialization.
+    """
+    if capacity < 1:
+        raise ValueError(f"need capacity >= 1, got {capacity}")
+    key = jax.random.PRNGKey(seed)
+    if rank:
+        key = jax.random.fold_in(key, rank)
+    return ReservoirSketch(
+        values=jnp.zeros((capacity,), jnp.dtype(dtype)),
+        tags=jnp.full((capacity,), -jnp.inf, jnp.float32),
+        count=jnp.asarray(0, jnp.int32),
+        key=key,
+    )
+
+
+def _top_capacity(values: Array, tags: Array, capacity: int) -> Tuple[Array, Array]:
+    order = jnp.argsort(-tags)[:capacity]
+    return values[order], tags[order]
+
+
+def reservoir_update(state: ReservoirSketch, x: Array) -> ReservoirSketch:
+    """Fold a batch in: draw one tag per value from the threaded key, keep the
+    top-``capacity`` tagged values (jit-safe; shapes preserved)."""
+    x = jnp.ravel(jnp.asarray(x)).astype(state.values.dtype)
+    if x.size == 0:
+        return state
+    capacity = state.values.shape[0]
+    key, sub = jax.random.split(state.key)
+    tags = jax.random.uniform(sub, (x.size,), jnp.float32)
+    values, tags = _top_capacity(
+        jnp.concatenate([state.values, x]), jnp.concatenate([state.tags, tags]), capacity
+    )
+    return ReservoirSketch(values, tags, state.count + jnp.asarray(x.size, jnp.int32), key)
+
+
+def reservoir_merge(a: ReservoirSketch, b: ReservoirSketch) -> ReservoirSketch:
+    """Top-``capacity`` of the tag union — exact on the sample; the threaded
+    key folds the peer's count in so later updates stay decorrelated."""
+    if a.values.shape != b.values.shape:
+        raise ValueError(
+            f"cannot merge reservoirs of different capacity: {a.values.shape} vs {b.values.shape}"
+        )
+    capacity = a.values.shape[0]
+    values, tags = _top_capacity(
+        jnp.concatenate([a.values, b.values]), jnp.concatenate([a.tags, b.tags]), capacity
+    )
+    return ReservoirSketch(
+        values=values,
+        tags=tags,
+        count=a.count + b.count,
+        key=jax.random.fold_in(a.key, b.count),
+    )
+
+
+def reservoir_sample(state: ReservoirSketch) -> Tuple[Array, Array]:
+    """``(values, valid)`` — the sample and a boolean mask of live slots
+    (the reservoir is only partially filled while ``count < capacity``)."""
+    return state.values, jnp.isfinite(state.tags)
+
+
+register_sketch_state(ReservoirSketch, reservoir_merge)
