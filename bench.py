@@ -315,6 +315,7 @@ def main() -> None:
     try:
         from bench_workloads import (
             bench_bertscore,
+            bench_checkpoint_roundtrip,
             bench_coco_map,
             bench_coco_map_scale,
             bench_fid50k,
@@ -329,6 +330,9 @@ def main() -> None:
             # bounded-memory sketch throughput + peak-state-bytes vs the
             # equivalent cat-state metric (ISSUE 4): cheap, runs early
             ("sketch_quantile_throughput", bench_sketch_quantile, (max(16, n_batches),), 40),
+            # durable-snapshot save+load throughput + on-disk bytes for the
+            # three state regimes (ISSUE 5): host+disk only, cheap, runs early
+            ("checkpoint_roundtrip", bench_checkpoint_roundtrip, (), 30),
             ("fid50k", bench_fid50k, (), 120),
             ("coco_map_scale", bench_coco_map_scale, (), 180),
             # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;

@@ -48,6 +48,8 @@ FID50K_BATCHES = 391  # 391 * 128 = 50,048 images ~ the FID-50k protocol
 SKETCH_BATCH = 65536  # values per sketch update step
 SKETCH_CAPACITY = 2048  # the eps=0.01 Quantile geometry (~0.9% rank error)
 SKETCH_LEVELS = 18
+CKPT_CAT_SAMPLES = 200_000  # cat-state rows in the checkpoint_roundtrip metric
+CKPT_CLASSES = 128  # confusion-matrix size for the elementwise variant
 
 
 def bench_ssim(n_batches: int, repeats: int = 3) -> Dict:
@@ -201,6 +203,69 @@ def bench_sketch_quantile(n_batches: int, repeats: int = 3) -> Dict:
         "state_bytes": kll_state_bytes(state0),
         "cat_state_bytes": cat_bytes,
         "state_bytes_ratio": round(cat_bytes / kll_state_bytes(state0), 1),
+    }
+
+
+def bench_checkpoint_roundtrip(repeats: int = 3) -> Dict:
+    """``checkpoint_roundtrip``: durable-snapshot overhead of the
+    preemption-safe evaluation layer (ISSUE 5). One timed repeat drives, for
+    an elementwise (``MulticlassAccuracy`` 128-class confusion matrix), a cat
+    (``BinaryAveragePrecision`` holding 200k rows) and a KLL-sketch
+    (``Quantile(eps=0.01)``) metric: ``CheckpointStore.save`` (pickle + CRC32
+    + fsync + rename) then ``latest()`` + ``load_checkpoint`` into a fresh
+    metric. Headline is roundtrips/s; per-variant on-disk bytes ride along so
+    snapshot cost stays visible in the BENCH trajectory — this bounds how
+    often a ``StreamingEvaluator`` snapshot policy can fire."""
+    import os
+    import shutil
+    import tempfile
+
+    from torchmetrics_tpu import Quantile
+    from torchmetrics_tpu.classification import BinaryAveragePrecision, MulticlassAccuracy
+    from torchmetrics_tpu.robustness import CheckpointStore
+
+    rng = np.random.RandomState(0)
+    acc = MulticlassAccuracy(num_classes=CKPT_CLASSES)
+    acc.update(rng.randint(0, CKPT_CLASSES, 4096), rng.randint(0, CKPT_CLASSES, 4096))
+    ap = BinaryAveragePrecision()
+    ap.update(rng.rand(CKPT_CAT_SAMPLES).astype(np.float32), rng.randint(0, 2, CKPT_CAT_SAMPLES))
+    quant = Quantile(q=0.5, eps=0.01)
+    quant.update(rng.randn(CKPT_CAT_SAMPLES).astype(np.float32))
+    variants = {
+        "elementwise": (acc, lambda: MulticlassAccuracy(num_classes=CKPT_CLASSES)),
+        "cat": (ap, BinaryAveragePrecision),
+        "sketch": (quant, lambda: Quantile(q=0.5, eps=0.01)),
+    }
+
+    base = tempfile.mkdtemp(prefix="tm_tpu_ckpt_bench_")
+    bytes_on_disk: Dict[str, int] = {}
+
+    def roundtrip(tag: str) -> None:
+        for name, (metric, make) in variants.items():
+            store = CheckpointStore(os.path.join(base, f"{name}-{tag}"), keep_last=1)
+            file_name = store.save({"cursor": 1, "checkpoint": metric.save_checkpoint()}, step=1)
+            bytes_on_disk[name] = os.path.getsize(os.path.join(store.directory, file_name))
+            fresh = make()
+            _, payload = store.latest()
+            fresh.load_checkpoint(payload["checkpoint"])
+
+    runs = []
+    try:
+        roundtrip("warm")  # first-touch costs (imports, device->host paths)
+        for r in range(repeats):
+            t0 = time.perf_counter()
+            roundtrip(str(r))
+            runs.append(len(variants) / (time.perf_counter() - t0))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return {
+        "runs": runs,
+        "unit": "roundtrips/s",
+        "baseline": None,
+        "elementwise_bytes": bytes_on_disk["elementwise"],
+        "cat_bytes": bytes_on_disk["cat"],
+        "sketch_bytes": bytes_on_disk["sketch"],
+        "cat_samples": CKPT_CAT_SAMPLES,
     }
 
 

@@ -29,6 +29,13 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long randomized soak/chaos loops — excluded from tier-1 (-m 'not slow'), run explicitly",
+    )
+
+
 NUM_PROCESSES = 2  # parity with reference conftest NUM_PROCESSES
 NUM_BATCHES = 4
 BATCH_SIZE = 32

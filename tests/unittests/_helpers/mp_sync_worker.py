@@ -35,6 +35,15 @@ deterministic rank-error bound, and a fault-injected structurally-corrupt
 sketch payload raises ``SyncError`` naming the offending rank on BOTH ranks
 (with clean rollback: the metric heals and syncs once the fault clears).
 
+A fourth scenario, ``durable``, exercises preemption-safe evaluation
+(ISSUE 5): on each rank a ``StreamingEvaluator`` accumulates its shard of
+the stream into a per-rank ``CheckpointStore`` (``TM_TPU_STORE_DIR`` set by
+the parent test), dies at a fault-injected batch in lockstep, resumes from
+the newest snapshot, and the final synced ``compute()`` matches the
+uninterrupted single-process result for an elementwise (sum-state), a cat
+(list-state) and a sketch (``dist_reduce_fx="merge"``) metric; the default
+rank-aware store additionally proves only process 0 writes.
+
 Usage: ``python mp_sync_worker.py <process_id> <num_processes> <coord_addr> [scenario]``
 """
 from __future__ import annotations
@@ -217,6 +226,84 @@ def run_sketch_scenario(pid: int, nproc: int) -> None:
     print(f"rank {pid}: all sketch merge-sync checks passed")
 
 
+def run_durable_scenario(pid: int, nproc: int) -> None:
+    """Kill-and-resume parity under a REAL 2-process group: each rank's
+    evaluation is preempted at the same batch, resumed from its own store,
+    and the synced result equals the uninterrupted single-process run."""
+    import os
+
+    import numpy as np
+
+    from torchmetrics_tpu import Quantile
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryAveragePrecision
+    from torchmetrics_tpu.robustness import CheckpointStore, StreamingEvaluator, faults
+    from torchmetrics_tpu.robustness.faults import SimulatedPreemption
+    from torchmetrics_tpu.sketch import kll_error_bound
+
+    base = os.environ["TM_TPU_STORE_DIR"]
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 96
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    bounds = [0, 60, n_total]  # uneven shards
+    lo, hi = bounds[pid], bounds[pid + 1]
+    n_batches = 6
+    shard_p = np.array_split(preds[lo:hi], n_batches)
+    shard_t = np.array_split(target[lo:hi], n_batches)
+    batches = list(zip(shard_p, shard_t))
+
+    def kill_and_resume(make_metric, batches, store_dir, kill_after=3):
+        """Run to a lockstep preemption at batch ``kill_after + 1``, then
+        resume a FRESH metric from the store and return its synced compute."""
+        store = CheckpointStore(store_dir, keep_last=2, write_rank=None)  # replica states: every rank persists
+        ev = StreamingEvaluator(make_metric(), store=store, snapshot_every_n=2)
+        with faults.inject(faults.Fault("preempt", "runner.preempt", after=kill_after, count=1)):
+            try:
+                ev.run(batches)
+                raise AssertionError("runner.preempt did not fire")
+            except SimulatedPreemption:
+                pass
+        resumed = StreamingEvaluator(make_metric(), store=store, snapshot_every_n=2)
+        return resumed, resumed.resume(batches)
+
+    # A) elementwise (sum states): synced resume equals the uninterrupted
+    # single-process result BITWISE
+    _, got = kill_and_resume(BinaryAccuracy, batches, f"{base}/acc/rank{pid}")
+    ref = BinaryAccuracy(distributed_available_fn=lambda: False)
+    ref.update(preds, target)
+    want = float(ref.compute())
+    assert float(got) == want, f"durable elementwise resume: {float(got)} != {want}"
+
+    # B) cat (list states): the restored list state gathers across ranks
+    _, got_ap = kill_and_resume(BinaryAveragePrecision, batches, f"{base}/ap/rank{pid}")
+    ap_ref = BinaryAveragePrecision(distributed_available_fn=lambda: False)
+    ap_ref.update(preds, target)
+    want_ap = float(ap_ref.compute())
+    assert abs(float(got_ap) - want_ap) < 1e-6, f"durable cat resume: {float(got_ap)} != {want_ap}"
+
+    # C) sketch ("merge" state): resumed + merged quantile stays inside the
+    # sketch's own deterministic rank-error bound on the full stream
+    data = rng.randn(20_000).astype(np.float32)  # same on both ranks
+    dlo, dhi = (0, 13_000) if pid == 0 else (13_000, 20_000)
+    sk_batches = [np.ascontiguousarray(c) for c in np.array_split(data[dlo:dhi], n_batches)]
+    make_q = lambda: Quantile(q=0.5, capacity=256, levels=14)
+    resumed_q, got_q = kill_and_resume(make_q, sk_batches, f"{base}/q/rank{pid}")
+    resumed_q.metric.sync()
+    bound = float(kll_error_bound(resumed_q.metric.sketch))
+    assert int(resumed_q.metric.sketch.count) == data.size, "merged sketch lost samples across resume"
+    rank_err = abs(float((data <= float(got_q)).sum()) - 0.5 * data.size)
+    assert rank_err <= bound + 1, f"durable sketch resume: rank error {rank_err} > bound {bound}"
+    resumed_q.metric.unsync()
+
+    # D) rank-aware default: with a SHARED store directory only process 0
+    # writes; other ranks' save() is a no-op
+    shared = CheckpointStore(f"{base}/shared")  # write_rank=0 default
+    wrote = shared.save({"rank": pid}, step=1)
+    assert (wrote is not None) == (pid == 0), f"rank {pid}: write gate broken ({wrote})"
+
+    print(f"rank {pid}: all durable kill-and-resume checks passed")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -227,6 +314,9 @@ def main() -> None:
         return
     if scenario == "sketch":
         run_sketch_scenario(pid, nproc)
+        return
+    if scenario == "durable":
+        run_durable_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

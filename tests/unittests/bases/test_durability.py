@@ -1,0 +1,429 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Preemption-safe evaluation (ISSUE 5 tentpole): ``StreamingEvaluator``
+kill-and-resume parity for elementwise, cat and sketch states, exactly-once
+cursor semantics, snapshot policies, the stall watchdog, and the chaos soak
+(deterministic kill-at-fixed-batch variants in tier-1; the long randomized
+loop is ``slow``). The REAL 2-process scenario lives in
+``mp_sync_worker.py`` (``durable``)."""
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection, Quantile
+from torchmetrics_tpu.classification import BinaryAccuracy, BinaryAveragePrecision, MulticlassAccuracy
+from torchmetrics_tpu.robustness import CheckpointStore, StreamingEvaluator, faults
+from torchmetrics_tpu.robustness.faults import SimulatedPreemption
+from torchmetrics_tpu.utilities.exceptions import CheckpointStoreWarning, StallError, StateRestoreError
+
+N_BATCHES = 8
+
+
+def _cls_batches(seed=0, n=N_BATCHES, size=48):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, 5, size), rng.randint(0, 5, size)) for _ in range(n)]
+
+
+def _bin_batches(seed=1, n=N_BATCHES, size=32):
+    rng = np.random.RandomState(seed)
+    return [(rng.rand(size).astype(np.float32), rng.randint(0, 2, size)) for _ in range(n)]
+
+
+def _sketch_batches(seed=2, n=N_BATCHES, size=2048):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(size).astype(np.float32) for _ in range(n)]
+
+
+#: (label, metric factory, batch stream factory) for the three state regimes
+REGIMES = [
+    ("elementwise", lambda: MulticlassAccuracy(num_classes=5), _cls_batches),
+    ("cat", BinaryAveragePrecision, _bin_batches),
+    ("sketch", lambda: Quantile(q=[0.25, 0.75], capacity=256, levels=14), _sketch_batches),
+]
+
+
+def _uninterrupted(make_metric, batches):
+    metric = make_metric()
+    for batch in batches:
+        metric.update(*batch) if isinstance(batch, tuple) else metric.update(batch)
+    return metric
+
+
+def _assert_state_parity(got, want, label):
+    got_tree = got.state_tree(include_count=True)
+    want_tree = want.state_tree(include_count=True)
+    assert set(got_tree) == set(want_tree)
+    for key, want_val in want_tree.items():
+        got_val = got_tree[key]
+        if isinstance(want_val, list):
+            assert len(got_val) == len(want_val), f"{label}:{key}"
+            for g, w in zip(got_val, want_val):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=f"{label}:{key}")
+        elif hasattr(want_val, "_fields"):  # sketch pytree: leaf-wise bitwise
+            for field, g, w in zip(type(want_val)._fields, got_val, want_val):
+                np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=f"{label}:{key}.{field}")
+        else:
+            np.testing.assert_array_equal(np.asarray(got_val), np.asarray(want_val), err_msg=f"{label}:{key}")
+
+
+def _kill_at(ev, batches, kill_after):
+    """Drive ``ev`` until the injected preemption after batch ``kill_after+1``."""
+    with faults.inject(faults.Fault("preempt", "runner.preempt", after=kill_after, count=1)):
+        with pytest.raises(SimulatedPreemption):
+            ev.run(batches)
+
+
+# ------------------------------------------------------- kill-and-resume parity
+
+
+@pytest.mark.parametrize("label,make_metric,make_batches", REGIMES, ids=[r[0] for r in REGIMES])
+def test_kill_and_resume_parity(tmp_path, label, make_metric, make_batches):
+    """The acceptance headline, deterministic tier-1 variant: killed at a
+    fixed batch and resumed from the store, every state regime reproduces the
+    uninterrupted run — the deterministic replay makes even the sketch
+    BITWISE identical, which is strictly inside its own error bound."""
+    batches = make_batches()
+    store = CheckpointStore(str(tmp_path / label), keep_last=2)
+    _kill_at(StreamingEvaluator(make_metric(), store=store, snapshot_every_n=2), batches, kill_after=4)
+    assert store.last_step() == 4, "snapshot for the batch the process died on must not exist"
+
+    resumed = StreamingEvaluator(make_metric(), store=store, snapshot_every_n=2)
+    result = resumed.resume(batches)
+    unbroken = _uninterrupted(make_metric, batches)
+    _assert_state_parity(resumed.metric, unbroken, label)
+    np.testing.assert_array_equal(np.asarray(result), np.asarray(unbroken.compute()), err_msg=label)
+
+
+def test_kill_between_snapshots_replays_lost_batches(tmp_path):
+    """Death strikes between snapshots: batches applied after the last
+    snapshot are lost with the process and REPLAYED on resume — exactly-once
+    relative to the restored cursor, no batch double-counted or skipped."""
+    batches = _cls_batches()
+    store = CheckpointStore(str(tmp_path / "s"), keep_last=None)
+    make = lambda: MulticlassAccuracy(num_classes=5)
+    _kill_at(StreamingEvaluator(make(), store=store, snapshot_every_n=3), batches, kill_after=4)
+    assert store.steps() == [3], "only the every-3 snapshot should exist"
+
+    resumed = StreamingEvaluator(make(), store=store, snapshot_every_n=3)
+    resumed.resume(batches)
+    assert resumed.cursor == N_BATCHES
+    unbroken = _uninterrupted(make, batches)
+    _assert_state_parity(resumed.metric, unbroken, "replay")
+    # the update count proves exactly-once: 3 restored + 5 replayed = 8
+    assert resumed.metric._update_count == N_BATCHES
+
+
+def test_resume_on_empty_store_runs_from_scratch(tmp_path):
+    batches = _cls_batches()
+    store = CheckpointStore(str(tmp_path / "s"))
+    ev = StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store, snapshot_every_n=4)
+    result = ev.resume(batches)  # nothing to restore: starts at batch 0
+    unbroken = _uninterrupted(lambda: MulticlassAccuracy(num_classes=5), batches)
+    np.testing.assert_array_equal(np.asarray(result), np.asarray(unbroken.compute()))
+    # a completed pass leaves a final snapshot at the stream end
+    assert store.last_step() == N_BATCHES
+
+
+def test_run_refuses_dirty_store(tmp_path):
+    batches = _cls_batches()
+    store = CheckpointStore(str(tmp_path / "s"))
+    StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store, snapshot_every_n=4).run(batches)
+    with pytest.raises(ValueError, match="use resume"):
+        StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store).run(batches)
+
+
+def test_resume_with_short_stream_raises(tmp_path):
+    batches = _cls_batches()
+    store = CheckpointStore(str(tmp_path / "s"))
+    _kill_at(StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store, snapshot_every_n=2), batches, 4)
+    ev = StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store)
+    with pytest.raises(ValueError, match="cannot fast-forward"):
+        ev.resume(batches[:2])  # stream shorter than the snapshot cursor
+
+
+def test_torn_write_mid_run_falls_back_one_snapshot(tmp_path):
+    """A preemption DURING a snapshot save (between temp and rename) loses
+    that snapshot but not the store: resume restores the previous one and
+    still converges to parity."""
+    batches = _cls_batches()
+    store = CheckpointStore(str(tmp_path / "s"), keep_last=None)
+    make = lambda: MulticlassAccuracy(num_classes=5)
+    with faults.inject(faults.Fault("fail", "store.write.torn", after=1, count=1)):
+        with pytest.raises(faults.FaultInjected):
+            # snapshot at step 2 lands, the one at step 4 tears
+            StreamingEvaluator(make(), store=store, snapshot_every_n=2).run(batches)
+    assert store.steps() == [2]
+    from torchmetrics_tpu.robustness import store_format as fmt
+
+    assert fmt.temp_files(store.directory), "torn save left no temp file"
+    resumed = StreamingEvaluator(make(), store=store, snapshot_every_n=2)
+    resumed.resume(batches)
+    _assert_state_parity(resumed.metric, _uninterrupted(make, batches), "torn")
+
+
+def test_bitrot_mid_run_falls_back_one_snapshot(tmp_path):
+    """At-rest corruption of the newest snapshot: latest() skips it with one
+    named warning and resumes from the older valid one — parity holds."""
+    batches = _cls_batches()
+    store = CheckpointStore(str(tmp_path / "s"), keep_last=None)
+    make = lambda: MulticlassAccuracy(num_classes=5)
+    with faults.inject(faults.Fault("corrupt", "store.payload", after=1, arg=64)):
+        _kill_at(StreamingEvaluator(make(), store=store, snapshot_every_n=2), batches, 4)
+    assert store.steps() == [2, 4]  # step 4's bytes rotted on disk
+    resumed = StreamingEvaluator(make(), store=store, snapshot_every_n=2)
+    with pytest.warns(CheckpointStoreWarning, match="step 4"):
+        resumed.resume(batches)
+    _assert_state_parity(resumed.metric, _uninterrupted(make, batches), "bitrot")
+
+
+# ----------------------------------------------------------- MetricCollection
+
+
+def test_collection_kill_and_resume(tmp_path):
+    batches = _bin_batches()
+    make = lambda: MetricCollection({"ap": BinaryAveragePrecision(), "acc": BinaryAccuracy()})
+    store = CheckpointStore(str(tmp_path / "coll"), keep_last=2)
+    _kill_at(StreamingEvaluator(make(), store=store, snapshot_every_n=2), batches, kill_after=3)
+
+    resumed = StreamingEvaluator(make(), store=store, snapshot_every_n=2)
+    result = resumed.resume(batches)
+    unbroken = make()
+    for p, t in batches:
+        unbroken.update(p, t)
+    want = unbroken.compute()
+    assert set(result) == set(want)
+    for key in want:
+        np.testing.assert_array_equal(np.asarray(result[key]), np.asarray(want[key]), err_msg=key)
+
+
+def test_collection_restore_never_half_applies():
+    """A member checkpoint failing AFTER an earlier member applied must roll
+    the whole group back — the collection analogue of the PR-2
+    validate-ALL-then-apply contract."""
+    import copy
+
+    make = lambda: MetricCollection({"a_acc": BinaryAccuracy(), "b_ap": BinaryAveragePrecision()})
+    src = make()
+    p, t = _bin_batches(n=1)[0]
+    src.update(p, t)
+    checkpoint = StreamingEvaluator(src)._checkpoint()
+
+    fresh = make()
+    ev = StreamingEvaluator(fresh)
+    names = [n for n, _ in fresh.items(keep_base=True, copy_state=False)]
+    bad = copy.deepcopy(checkpoint)
+    del bad[names[-1]]["metrics"][""]["state"]  # last member's payload malformed
+    with pytest.raises(StateRestoreError):
+        ev._restore_checkpoint(bad)
+    # the earlier member(s) applied then rolled back: nothing half-restored
+    for _, member in fresh.items(keep_base=True, copy_state=False):
+        assert member._update_count == 0
+    # and the intact checkpoint still restores the whole group
+    ev._restore_checkpoint(checkpoint)
+    for _, member in fresh.items(keep_base=True, copy_state=False):
+        assert member._update_count == 1
+
+
+def test_collection_member_drift_raises_named_error(tmp_path):
+    """The runner pins the (collection-wide) registry fingerprint into the
+    manifest, so resuming a renamed/reshaped collection in a new process is
+    refused with a NAMED StateRestoreError at the store door — drift never
+    silently restarts the evaluation."""
+    batches = _bin_batches(n=4)
+    directory = str(tmp_path / "coll")
+    make = lambda: MetricCollection({"ap": BinaryAveragePrecision()})
+    _kill_at(
+        StreamingEvaluator(make(), store=CheckpointStore(directory), snapshot_every_n=2), batches, kill_after=2
+    )
+    renamed = MetricCollection({"average_precision": BinaryAveragePrecision()})
+    ev = StreamingEvaluator(renamed, store=CheckpointStore(directory))  # fresh process, fresh store handle
+    with pytest.raises(StateRestoreError, match="fingerprint"):
+        ev.resume(batches)
+    assert renamed["average_precision"]._update_count == 0  # nothing half-restored, nothing replayed
+
+
+# ------------------------------------------------------------------- watchdog
+
+
+class _StallOnce(MulticlassAccuracy):
+    """Second update blocks past any reasonable deadline (while ``armed``)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._calls = 0
+        self.armed = True
+
+    def update(self, *args, **kwargs):
+        self._calls += 1
+        if self.armed and self._calls == 2:
+            time.sleep(30)
+        super().update(*args, **kwargs)
+
+
+def test_watchdog_raise(tmp_path):
+    batches = _cls_batches(n=4)
+    ev = StreamingEvaluator(_StallOnce(num_classes=5), watchdog_timeout_s=0.3, on_stall="raise")
+    t0 = time.monotonic()
+    with pytest.raises(StallError, match="exceeded the 0.3s watchdog"):
+        ev.run(batches)
+    assert time.monotonic() - t0 < 10.0
+    assert ev.cursor == 1  # the stalled batch never counted
+
+
+def test_watchdog_snapshot_then_raise(tmp_path):
+    """The stall snapshot persists the LAST-GOOD state (pre-stall cursor), so
+    a supervisor can kill this process and resume without losing batch 1."""
+    batches = _cls_batches(n=4)
+    store = CheckpointStore(str(tmp_path / "s"))
+    ev = StreamingEvaluator(
+        _StallOnce(num_classes=5), store=store, watchdog_timeout_s=0.3, on_stall="snapshot_then_raise"
+    )
+    with pytest.raises(StallError, match="last-good state saved at step 1"):
+        ev.run(batches)
+    fresh = _StallOnce(num_classes=5)  # same class: the spec fingerprint must match
+    fresh.armed = False
+    with warnings.catch_warnings():
+        warnings.filterwarnings("error", category=CheckpointStoreWarning)  # restore, not restart
+        resumed = StreamingEvaluator(fresh, store=store)
+        resumed.resume(batches)
+    assert fresh._update_count == len(batches)  # 1 restored + 3 replayed
+    unbroken = _uninterrupted(lambda: MulticlassAccuracy(num_classes=5), batches)
+    got, want = fresh.state_tree(include_count=True), unbroken.state_tree(include_count=True)
+    for key in want:
+        np.testing.assert_array_equal(np.asarray(got[key]), np.asarray(want[key]), err_msg=key)
+
+
+def test_invalid_configuration_rejected(tmp_path):
+    metric = MulticlassAccuracy(num_classes=5)
+    with pytest.raises(ValueError, match="snapshot_every_n"):
+        StreamingEvaluator(metric, snapshot_every_n=0)
+    with pytest.raises(ValueError, match="snapshot_every_s"):
+        StreamingEvaluator(metric, snapshot_every_s=0.0)
+    with pytest.raises(ValueError, match="on_stall"):
+        StreamingEvaluator(metric, on_stall="retry")
+    with pytest.raises(ValueError, match="watchdog_timeout_s"):
+        StreamingEvaluator(metric, watchdog_timeout_s=0)  # 0 would silently disable
+    with pytest.raises(ValueError, match="CheckpointStore"):
+        StreamingEvaluator(metric, store=str(tmp_path))
+    with pytest.raises(ValueError, match="keep_last"):
+        CheckpointStore(str(tmp_path), keep_last=0)
+
+
+def test_time_policy_snapshots(tmp_path):
+    """snapshot_every_s triggers on wall clock; combined with every_n as OR."""
+    batches = _cls_batches(n=6)
+    store = CheckpointStore(str(tmp_path / "s"), keep_last=None)
+
+    def slow_update(metric, batch):
+        time.sleep(0.05)
+        metric.update(*batch)
+
+    ev = StreamingEvaluator(
+        MulticlassAccuracy(num_classes=5), store=store, snapshot_every_s=0.01, update_fn=slow_update
+    )
+    ev.run(batches)
+    # every batch outlasts the period, so every batch snapshots
+    assert store.steps() == list(range(1, 7))
+
+
+def test_custom_update_fn_sharded_step(tmp_path):
+    """update_fn carries the sharded regime: kill-and-resume over
+    ``sharded_update`` steps reproduces the uninterrupted sharded run."""
+    import jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu.parallel import sharded_update
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    n_dev = len(jax.devices())
+    rng = np.random.RandomState(9)
+    batches = [
+        (jnp.asarray(rng.randint(0, 5, 8 * n_dev)), jnp.asarray(rng.randint(0, 5, 8 * n_dev)))
+        for _ in range(6)
+    ]
+    step = lambda metric, batch: sharded_update(metric, mesh, *batch)
+    make = lambda: MulticlassAccuracy(num_classes=5)
+
+    store = CheckpointStore(str(tmp_path / "sh"), keep_last=2)
+    _kill_at(
+        StreamingEvaluator(make(), store=store, snapshot_every_n=2, update_fn=step), batches, kill_after=3
+    )
+    resumed = StreamingEvaluator(make(), store=store, snapshot_every_n=2, update_fn=step)
+    result = resumed.resume(batches)
+
+    unbroken = make()
+    for batch in batches:
+        sharded_update(unbroken, mesh, *batch)
+    np.testing.assert_array_equal(np.asarray(result), np.asarray(unbroken.compute()))
+
+
+def test_runner_obs_counters(tmp_path):
+    from torchmetrics_tpu import obs
+
+    batches = _cls_batches(n=4)
+    store = CheckpointStore(str(tmp_path / "s"))
+    with obs.tracing():
+        ev = StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store, snapshot_every_n=2)
+        _kill_at(ev, batches, kill_after=2)
+        resumed = StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store, snapshot_every_n=2)
+        resumed.resume(batches)
+        snap = obs.snapshot()
+        spans = [e["name"] for e in obs.get_trace() if e.get("type") == "span"]
+    assert snap["counters"]["runner.resume"] == 1
+    assert snap["counters"]["runner.snapshot"] >= 2
+    assert snap["counters"]["robustness.store.save"] >= 2
+    assert "runner.resume" in spans and "robustness.store.save" in spans
+
+
+# ----------------------------------------------------------------- chaos soak
+
+
+def _chaos_trial(tmp_path, trial, label, make_metric, make_batches, rng):
+    """One randomized kill-resume-verify cycle, optionally with a store fault."""
+    batches = make_batches(seed=100 + trial)
+    store = CheckpointStore(str(tmp_path / f"{label}{trial}"), keep_last=3)
+    kill_after = int(rng.randint(1, len(batches) - 1))
+    every_n = int(rng.randint(1, 4))
+    store_fault = rng.choice(["none", "torn", "bitrot"])
+
+    ev = StreamingEvaluator(make_metric(), store=store, snapshot_every_n=every_n)
+    injected = [faults.Fault("preempt", "runner.preempt", after=kill_after, count=1)]
+    if store_fault == "torn":
+        injected.append(faults.Fault("fail", "store.write.torn", after=1, count=1))
+    elif store_fault == "bitrot":
+        injected.append(faults.Fault("corrupt", "store.payload", after=1, count=1, arg=32))
+    with faults.inject(*injected):
+        with pytest.raises((SimulatedPreemption, faults.FaultInjected)):
+            ev.run(batches)
+
+    resumed = StreamingEvaluator(make_metric(), store=store, snapshot_every_n=every_n)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", CheckpointStoreWarning)  # bitrot fallback warns by design
+        result = resumed.resume(batches)
+    unbroken = _uninterrupted(make_metric, batches)
+    _assert_state_parity(resumed.metric, unbroken, f"{label}-trial{trial}-{store_fault}@{kill_after}")
+    np.testing.assert_array_equal(np.asarray(result), np.asarray(unbroken.compute()))
+
+
+@pytest.mark.parametrize("label,make_metric,make_batches", REGIMES, ids=[r[0] for r in REGIMES])
+def test_chaos_kill_at_random_batch_bounded(tmp_path, label, make_metric, make_batches):
+    """Tier-1 bounded chaos: 2 seeded-random trials per regime (kill batch,
+    snapshot period and store fault all drawn from a pinned rng)."""
+    import zlib
+
+    rng = np.random.RandomState(zlib.crc32(label.encode()))  # stable, unlike hash()
+    for trial in range(2):
+        _chaos_trial(tmp_path, trial, label, make_metric, make_batches, rng)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("label,make_metric,make_batches", REGIMES, ids=[r[0] for r in REGIMES])
+def test_chaos_soak(tmp_path, label, make_metric, make_batches):
+    """The long soak: 12 randomized kill/fault/resume cycles per regime."""
+    rng = np.random.RandomState(1234)
+    for trial in range(12):
+        _chaos_trial(tmp_path, trial, label, make_metric, make_batches, rng)

@@ -1,0 +1,135 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tests for the ``tools/metricdoctor.py`` CLI (ISSUE 5 satellite): verify /
+list / prune a ``CheckpointStore`` directory, and — the contract that makes
+the tool useful on a wedged host — do it WITHOUT importing jax (the same
+poisoned-jax subprocess gate ``metricscope`` passes)."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.robustness import CheckpointStore, faults
+from torchmetrics_tpu.robustness import store_format as fmt
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+CLI_PATH = os.path.join(REPO_ROOT, "tools", "metricdoctor.py")
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location("metricdoctor_cli", CLI_PATH)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture()
+def populated_store(tmp_path):
+    """A real store with three metric snapshots (what an interrupted
+    StreamingEvaluator leaves behind)."""
+    metric = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(0)
+    store = CheckpointStore(str(tmp_path / "store"), keep_last=None)
+    for step in (2, 4, 6):
+        metric.update(rng.randint(0, 5, 32), rng.randint(0, 5, 32))
+        store.save({"cursor": step, "checkpoint": metric.save_checkpoint()}, step=step)
+    return store
+
+
+def test_verify_ok_and_list(populated_store, capsys):
+    cli = _load_cli()
+    assert cli.main(["verify", populated_store.directory]) == 0
+    out = capsys.readouterr().out
+    assert "OK — 3 snapshot(s) verified" in out
+    assert cli.main(["list", populated_store.directory]) == 0
+    out = capsys.readouterr().out
+    assert "3 snapshot(s), newest step 6" in out
+    for step in (2, 4, 6):
+        assert fmt.snapshot_filename(step) in out
+
+
+def test_verify_flags_damage_and_exits_nonzero(populated_store, capsys):
+    cli = _load_cli()
+    # bitrot one snapshot, delete another, add torn-write debris
+    path4 = os.path.join(populated_store.directory, fmt.snapshot_filename(4))
+    data = bytearray(open(path4, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    with open(path4, "wb") as fh:
+        fh.write(bytes(data))
+    os.unlink(os.path.join(populated_store.directory, fmt.snapshot_filename(2)))
+    with open(os.path.join(populated_store.directory, "snapshot-x.ckpt.tmp-dead"), "wb") as fh:
+        fh.write(b"torn")
+    assert cli.main(["verify", populated_store.directory]) == 1
+    out = capsys.readouterr().out
+    assert "CRC32" in out and "deleted snapshot" in out and "torn temp file" in out
+    assert "FAILED — 2 problem(s)" in out
+
+
+def test_prune_keeps_newest_and_clears_debris(populated_store, capsys):
+    cli = _load_cli()
+    with open(os.path.join(populated_store.directory, "snapshot-x.ckpt.tmp-dead"), "wb") as fh:
+        fh.write(b"torn")
+    assert cli.main(["prune", populated_store.directory, "--keep", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "pruned 3 file(s)" in out
+    assert populated_store.steps() == [6]
+    assert fmt.temp_files(populated_store.directory) == []
+    # the surviving snapshot still verifies
+    assert cli.main(["verify", populated_store.directory]) == 0
+
+
+def test_verify_empty_and_broken_manifest(tmp_path, capsys):
+    cli = _load_cli()
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert cli.main(["verify", str(empty)]) == 0  # empty store is healthy
+    assert cli.main(["list", str(empty)]) == 0
+    capsys.readouterr()
+    (empty / fmt.MANIFEST_NAME).write_text("{not json")
+    assert cli.main(["verify", str(empty)]) == 1
+    assert "BROKEN" in capsys.readouterr().out
+
+
+def test_verify_standalone_does_not_import_jax(populated_store, tmp_path):
+    """The CI gate: metricdoctor must verify a store on a machine (or in a
+    shell) that cannot import jax — same pattern as metricscope summary."""
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text("raise ImportError('metricdoctor must not import jax')\n")
+    env = dict(os.environ, PYTHONPATH=str(poison))
+    for argv, needle in (
+        (["verify", populated_store.directory], "OK — 3 snapshot(s) verified"),
+        (["list", populated_store.directory], "newest step 6"),
+    ):
+        result = subprocess.run(
+            [sys.executable, "-c", "import runpy, sys; sys.argv=[sys.argv[1]]+sys.argv[2:];"
+             " runpy.run_path(sys.argv[0], run_name='__main__')", CLI_PATH, *argv],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert result.returncode == 0, result.stderr
+        assert needle in result.stdout
+
+
+def test_store_fault_debris_is_doctorable(tmp_path, capsys):
+    """End-to-end: a torn write plus bitrot leave a store that verify flags,
+    latest() recovers from, and prune repairs."""
+    cli = _load_cli()
+    store = CheckpointStore(str(tmp_path / "store"), keep_last=None)
+    store.save({"step": 1}, step=1)
+    with faults.inject(faults.Fault("corrupt", "store.payload", arg=16)):
+        store.save({"step": 2}, step=2)
+    with faults.inject(faults.Fault("fail", "store.write.torn")):
+        with pytest.raises(faults.FaultInjected):
+            store.save({"step": 3}, step=3)
+    assert cli.main(["verify", store.directory]) == 1
+    out = capsys.readouterr().out
+    assert "CRC32" in out and "torn temp file" in out
+    assert cli.main(["prune", store.directory, "--keep", "1"]) == 0
+    capsys.readouterr()
+    # retention is recency-based: the corrupt newest snapshot survives prune,
+    # verify still flags it — run verify BEFORE pruning a suspect store
+    assert cli.main(["verify", store.directory]) == 1

@@ -333,6 +333,54 @@ def test_fault_env_spec_parsing():
     faults.clear()
 
 
+class MidFaultMetric(Metric):
+    """Two states mutated in sequence with an injection point between them —
+    a fault there is a genuine half-applied update."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("seen", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        self.total = self.total + jnp.sum(x)  # applied ...
+        self.seen.append(x)  # ... an in-place list append too ...
+        faults.fire("update.mid")  # ... then the host dies mid-update
+        self.count = self.count + jnp.asarray(x.size, jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+def test_failed_update_rolls_back_count_and_state():
+    """ISSUE 5 satellite: ``update()`` used to advance ``_update_count``
+    before running the wrapped update, so an exception mid-update left the
+    count claiming an update the (half-applied) state never finished. Count
+    and states now roll back together — the update is transactional."""
+    m = MidFaultMetric()
+    m.update([1.0, 2.0])
+    before = m.state_tree(include_count=True)
+    with faults.inject(faults.Fault("fail", "update.mid")):
+        with pytest.raises(faults.FaultInjected):
+            m.update([10.0, 20.0])
+    after = m.state_tree(include_count=True)
+    assert after["_update_count"] == before["_update_count"] == 1
+    np.testing.assert_array_equal(np.asarray(after["total"]), np.asarray(before["total"]))
+    assert len(after["seen"]) == len(before["seen"]) == 1  # half-applied cat state rolled back
+    # the metric recovers: a later clean update stays in lockstep with a
+    # metric that never saw the failure
+    m.update([3.0, 5.0])
+    clean = MidFaultMetric()
+    clean.update([1.0, 2.0])
+    clean.update([3.0, 5.0])
+    assert float(m.compute()) == float(clean.compute())
+    assert m._update_count == clean._update_count == 2
+
+
 def test_simulated_preemption_checkpoint_drill():
     """Preemption between updates: the in-flight update's contribution is
     lost with the host; restoring the checkpoint and replaying the stream

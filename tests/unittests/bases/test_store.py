@@ -1,0 +1,279 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""``CheckpointStore`` contract tests (ISSUE 5): atomicity, CRC32 integrity,
+monotonic steps, retention, rank-aware writes, and — the point of the whole
+layer — the negative paths: torn writes, bitrot, deleted snapshots, manifest
+damage and metric-definition drift all recover to the newest VALID snapshot
+or raise a named error, never a half-restore."""
+import json
+import os
+import pickle
+import warnings
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.robustness import CheckpointStore, checkpoint_fingerprint, faults
+from torchmetrics_tpu.robustness import store_format as fmt
+from torchmetrics_tpu.utilities.exceptions import CheckpointStoreWarning, StateRestoreError
+
+
+def _store(tmp_path, **kwargs):
+    return CheckpointStore(str(tmp_path / "store"), **kwargs)
+
+
+def _seed(store, n=3):
+    for step in range(1, n + 1):
+        store.save({"step": step, "blob": np.arange(step * 4, dtype=np.float32)}, step=step)
+
+
+@pytest.fixture(autouse=True)
+def _no_store_warnings_leak():
+    # every test asserts its own warnings; anything unasserted should fail loudly
+    with warnings.catch_warnings():
+        warnings.filterwarnings("error", category=CheckpointStoreWarning)
+        yield
+
+
+# ----------------------------------------------------------------- happy path
+
+
+def test_save_latest_roundtrip_and_layout(tmp_path):
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 3)
+    step, payload = store.latest()
+    assert step == 3 and payload["step"] == 3
+    np.testing.assert_array_equal(payload["blob"], np.arange(12, dtype=np.float32))
+    # on-disk layout follows the documented format
+    names = sorted(os.listdir(store.directory))
+    assert names == [fmt.MANIFEST_NAME] + [fmt.snapshot_filename(s) for s in (1, 2, 3)]
+    manifest = fmt.read_manifest(store.directory)
+    assert [e["step"] for e in manifest["snapshots"]] == [1, 2, 3]
+    for entry in manifest["snapshots"]:
+        data = fmt.read_snapshot_bytes(store.directory, entry)  # enforces size+CRC
+        assert pickle.loads(data)["step"] == entry["step"]
+    assert store.verify()["ok"]
+
+
+def test_steps_are_strictly_monotonic(tmp_path):
+    store = _store(tmp_path)
+    store.save({"x": 1}, step=5)
+    with pytest.raises(ValueError, match="strictly monotonic"):
+        store.save({"x": 2}, step=5)
+    with pytest.raises(ValueError, match="strictly monotonic"):
+        store.save({"x": 2}, step=4)
+    store.save({"x": 2}, step=6)
+    assert store.steps() == [5, 6]
+
+
+def test_keep_last_retention_prunes_oldest(tmp_path):
+    store = _store(tmp_path, keep_last=2)
+    _seed(store, 5)
+    assert store.steps() == [4, 5]
+    files = [n for n in os.listdir(store.directory) if n.endswith(fmt.SNAPSHOT_SUFFIX)]
+    assert sorted(files) == [fmt.snapshot_filename(4), fmt.snapshot_filename(5)]
+
+
+def test_empty_store_latest_is_none(tmp_path):
+    store = _store(tmp_path)
+    assert store.latest() is None and store.steps() == [] and store.last_step() is None
+    # a directory that was created but never written to is a valid empty store
+    os.makedirs(store.directory)
+    report = store.verify()
+    assert report["ok"] and "no manifest" in report["problems"][0]
+    # ... but a path that is not a directory at all is a verify failure
+    missing = CheckpointStore(str(tmp_path / "nope")).verify()
+    assert not missing["ok"] and "not a directory" in missing["problems"][0]
+
+
+def test_non_writer_rank_never_touches_disk(tmp_path, monkeypatch):
+    import torchmetrics_tpu.robustness.store as store_mod
+
+    monkeypatch.setattr(store_mod, "_process_index", lambda: 1)
+    store = _store(tmp_path)  # write_rank=0 default
+    assert not store.is_writer
+    assert store.save({"x": 1}, step=1) is None
+    assert store.prune() == []
+    assert not os.path.exists(store.directory)
+    # write_rank=None makes every rank a writer
+    every = CheckpointStore(str(tmp_path / "every"), write_rank=None)
+    assert every.is_writer and every.save({"x": 1}, step=1) is not None
+
+
+# -------------------------------------------------------------- negative paths
+
+
+def test_torn_write_leaves_store_readable(tmp_path):
+    """Crash between temp and rename: the temp file survives, the manifest
+    never references it, and latest() serves the previous snapshot."""
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 2)
+    with faults.inject(faults.Fault("fail", "store.write.torn")):
+        with pytest.raises(faults.FaultInjected):
+            store.save({"step": 3}, step=3)
+    assert fmt.temp_files(store.directory), "torn write left no temp debris"
+    assert not os.path.exists(os.path.join(store.directory, fmt.snapshot_filename(3)))
+    step, payload = store.latest()  # no warning: the manifest is clean
+    assert step == 2 and payload["step"] == 2
+    report = store.verify()
+    assert report["ok"] and report["torn_temp_files"]
+    # prune clears the debris and the store keeps working
+    removed = store.prune()
+    assert any(".tmp-" in n for n in removed)
+    store.save({"step": 3}, step=3)
+    assert store.latest()[0] == 3
+
+
+def test_crc_mismatch_skips_to_newest_valid_with_named_warning(tmp_path):
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 2)
+    with faults.inject(faults.Fault("corrupt", "store.payload", arg=32)):
+        store.save({"step": 3}, step=3)  # manifest records the TRUE crc; disk rots
+    with pytest.warns(CheckpointStoreWarning, match="step 3.*CRC32"):
+        step, payload = store.latest()
+    assert step == 2 and payload["step"] == 2, "fell back past the newest valid snapshot"
+    report = store.verify()
+    assert not report["ok"] and "CRC32" in report["problems"][0]
+
+
+def test_manifest_pointing_at_deleted_snapshot_falls_back(tmp_path):
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 3)
+    os.unlink(os.path.join(store.directory, fmt.snapshot_filename(3)))
+    with pytest.warns(CheckpointStoreWarning, match="step 3.*deleted"):
+        step, _ = store.latest()
+    assert step == 2
+
+
+def test_truncated_snapshot_file_falls_back(tmp_path):
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 2)
+    path = os.path.join(store.directory, fmt.snapshot_filename(2))
+    data = open(path, "rb").read()
+    with open(path, "wb") as fh:
+        fh.write(data[: len(data) // 2])
+    with pytest.warns(CheckpointStoreWarning, match="step 2.*torn or truncated"):
+        step, _ = store.latest()
+    assert step == 1
+
+
+def test_unpicklable_payload_falls_back(tmp_path):
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 2)
+    # bytes whose CRC the manifest endorses but that are not a pickle at all:
+    # rewrite entry 2 end-to-end, the way a buggy external writer would
+    manifest = fmt.read_manifest(store.directory)
+    garbage = b"\x00not a pickle\x00"
+    fmt.atomic_write(os.path.join(store.directory, fmt.snapshot_filename(2)), garbage)
+    manifest["snapshots"][1]["crc32"] = fmt.payload_crc(garbage)
+    manifest["snapshots"][1]["bytes"] = len(garbage)
+    fmt.write_manifest(store.directory, manifest)
+    with pytest.warns(CheckpointStoreWarning, match="step 2.*unpickle"):
+        step, _ = store.latest()
+    assert step == 1
+
+
+def test_all_snapshots_bad_returns_none(tmp_path):
+    store = _store(tmp_path, keep_last=None)
+    _seed(store, 2)
+    for step in (1, 2):
+        os.unlink(os.path.join(store.directory, fmt.snapshot_filename(step)))
+    with pytest.warns(CheckpointStoreWarning):
+        assert store.latest() is None
+
+
+def test_malformed_manifest_is_a_hard_error(tmp_path):
+    store = _store(tmp_path)
+    _seed(store, 1)
+    with open(os.path.join(store.directory, fmt.MANIFEST_NAME), "w") as fh:
+        fh.write("{not json")
+    with pytest.raises(fmt.StoreFormatError, match="unreadable"):
+        store.latest()
+    report = store.verify()
+    assert not report["ok"] and not report["manifest_ok"]
+
+
+def test_future_store_format_version_refused(tmp_path):
+    store = _store(tmp_path)
+    _seed(store, 1)
+    path = os.path.join(store.directory, fmt.MANIFEST_NAME)
+    manifest = json.load(open(path))
+    manifest["store_format_version"] = 99
+    with open(path, "w") as fh:
+        json.dump(manifest, fh)
+    with pytest.raises(fmt.StoreFormatError, match="version 99"):
+        store.latest()
+
+
+def test_fingerprint_drift_raises_named_error(tmp_path):
+    """A store written under one metric definition refuses a differently-
+    configured metric — both at the manifest level (pinned fingerprint) and
+    at payload validation (load_checkpoint's spec fingerprint)."""
+    src = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(0)
+    src.update(rng.randint(0, 5, 64), rng.randint(0, 5, 64))
+    directory = str(tmp_path / "store")
+    store = CheckpointStore(directory, fingerprint=checkpoint_fingerprint(src))
+    store.save({"checkpoint": src.save_checkpoint()}, step=1)
+
+    # manifest-level: a store opened with the drifted fingerprint refuses
+    drifted = MulticlassAccuracy(num_classes=7)
+    reopened = CheckpointStore(directory, fingerprint=checkpoint_fingerprint(drifted))
+    with pytest.raises(StateRestoreError, match="fingerprint"):
+        reopened.latest()
+    with pytest.raises(StateRestoreError, match="fingerprint"):
+        reopened.save({"x": 1}, step=2)
+
+    # payload-level: even without a pinned fingerprint, validation rejects the
+    # payload and the drifted metric is left untouched (validate-then-apply)
+    unpinned = CheckpointStore(directory)
+
+    def validate(payload):
+        drifted.load_checkpoint(payload["checkpoint"])
+
+    with pytest.warns(CheckpointStoreWarning, match="fails validation"):
+        assert unpinned.latest(validate=validate) is None
+    assert drifted._update_count == 0
+
+    # the matching metric restores cleanly through the same ladder
+    fresh = MulticlassAccuracy(num_classes=5)
+    step, payload = unpinned.latest(validate=lambda p: fresh.load_checkpoint(p["checkpoint"]))
+    assert step == 1 and fresh._update_count == src._update_count
+    assert float(fresh.compute()) == float(src.compute())
+
+
+def test_latest_validation_ladder_falls_back_to_older_schema_match(tmp_path):
+    """A newer snapshot whose payload fails semantic validation (truncated
+    checkpoint dict) is skipped in favour of an older one that passes — the
+    recovery ladder applies the PR-2 validate-ALL-then-apply contract at
+    every rung, so nothing is ever half-restored."""
+    src = MulticlassAccuracy(num_classes=5)
+    rng = np.random.RandomState(1)
+    src.update(rng.randint(0, 5, 32), rng.randint(0, 5, 32))
+    good = src.save_checkpoint()
+    src.update(rng.randint(0, 5, 32), rng.randint(0, 5, 32))
+    truncated = src.save_checkpoint()
+    del truncated["metrics"][""]["state"]
+
+    store = _store(tmp_path, keep_last=None)
+    store.save({"checkpoint": good}, step=1)
+    store.save({"checkpoint": truncated}, step=2)
+
+    fresh = MulticlassAccuracy(num_classes=5)
+    with pytest.warns(CheckpointStoreWarning, match="step 2.*fails validation"):
+        step, _ = store.latest(validate=lambda p: fresh.load_checkpoint(p["checkpoint"]))
+    assert step == 1 and fresh._update_count == 1
+
+
+def test_snapshot_bytes_gauge_and_counters(tmp_path):
+    from torchmetrics_tpu import obs
+
+    store = _store(tmp_path)
+    with obs.tracing():
+        store.save({"blob": np.zeros(128, np.float32)}, step=1)
+        store.latest()
+        snap = obs.snapshot()
+    assert snap["counters"]["robustness.store.save"] == 1
+    assert snap["counters"]["robustness.store.load"] == 1
+    assert snap["gauges"]["robustness.store.snapshot_bytes"] > 128 * 4

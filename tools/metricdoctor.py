@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metricdoctor CLI — inspect, verify and prune CheckpointStore directories.
+
+Usage::
+
+    python tools/metricdoctor.py verify /ckpts/eval-run-7
+    python tools/metricdoctor.py list   /ckpts/eval-run-7
+    python tools/metricdoctor.py prune  /ckpts/eval-run-7 --keep 2
+
+``verify`` replays the store's own recovery checks offline — manifest parse,
+per-snapshot size + CRC32, torn-write debris — and exits non-zero when any
+manifest-listed snapshot is damaged, so a supervisor can gate a resume on it.
+``list`` prints the snapshot table (step, file, bytes, integrity). ``prune``
+applies ``keep_last`` retention and clears torn temp files.
+
+Like ``tools/metricscope.py``, this tool NEVER imports jax (or the metric
+library): it loads the stdlib-only format module
+``torchmetrics_tpu/robustness/store_format.py`` directly from its file, so a
+checkpoint directory can be doctored from any Python on the box — including
+while the evaluation job itself is wedged.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_store_format():
+    """Import the store-format module WITHOUT importing ``torchmetrics_tpu``
+    (whose __init__ pulls in jax and all 200+ metric modules)."""
+    if "torchmetrics_tpu" in sys.modules:  # already paid elsewhere — reuse
+        from torchmetrics_tpu.robustness import store_format
+
+        return store_format
+    path = os.path.join(_REPO_ROOT, "torchmetrics_tpu", "robustness", "store_format.py")
+    spec = importlib.util.spec_from_file_location("metricdoctor_store_format", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["metricdoctor_store_format"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _human_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n}B"
+
+
+def _cmd_verify(args) -> int:
+    fmt = _load_store_format()
+    report = fmt.verify_store(args.store)
+    print(f"store: {args.store}")
+    print(f"manifest: {'ok' if report['manifest_ok'] else 'BROKEN'}"
+          + (f" (fingerprint {report['fingerprint']})" if report["fingerprint"] else ""))
+    for row in report["snapshots"]:
+        status = "ok" if row["valid"] else f"BAD: {row['problem']}"
+        print(f"  step {row['step']:>8}  {row['file']}  {_human_bytes(row['bytes']):>10}  {status}")
+    for name in report["torn_temp_files"]:
+        print(f"  torn temp file: {name} (crash during save; prune to clear)")
+    if report["ok"]:
+        valid = sum(1 for r in report["snapshots"] if r["valid"])
+        print(f"OK — {valid} snapshot(s) verified")
+        return 0
+    print(f"FAILED — {len(report['problems'])} problem(s):")
+    for problem in report["problems"]:
+        print(f"  - {problem}")
+    return 1
+
+
+def _cmd_list(args) -> int:
+    fmt = _load_store_format()
+    try:
+        manifest = fmt.read_manifest(args.store)
+    except fmt.StoreFormatError as err:
+        print(f"ERROR: {err}")
+        return 1
+    if manifest is None:
+        print(f"{args.store}: no manifest.json (empty store)")
+        return 0
+    print(f"{'step':>12}  {'bytes':>10}  {'crc32':>10}  file")
+    for entry in manifest["snapshots"]:
+        print(f"{entry['step']:>12}  {_human_bytes(int(entry['bytes'])):>10}"
+              f"  {int(entry['crc32']):>10}  {entry['file']}")
+    newest = manifest["snapshots"][-1]["step"] if manifest["snapshots"] else None
+    print(f"{len(manifest['snapshots'])} snapshot(s)"
+          + (f", newest step {newest}" if newest is not None else "")
+          + (f", fingerprint {manifest['fingerprint']}" if manifest["fingerprint"] else ""))
+    return 0
+
+
+def _cmd_prune(args) -> int:
+    fmt = _load_store_format()
+    try:
+        manifest = fmt.read_manifest(args.store)
+    except fmt.StoreFormatError as err:
+        print(f"ERROR: {err}")
+        return 1
+    if manifest is None:
+        print(f"{args.store}: no manifest.json (empty store) — nothing to prune")
+        return 0
+    _, removed = fmt.prune_entries(args.store, manifest, args.keep, drop_temp=True)
+    for name in removed:
+        print(f"removed {name}")
+    print(f"pruned {len(removed)} file(s); keeping the newest {args.keep} snapshot(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="metricdoctor", description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_verify = sub.add_parser("verify", help="manifest + per-snapshot CRC32 integrity check (exit 1 on damage)")
+    p_verify.add_argument("store", help="CheckpointStore directory")
+    p_verify.set_defaults(fn=_cmd_verify)
+
+    p_list = sub.add_parser("list", help="snapshot table from the manifest")
+    p_list.add_argument("store", help="CheckpointStore directory")
+    p_list.set_defaults(fn=_cmd_list)
+
+    p_prune = sub.add_parser("prune", help="apply keep-last retention and clear torn temp files")
+    p_prune.add_argument("store", help="CheckpointStore directory")
+    p_prune.add_argument("--keep", type=int, default=3, help="snapshots to keep (default: 3)")
+    p_prune.set_defaults(fn=_cmd_prune)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:  # list piped into head/less that exited early
+        os._exit(0)

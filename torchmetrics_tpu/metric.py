@@ -343,19 +343,41 @@ class Metric:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             self._computed = None
+            # updates are transactional: an exception mid-update must not
+            # leave the count advanced over half-applied state (a checkpoint
+            # of that pair would silently skew every "mean" reduction), so
+            # count AND states roll back together. The snapshot is O(#states),
+            # NOT O(stream): arrays are immutable (a ref suffices) and list
+            # ("cat") states are append-only by the add_state contract, so a
+            # (ref, len) pair rolls them back by truncation — whether the
+            # update appended in place or replaced the attribute.
+            prior_state = {
+                attr: (v, len(v)) if isinstance(v, list) else v for attr, v in self.state_tree().items()
+            }
             self._update_count += 1
-            # disabled-tracing path: a single module-level flag check — the
-            # span (and its tag dict) is only ever allocated inside the branch
-            if _obs_trace.ENABLED:
-                with _obs_trace.span("metric.update", metric=type(self).__name__, n=self._update_count):
+            try:
+                # disabled-tracing path: a single module-level flag check — the
+                # span (and its tag dict) is only ever allocated inside the branch
+                if _obs_trace.ENABLED:
+                    with _obs_trace.span("metric.update", metric=type(self).__name__, n=self._update_count):
+                        with _trace_annotation(self, "update"):
+                            update(*args, **kwargs)
+                else:
                     with _trace_annotation(self, "update"):
                         update(*args, **kwargs)
-            else:
-                with _trace_annotation(self, "update"):
-                    update(*args, **kwargs)
+            except Exception:
+                self._update_count -= 1
+                for attr, prior in prior_state.items():
+                    if isinstance(prior, tuple):
+                        lst, length = prior
+                        del lst[length:]  # undo in-place appends; no-op if replaced
+                        setattr(self, attr, lst)
+                    else:
+                        setattr(self, attr, prior)
+                raise
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
-            if faults._ACTIVE:  # simulated preemption between updates (checkpoint drills)
+            if faults._ACTIVE:  # simulated preemption between COMPLETED updates (checkpoint drills)
                 faults.fire("update.preempt")
 
         return wrapped_func

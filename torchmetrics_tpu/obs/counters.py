@@ -24,6 +24,17 @@ name                                incremented when
 ``sketch.merge`` (+ ``.<Class>``)   a host-side pairwise sketch-state merge ran
                                     (cross-rank "merge" sync, forward fold);
                                     traced merges are excluded, not undercounted
+``robustness.store.save``/``.load`` a ``CheckpointStore`` snapshot was persisted /
+                                    a ``latest()`` recovery walk ran (the
+                                    ``robustness.store.snapshot_bytes`` gauge
+                                    tracks the newest snapshot's on-disk size)
+``robustness.store.recovery_skipped``  ``latest()`` skipped a torn/corrupt/invalid
+                                    snapshot and fell back to an older one
+``runner.snapshot``                 a ``StreamingEvaluator`` snapshot was written
+``runner.resume``                   a ``StreamingEvaluator.resume()`` restored (or
+                                    started fresh from an empty store)
+``runner.watchdog_stall``           an update/compute outlived the watchdog
+                                    deadline and raised ``StallError``
 ==================================  ==============================================
 
 Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the

@@ -14,6 +14,17 @@ Three fronts (ARCHITECTURE.md §9):
   (timeout/retries/backoff/degrade-to-local) threaded through
   ``Metric.sync()``, plus the deterministic fault-injection harness the
   tests drive it with.
+
+And the durability layer on top (ARCHITECTURE.md §12):
+
+- :mod:`~torchmetrics_tpu.robustness.store` — :class:`CheckpointStore`:
+  atomic (temp + fsync + ``os.replace``), CRC32-verified, retention-pruned,
+  rank-aware snapshot directory with a torn/corrupt-skipping ``latest()``
+  recovery ladder (inspect offline with ``tools/metricdoctor.py``).
+- :mod:`~torchmetrics_tpu.robustness.runner` — :class:`StreamingEvaluator`:
+  preemption-safe evaluation over a batch stream with an exactly-once batch
+  cursor, snapshot-every-N/T policies, ``resume()`` fast-forward, and a
+  stall watchdog.
 """
 from torchmetrics_tpu.robustness import faults
 from torchmetrics_tpu.robustness.checkpoint import (
@@ -22,13 +33,17 @@ from torchmetrics_tpu.robustness.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from torchmetrics_tpu.robustness.runner import StreamingEvaluator
 from torchmetrics_tpu.robustness.spec import StateSpec, build_state_specs, spec_fingerprint, validate_state_tree
+from torchmetrics_tpu.robustness.store import CheckpointStore
 from torchmetrics_tpu.robustness.sync_config import DEFAULT_SYNC_CONFIG, SyncConfig
 
 __all__ = [
     "CHECKPOINT_FORMAT_VERSION",
+    "CheckpointStore",
     "DEFAULT_SYNC_CONFIG",
     "StateSpec",
+    "StreamingEvaluator",
     "SyncConfig",
     "build_state_specs",
     "checkpoint_fingerprint",

@@ -6,7 +6,8 @@ Production TPU fleets lose hosts, corrupt DCN payloads, and preempt workers
 mid-epoch; code that only ever runs on the happy path is untested exactly
 where it matters most. This module plants **zero-cost-when-off** injection
 points inside ``Metric.sync()`` / ``utilities/distributed.py`` /
-``Metric.update`` so tests (single-process and the real 2-process
+``Metric.update`` and the durability layer (``CheckpointStore`` /
+``StreamingEvaluator``) so tests (single-process and the real 2-process
 ``jax.distributed`` suite) can rehearse those failures deterministically.
 
 Injection points
@@ -27,6 +28,15 @@ point                      kinds                  fires
                                                   every process so the group agrees on the error)
 ``update.preempt``         preempt                after a completed ``Metric.update`` (raises
                                                   :class:`SimulatedPreemption` — checkpoint/restore drills)
+``runner.preempt``         preempt                in ``StreamingEvaluator`` after batch k is applied,
+                                                  BEFORE its snapshot (``after=k`` kills at batch k+1 —
+                                                  kill-and-resume drills)
+``store.write.torn``       fail, preempt          in ``CheckpointStore.save`` between the temp write
+                                                  and the rename: the temp file survives, the manifest
+                                                  never references it (a torn write)
+``store.payload``          corrupt, truncate      on the snapshot bytes as written to disk; the
+                                                  manifest keeps the TRUE crc, so ``latest()`` detects
+                                                  the bitrot and falls back
 =========================  =====================  ==================================
 
 Faults are scoped with the :func:`inject` context manager (in-process tests)

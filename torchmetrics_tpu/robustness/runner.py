@@ -1,0 +1,385 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Preemption-safe streaming evaluation.
+
+On preemptible TPU fleets a multi-hour evaluation WILL be killed mid-stream;
+without durable progress a death at batch 1.9M restarts from zero.
+:class:`StreamingEvaluator` closes that gap by wrapping a ``Metric``, a
+``MetricCollection``, or a custom (e.g. sharded) update step over a batch
+iterable with:
+
+- an **exactly-once batch cursor**: every snapshot records the number of
+  fully-applied batches; :meth:`resume` fast-forwards the (deterministically
+  re-creatable) stream past exactly that many batches and continues, so no
+  batch is ever double-counted or skipped relative to the restored state —
+  batches applied after the last snapshot die with the process and are
+  simply replayed.
+- a **snapshot policy**: every N batches and/or every T seconds, the metric's
+  deep self-validating checkpoint (PR 2) plus the cursor is persisted through
+  a :class:`~torchmetrics_tpu.robustness.store.CheckpointStore` (atomic,
+  CRC'd, retention-pruned, rank-aware).
+- a **watchdog**: each update (and the final compute/sync) optionally runs
+  under a wall-clock deadline; a stall raises
+  :class:`~torchmetrics_tpu.utilities.exceptions.StallError` instead of
+  hanging the fleet — ``on_stall="snapshot_then_raise"`` persists the
+  last-good state first so the supervisor can kill and resume.
+
+The update/sync watchdog runs the step on a daemon worker thread (the same
+trade as ``Metric._sync_dist_bounded``): an abandoned step cannot be
+cancelled and its state must be considered poisoned — which is why the stall
+snapshot is taken from the checkpoint captured BEFORE the stalled step, never
+from the live (possibly half-mutated) metric.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, Optional
+
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import trace as _obs_trace
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.robustness.store import CheckpointStore
+from torchmetrics_tpu.utilities.exceptions import StallError, StateRestoreError
+
+__all__ = ["StreamingEvaluator"]
+
+#: payload layout version for the runner's snapshot dict
+RUNNER_PAYLOAD_VERSION = 1
+
+_ON_STALL = ("raise", "snapshot_then_raise")
+
+
+def _default_update(target: Any, batch: Any) -> None:
+    """Positional-splat convention: a tuple batch is ``update(*batch)``,
+    anything else is ``update(batch)`` — matches how eval loops usually zip
+    preds/targets. Pass ``update_fn`` for anything richer (kwargs, sharded
+    steps: ``lambda m, b: sharded_update(m, mesh, *b)``)."""
+    if isinstance(batch, tuple):
+        target.update(*batch)
+    else:
+        target.update(batch)
+
+
+class StreamingEvaluator:
+    """Drive a metric over a batch stream with durable, resumable progress.
+
+    Args:
+        metric: a ``Metric`` or ``MetricCollection`` accumulating the stream.
+        store: the durable :class:`CheckpointStore`; ``None`` runs without
+            durability (the watchdog still works).
+        snapshot_every_n: persist a snapshot after every N applied batches.
+        snapshot_every_s: persist a snapshot when at least T seconds passed
+            since the last one (checked after each batch; combines with
+            ``snapshot_every_n`` as an OR).
+        update_fn: ``update_fn(metric, batch)`` override for the per-batch
+            step (sharded/jitted steps, kwargs batches).
+        watchdog_timeout_s: wall-clock deadline per update and for the final
+            compute/sync; ``None`` disables the watchdog.
+        on_stall: ``"raise"`` surfaces :class:`StallError` immediately;
+            ``"snapshot_then_raise"`` first persists the last-good state
+            (pre-stall cursor) to ``store``.
+
+    One evaluator instance drives one pass: :meth:`run` starts from batch 0
+    (and demands a fresh store), :meth:`resume` restores the newest valid
+    snapshot — or starts from 0 on an empty store, so supervisors can always
+    call ``resume()``.
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        store: Optional[CheckpointStore] = None,
+        snapshot_every_n: Optional[int] = None,
+        snapshot_every_s: Optional[float] = None,
+        update_fn: Optional[Callable[[Any, Any], None]] = None,
+        watchdog_timeout_s: Optional[float] = None,
+        on_stall: str = "raise",
+    ) -> None:
+        if snapshot_every_n is not None and snapshot_every_n < 1:
+            raise ValueError(f"snapshot_every_n must be >= 1, got {snapshot_every_n}")
+        if snapshot_every_s is not None and snapshot_every_s <= 0:
+            raise ValueError(f"snapshot_every_s must be > 0, got {snapshot_every_s}")
+        if watchdog_timeout_s is not None and watchdog_timeout_s <= 0:
+            raise ValueError(f"watchdog_timeout_s must be > 0 (or None to disable), got {watchdog_timeout_s}")
+        if on_stall not in _ON_STALL:
+            raise ValueError(f"on_stall must be one of {_ON_STALL}, got {on_stall!r}")
+        if store is not None and not isinstance(store, CheckpointStore):
+            raise ValueError(f"store must be a CheckpointStore, got {type(store).__name__}")
+        self.metric = metric
+        self.store = store
+        self.snapshot_every_n = snapshot_every_n
+        self.snapshot_every_s = snapshot_every_s
+        self.update_fn = update_fn or _default_update
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.on_stall = on_stall
+        #: number of batches fully applied to the metric state
+        self.cursor = 0
+        self._last_snapshot_t: Optional[float] = None
+        self._last_good_payload: Optional[Dict[str, Any]] = None
+        if store is not None and store.fingerprint is None:
+            # pin the metric's registry fingerprint into the manifest so a
+            # drifted metric definition is refused with a NAMED error at the
+            # store door, before any snapshot is even read
+            store.fingerprint = self._fingerprint()
+
+    # ----------------------------------------------------------- checkpoints
+    def _is_collection(self) -> bool:
+        from torchmetrics_tpu.collections import MetricCollection
+
+        return isinstance(self.metric, MetricCollection)
+
+    def _fingerprint(self) -> str:
+        """PR-2 registry fingerprint of the wrapped target: the metric's deep
+        checkpoint fingerprint, or a digest over every member's for a
+        collection."""
+        from torchmetrics_tpu.robustness.checkpoint import checkpoint_fingerprint
+
+        if self._is_collection():
+            import hashlib
+            import json
+
+            canon = sorted(
+                (name, checkpoint_fingerprint(m))
+                for name, m in self.metric.items(keep_base=True, copy_state=False)
+            )
+            return hashlib.sha256(json.dumps(canon, separators=(",", ":")).encode()).hexdigest()[:16]
+        return checkpoint_fingerprint(self.metric)
+
+    def _checkpoint(self) -> Dict[str, Any]:
+        if self._is_collection():
+            # copy_state=True materializes per-member states out of compute-
+            # group aliasing, so each member checkpoints its own (equal) state
+            return {name: m.save_checkpoint() for name, m in self.metric.items(keep_base=True, copy_state=True)}
+        return self.metric.save_checkpoint()
+
+    def _restore_checkpoint(self, checkpoint: Dict[str, Any]) -> None:
+        if not self._is_collection():
+            self.metric.load_checkpoint(checkpoint)  # validate-ALL-then-apply (PR 2)
+            return
+        live = dict(self.metric.items(keep_base=True, copy_state=False))
+        missing = sorted(set(live) - set(checkpoint))
+        extra = sorted(set(checkpoint) - set(live))
+        if missing or extra:
+            raise StateRestoreError(
+                "snapshot does not match the MetricCollection:"
+                + (f" missing member(s) {missing}" if missing else "")
+                + (f" unexpected member(s) {extra}" if extra else "")
+            )
+        # each member's load_checkpoint is atomic, but a member failing after
+        # an earlier one applied would half-restore the COLLECTION — snapshot
+        # every member first and roll the group back together on any failure
+        prior = [
+            (
+                m,
+                m._copy_state_dict(),
+                m._update_count,
+                {attr: getattr(m, attr) for attr in getattr(m, "_host_counters", ())},
+            )
+            for m in live.values()
+        ]
+        try:
+            for name, member in live.items():
+                member.load_checkpoint(checkpoint[name])
+        except Exception:
+            for member, tree, count, host_counters in prior:
+                member._install_state_tree(tree)  # self-snapshot: trusted
+                member._update_count = count
+                member._computed = None
+                for attr, val in host_counters.items():
+                    setattr(member, attr, val)
+            raise
+
+    def _payload(self) -> Dict[str, Any]:
+        return {
+            "payload_version": RUNNER_PAYLOAD_VERSION,
+            "cursor": self.cursor,
+            "kind": "collection" if self._is_collection() else "metric",
+            "checkpoint": self._checkpoint(),
+        }
+
+    def _validate_payload(self, payload: Dict[str, Any]) -> None:
+        """``CheckpointStore.latest`` hook: raise ``StateRestoreError`` for a
+        payload this evaluator cannot resume from. Restores the metric as a
+        side effect when valid — ``load_checkpoint`` is validate-ALL-then-
+        apply, so a raising payload leaves the metric untouched and the
+        store's recovery ladder moves on to an older snapshot."""
+        missing = [k for k in ("payload_version", "cursor", "checkpoint") if k not in payload]
+        if missing:
+            raise StateRestoreError(f"runner snapshot is missing key(s) {missing} — truncated payload?")
+        version = payload["payload_version"]
+        if not isinstance(version, int) or version < 1 or version > RUNNER_PAYLOAD_VERSION:
+            raise StateRestoreError(
+                f"runner snapshot payload_version {version!r} is not supported"
+                f" (this build reads <= {RUNNER_PAYLOAD_VERSION})"
+            )
+        cursor = payload["cursor"]
+        if not isinstance(cursor, int) or cursor < 0:
+            raise StateRestoreError(f"runner snapshot cursor {cursor!r} is not a non-negative int")
+        kind = "collection" if self._is_collection() else "metric"
+        if payload.get("kind") != kind:
+            raise StateRestoreError(
+                f"runner snapshot was written for a {payload.get('kind')!r} target, this"
+                f" evaluator wraps a {kind!r}"
+            )
+        self._restore_checkpoint(payload["checkpoint"])
+
+    # -------------------------------------------------------------- snapshot
+    def snapshot(self) -> Optional[int]:
+        """Persist the current state + cursor now; returns the step written
+        (the cursor), or ``None`` without a store / on non-writer ranks / when
+        the store already holds this step (idempotent re-snapshot)."""
+        if self.store is None or not self.store.is_writer:
+            return None  # non-writer ranks skip even the host-copy of the payload
+        last = self.store.last_step()
+        if last is not None and self.cursor <= last:
+            return None
+        if self.store.save(self._payload(), step=self.cursor) is None:
+            return None
+        self._last_snapshot_t = time.monotonic()
+        if _obs_trace.ENABLED:
+            _obs_counters.inc("runner.snapshot")
+        return self.cursor
+
+    def _maybe_snapshot(self) -> None:
+        if self.store is None:
+            return
+        due_n = self.snapshot_every_n is not None and self.cursor % self.snapshot_every_n == 0
+        due_s = (
+            self.snapshot_every_s is not None
+            and self._last_snapshot_t is not None
+            and time.monotonic() - self._last_snapshot_t >= self.snapshot_every_s
+        )
+        if due_n or due_s:
+            self.snapshot()
+
+    # -------------------------------------------------------------- watchdog
+    def _bounded(self, fn: Callable[[], Any], what: str) -> Any:
+        """Run ``fn`` under the watchdog deadline (same daemon-thread trade as
+        ``Metric._sync_dist_bounded``: a timed-out step cannot be cancelled
+        and its state is poisoned — the caller must treat a StallError as
+        fatal for this process and resume in a fresh one)."""
+        if not self.watchdog_timeout_s:
+            return fn()
+        box: Dict[str, Any] = {}
+
+        def _worker() -> None:
+            try:
+                box["value"] = fn()
+            except BaseException as err:
+                box["err"] = err
+
+        thread = threading.Thread(target=_worker, daemon=True, name=f"tm-tpu-runner-{what}")
+        thread.start()
+        thread.join(self.watchdog_timeout_s)
+        if thread.is_alive():
+            if _obs_trace.ENABLED:
+                _obs_counters.inc("runner.watchdog_stall")
+                _obs_trace.instant("runner.watchdog_stall", what=what, cursor=self.cursor)
+            saved = None
+            if self.on_stall == "snapshot_then_raise" and self.store is not None:
+                saved = self._stall_snapshot()
+            raise StallError(
+                f"evaluation {what} at batch cursor {self.cursor} exceeded the"
+                f" {self.watchdog_timeout_s}s watchdog deadline"
+                + (f" — last-good state saved at step {saved}" if saved is not None else "")
+                + "; the stalled step cannot be cancelled, resume in a fresh process"
+            )
+        if "err" in box:
+            raise box["err"]
+        return box.get("value")
+
+    def _stall_snapshot(self) -> Optional[int]:
+        """Persist the pre-stall payload captured before the stalled step —
+        NEVER the live metric, which the abandoned worker thread may still be
+        mutating."""
+        if self._last_good_payload is None:
+            return None
+        payload = self._last_good_payload
+        last = self.store.last_step() if self.store.is_writer else None
+        if last is not None and int(payload["cursor"]) <= last:
+            return None  # the periodic policy already persisted this step
+        if self.store.save(payload, step=int(payload["cursor"])) is None:
+            return None
+        return int(payload["cursor"])
+
+    # ------------------------------------------------------------------ run
+    def run(self, batches: Iterable[Any]) -> Any:
+        """Evaluate the stream from batch 0 and return ``compute()``.
+
+        Demands a fresh position: if the store already holds snapshots, this
+        raises (use :meth:`resume`, or point the evaluator at a new
+        directory) — silently re-running from 0 over a dirty store would
+        violate step monotonicity at the first snapshot anyway.
+        """
+        if self.store is not None and self.store.is_writer and self.store.last_step() is not None:
+            raise ValueError(
+                f"store {self.store.directory} already holds snapshots up to step"
+                f" {self.store.last_step()} — use resume() to continue, or a fresh directory"
+            )
+        if _obs_trace.ENABLED:
+            with _obs_trace.span("runner.run", metric=type(self.metric).__name__):
+                return self._drive(batches, skip=0)
+        return self._drive(batches, skip=0)
+
+    def resume(self, batches: Iterable[Any]) -> Any:
+        """Restore the newest valid snapshot, fast-forward ``batches`` past
+        the recorded cursor, evaluate the remainder and return ``compute()``.
+
+        ``batches`` must be the SAME deterministic stream the interrupted run
+        consumed (same order, same content) — the exactly-once guarantee is
+        relative to the stream, and the fast-forward is positional. On an
+        empty (or entirely-invalid) store the evaluation starts from batch 0.
+        """
+        if _obs_trace.ENABLED:
+            with _obs_trace.span("runner.resume", metric=type(self.metric).__name__):
+                return self._resume(batches)
+        return self._resume(batches)
+
+    def _resume(self, batches: Iterable[Any]) -> Any:
+        restored = self.store.latest(validate=self._validate_payload) if self.store is not None else None
+        if restored is None:
+            self.cursor = 0
+        else:
+            step, payload = restored
+            # _validate_payload already installed the checkpoint
+            self.cursor = int(payload["cursor"])
+        if _obs_trace.ENABLED:
+            _obs_counters.inc("runner.resume")
+            _obs_trace.instant("runner.resume", cursor=self.cursor, restored=restored is not None)
+        return self._drive(batches, skip=self.cursor)
+
+    def _drive(self, batches: Iterable[Any], skip: int) -> Any:
+        self.cursor = skip
+        self._last_snapshot_t = time.monotonic()
+        snapshotting_stalls = self.on_stall == "snapshot_then_raise" and self.watchdog_timeout_s
+        stream = iter(batches)
+        skipped = 0
+        while skipped < skip:
+            try:
+                next(stream)
+            except StopIteration:
+                raise ValueError(
+                    f"cannot fast-forward: the stream ended after {skipped} batch(es) but the"
+                    f" snapshot cursor is {skip} — resume() needs the same stream the"
+                    " interrupted run consumed"
+                ) from None
+            skipped += 1
+        for batch in stream:
+            if snapshotting_stalls:
+                # the stall snapshot must pre-date the (possibly half-applied)
+                # stalled update; capture costs one host round-trip per batch
+                # and is only paid when the policy asks for it
+                self._last_good_payload = self._payload()
+            self._bounded(lambda: self.update_fn(self.metric, batch), "update")
+            self.cursor += 1
+            if faults._ACTIVE:  # preemption drill: die after batch k, before its snapshot
+                faults.fire("runner.preempt")
+            self._maybe_snapshot()
+        # final snapshot so a completed pass is restorable/auditable ...
+        self.snapshot()
+        if snapshotting_stalls:
+            self._last_good_payload = self._payload()
+        # ... then compute (which may sync across the process group) under the
+        # same watchdog deadline
+        return self._bounded(self.metric.compute, "compute")
