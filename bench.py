@@ -318,6 +318,7 @@ def main() -> None:
             bench_checkpoint_roundtrip,
             bench_coco_map,
             bench_coco_map_scale,
+            bench_device_telemetry,
             bench_fid50k,
             bench_retrieval_ndcg,
             bench_sketch_quantile,
@@ -333,6 +334,9 @@ def main() -> None:
             # durable-snapshot save+load throughput + on-disk bytes for the
             # three state regimes (ISSUE 5): host+disk only, cheap, runs early
             ("checkpoint_roundtrip", bench_checkpoint_roundtrip, (), 30),
+            # in-graph telemetry cost on the compiled classification step
+            # (ISSUE 6): enabled-vs-disabled ratio rides the record
+            ("device_telemetry_overhead", bench_device_telemetry, (), 60),
             ("fid50k", bench_fid50k, (), 120),
             ("coco_map_scale", bench_coco_map_scale, (), 180),
             # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;

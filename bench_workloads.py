@@ -728,6 +728,80 @@ def bench_fid50k(n_batches: int = FID50K_BATCHES) -> Dict:
     }
 
 
+def bench_device_telemetry(n_batches: int = 8, repeats: int = 3) -> Dict:
+    """``device_telemetry_overhead``: samples/s of the telemetry-ENABLED
+    compiled classification step (ISSUE 6), with the disabled path measured
+    alongside so the BENCH trajectory tracks the in-graph health plane's
+    cost. Workload mirrors the headline suite's dominant member: a binned
+    multiclass AUROC (64 classes, 128 thresholds) streamed through
+    ``make_jit_update`` inside one ``lax.scan``-compiled program. Headline is
+    the ENABLED throughput; ``ratio_vs_disabled`` (enabled time / disabled
+    time) is the number the tier-1 1.3x ratchet guards."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAUROC
+    from torchmetrics_tpu.obs import device as obs_device
+    from torchmetrics_tpu.parallel import make_jit_update
+
+    classes, batch = 64, 65536
+    n_samples = n_batches * batch
+
+    def build(enabled: bool):
+        make = lambda: MulticlassAUROC(
+            num_classes=classes, thresholds=128, distributed_available_fn=lambda: False
+        )
+        if enabled:
+            with obs_device.device_telemetry():
+                step, state0 = make_jit_update(make())
+        else:
+            # force the flag OFF for the baseline build: with
+            # TM_TPU_DEVICE_TELEMETRY=1 exported, both builds would otherwise
+            # carry telemetry and the ratio would measure enabled-vs-enabled
+            prev_on, prev_hist = obs_device.config_token()
+            obs_device.disable()
+            try:
+                step, state0 = make_jit_update(make())
+            finally:
+                if prev_on:
+                    obs_device.enable(prev_hist)
+
+        @jax.jit
+        def run(state, preds, target):
+            def scan_step(s, b):
+                return step(s, *b), None
+
+            out, _ = jax.lax.scan(scan_step, state, (preds, target))
+            return out
+
+        return run, state0
+
+    kp, kt = jax.random.split(jax.random.key(0))
+    preds = jax.random.normal(kp, (n_batches, batch, classes), jnp.float32)
+    target = jax.random.randint(kt, (n_batches, batch), 0, classes, jnp.int32)
+
+    timed: Dict[str, list] = {}
+    for tag, enabled in (("disabled", False), ("enabled", True)):
+        run, state0 = build(enabled)
+        np.asarray(run(state0, preds, target)["_update_count"])  # compile + warm
+        runs = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = run(state0, preds, target)
+            np.asarray(out["_update_count"])  # forced materialization bounds the timing
+            runs.append(n_samples / (time.perf_counter() - t0))
+        timed[tag] = runs
+    disabled_med = sorted(timed["disabled"])[len(timed["disabled"]) // 2]
+    enabled_med = sorted(timed["enabled"])[len(timed["enabled"]) // 2]
+    return {
+        "runs": timed["enabled"],
+        "unit": "samples/s",
+        "baseline": None,
+        "disabled_sps": round(disabled_med, 1),
+        "ratio_vs_disabled": round(disabled_med / enabled_med, 3),
+    }
+
+
 def bench_wer(n_pairs: int = 4096, repeats: int = 3) -> Dict:
     """Sentences/sec of corpus word-error-rate — the text dynamic-programming
     workload. Ours runs the token-interned batch edit distance through the

@@ -35,6 +35,13 @@ deterministic rank-error bound, and a fault-injected structurally-corrupt
 sketch payload raises ``SyncError`` naming the offending rank on BOTH ranks
 (with clean rollback: the metric heals and syncs once the fault clears).
 
+A fifth scenario, ``obs``, exercises the multi-rank observability plane
+(ISSUE 6): each rank traces a replica-synced metric run and exports its own
+JSONL trace (``TM_TPU_TRACE_DIR`` set by the parent) with rank + export-epoch
+anchors; the parent test merges the two files with ``metricscope merge``
+(under a poisoned jax — the CLI must never import it) and asserts one Chrome
+timeline with both ranks' pids and sync spans.
+
 A fourth scenario, ``durable``, exercises preemption-safe evaluation
 (ISSUE 5): on each rank a ``StreamingEvaluator`` accumulates its shard of
 the stream into a per-rank ``CheckpointStore`` (``TM_TPU_STORE_DIR`` set by
@@ -304,6 +311,35 @@ def run_durable_scenario(pid: int, nproc: int) -> None:
     print(f"rank {pid}: all durable kill-and-resume checks passed")
 
 
+def run_obs_scenario(pid: int, nproc: int) -> None:
+    """Per-rank trace recording for the multi-rank merge (ISSUE 6): each rank
+    traces a replica-synced run — so both ranks record ``metric.sync`` spans
+    from REAL cross-process collectives — and writes its own JSONL trace with
+    ``rank`` + export-epoch anchors for ``metricscope merge``."""
+    import os
+
+    import numpy as np
+
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    out_dir = os.environ["TM_TPU_TRACE_DIR"]
+    rng = np.random.RandomState(42)  # identical on both ranks
+    preds = rng.rand(32).astype(np.float32)
+    target = rng.randint(0, 2, 32)
+    lo, hi = (0, 20) if pid == 0 else (20, 32)
+    with obs.tracing():
+        acc = BinaryAccuracy()
+        acc.update(preds[lo:hi], target[lo:hi])
+        got = float(acc.compute())  # auto-syncs across the group
+        assert any(e["name"] == "metric.sync" for e in obs.get_trace()), "no sync span recorded"
+        obs.write_jsonl(os.path.join(out_dir, f"rank{pid}.trace.jsonl"), rank=pid)
+    ref = BinaryAccuracy(distributed_available_fn=lambda: False)
+    ref.update(preds, target)
+    assert abs(got - float(ref.compute())) < 1e-6, f"synced accuracy {got}"
+    print(f"rank {pid}: obs trace written and synced value verified")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -317,6 +353,9 @@ def main() -> None:
         return
     if scenario == "durable":
         run_durable_scenario(pid, nproc)
+        return
+    if scenario == "obs":
+        run_obs_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

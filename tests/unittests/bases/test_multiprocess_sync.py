@@ -13,6 +13,7 @@ values equal single-process results. The worker lives in
 """
 from __future__ import annotations
 
+import json
 import os
 import socket
 import subprocess
@@ -100,6 +101,44 @@ def test_two_process_durable_resume(tmp_path):
     for pid, (p, out) in enumerate(results):
         assert p.returncode == 0, f"rank {pid} failed:\n{out}"
         assert f"rank {pid}: all durable kill-and-resume checks passed" in out, out
+
+
+@pytest.mark.timeout(240)
+def test_two_process_trace_merge(tmp_path):
+    """Multi-rank trace merge end to end (ISSUE 6 acceptance): each rank of a
+    REAL 2-process group records and exports its own trace, then
+    ``metricscope merge`` — run under a poisoned jax, the CLI must never
+    import it — produces ONE Chrome timeline whose pid lanes cover both
+    ranks, each carrying that rank's ``metric.sync`` spans."""
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    results = _run_workers("obs", timeout=180, extra_env={"TM_TPU_TRACE_DIR": str(trace_dir)})
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: obs trace written" in out, out
+
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text("raise ImportError('metricscope merge must not import jax')\n")
+    merged_path = tmp_path / "merged.chrome.json"
+    cli = str(_REPO_ROOT / "tools" / "metricscope.py")
+    result = subprocess.run(
+        [sys.executable, cli, "merge",
+         str(trace_dir / "rank0.trace.jsonl"), str(trace_dir / "rank1.trace.jsonl"),
+         "-o", str(merged_path)],
+        capture_output=True, text=True, timeout=60, env=dict(os.environ, PYTHONPATH=str(poison)),
+    )
+    assert result.returncode == 0, result.stderr
+    merged = json.load(open(merged_path))
+    spans_by_pid = {}
+    for event in merged["traceEvents"]:
+        if event.get("ph") == "X":
+            spans_by_pid.setdefault(event["pid"], set()).add(event["name"])
+    assert set(spans_by_pid) == {0, 1}, f"expected both rank lanes, got {set(spans_by_pid)}"
+    for pid in (0, 1):
+        assert "metric.sync" in spans_by_pid[pid], f"rank {pid} lane lacks its sync span"
+    # the lanes are clock-aligned (both files carried an export epoch)
+    assert "unaligned" not in merged["otherData"]
 
 
 @pytest.mark.timeout(240)

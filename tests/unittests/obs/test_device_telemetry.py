@@ -1,0 +1,371 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Device-plane telemetry tests (ISSUE 6 acceptance gates).
+
+- **Zero-HLO-when-disabled**: with telemetry off, ``make_jit_update``'s
+  lowered program is BYTE-IDENTICAL to a never-instrumented build (the
+  golden step is re-implemented inline here, so an always-on op added to the
+  builder can't hide), and the sharded step's lowering is unchanged too.
+- **Value parity when enabled**: compute results and state trees are bitwise
+  identical with telemetry on vs off for the jitted and sharded paths.
+- **Exact health counts**: a stream with known injected NaN/Inf counts
+  drains gauges reporting exactly those counts.
+- **Enabled-path overhead ratchet**: the telemetry-enabled compiled step
+  stays within 1.3x of the disabled one on a classification workload.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import MeanMetric, SumMetric, obs
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.obs import counters, device, trace
+from torchmetrics_tpu.obs import xla as obs_xla
+from torchmetrics_tpu.parallel import fold_jit_state, make_jit_update, make_sharded_update, sharded_update
+from torchmetrics_tpu.parallel.sharded import _SHARDED_FN_CACHE, _batch_update_state, tree_merge
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    device.disable()
+    trace.disable()
+    trace.clear()
+    counters.clear()
+    obs_xla.clear_records()
+    yield
+    device.disable()
+    trace.disable()
+    trace.clear()
+    counters.clear()
+    obs_xla.clear_records()
+
+
+# ------------------------------------------------------------ HLO parity
+
+
+def _golden_uninstrumented_jit(metric):
+    """Inline re-implementation of the pre-telemetry ``make_jit_update``
+    traced program (no list states) — the never-instrumented reference the
+    disabled path must lower byte-identically to."""
+    reductions = dict(metric._reductions)
+    init_state = {k: jnp.asarray(v) for k, v in metric._defaults.items()}
+    init_state["_update_count"] = jnp.asarray(0, jnp.int32)
+
+    def step(state, *batch):
+        state = dict(state)
+        count = state.pop("_update_count")
+        fresh = _batch_update_state(metric, batch, {})
+        array_keys = [k for k in fresh]
+        merged = tree_merge(
+            {k: reductions[k] for k in array_keys},
+            {k: state[k] for k in array_keys},
+            fresh,
+            weight_a=count,
+            weight_b=1,
+        )
+        merged["_update_count"] = count + 1
+        return merged
+
+    return jax.jit(step), init_state
+
+
+def test_disabled_path_hlo_byte_identical_to_uninstrumented_build():
+    batch = (jnp.arange(8.0),)
+    step, state = make_jit_update(SumMetric())
+    off_text = step.lower(state, *batch).as_text()
+
+    golden_step, golden_state = _golden_uninstrumented_jit(SumMetric())
+    golden_text = golden_step.lower(golden_state, *batch).as_text()
+    assert off_text == golden_text, "telemetry-off lowering differs from a never-instrumented build"
+    assert "is_finite" not in off_text
+
+    device.enable()
+    step_on, state_on = make_jit_update(SumMetric())
+    on_text = step_on.lower(state_on, *batch).as_text()
+    assert on_text != off_text
+    assert "is_finite" in on_text  # the telemetry ops exist ONLY behind the flag
+
+
+def test_disabled_sharded_hlo_unchanged():
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    batch = jnp.arange(float(len(jax.devices())))
+
+    def lowered_text():
+        metric = SumMetric()
+        fn = make_sharded_update(metric, mesh)
+        fn(batch)  # builds + caches the per-spec jit
+        (wrapper,) = fn._fn_cache.values()
+        return wrapper.lower(batch).as_text()
+
+    off_text = lowered_text()
+    assert "is_finite" not in off_text
+    assert off_text == lowered_text(), "sharded lowering is not deterministic"
+    device.enable()
+    on_text = lowered_text()
+    assert on_text != off_text and "is_finite" in on_text
+
+
+# ------------------------------------------------------------ value parity
+
+
+def _jit_stream(metric_factory, batches):
+    metric = metric_factory()
+    step, state = make_jit_update(metric)
+    for batch in batches:
+        state = step(state, *batch)
+    fold_jit_state(metric, state)
+    return metric
+
+
+def test_jit_update_value_parity_bitwise():
+    rng = np.random.RandomState(0)
+    batches = [
+        (jnp.asarray(rng.randn(64, 8).astype(np.float32)), jnp.asarray(rng.randint(0, 8, 64)))
+        for _ in range(4)
+    ]
+    factory = lambda: MulticlassAccuracy(num_classes=8, distributed_available_fn=lambda: False)
+    plain = _jit_stream(factory, batches)
+    device.enable(histogram=(32, -5.0, 5.0))
+    told = _jit_stream(factory, batches)
+    assert np.asarray(plain.compute()).tobytes() == np.asarray(told.compute()).tobytes()
+    tree_p = plain.state_tree(include_count=True)
+    tree_t = told.state_tree(include_count=True)
+    assert tree_p.keys() == tree_t.keys()
+    for key in tree_p:
+        assert np.asarray(tree_p[key]).tobytes() == np.asarray(tree_t[key]).tobytes(), key
+
+
+def test_sharded_update_value_parity_bitwise():
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    n_dev = len(jax.devices())
+    rng = np.random.RandomState(1)
+    batches = [jnp.asarray(rng.randn(4 * n_dev).astype(np.float32)) for _ in range(3)]
+
+    def run():
+        metric = MeanMetric(distributed_available_fn=lambda: False)
+        for batch in batches:
+            sharded_update(metric, mesh, batch)
+        return metric
+
+    plain = run()
+    device.enable()
+    told = run()
+    assert np.asarray(plain.compute()).tobytes() == np.asarray(told.compute()).tobytes()
+    for key in plain.state_tree(include_count=True):
+        assert (
+            np.asarray(plain.state_tree(include_count=True)[key]).tobytes()
+            == np.asarray(told.state_tree(include_count=True)[key]).tobytes()
+        ), key
+
+
+def test_telemetry_flag_flip_invalidates_sharded_cache():
+    """The device-telemetry config rides the ``_SHARDED_FN_CACHE`` key: a
+    flip rebuilds instead of serving a step with the wrong instrumentation."""
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    batch = jnp.arange(float(len(jax.devices())))
+    metric = SumMetric(distributed_available_fn=lambda: False)
+    trace.enable()
+    sharded_update(metric, mesh, batch)
+    sharded_update(metric, mesh, batch)
+    assert counters.get("sharded.cache.miss") == 1 and counters.get("sharded.cache.hit") == 1
+    device.enable()
+    sharded_update(metric, mesh, batch)  # config changed -> miss + rebuild
+    assert counters.get("sharded.cache.miss") == 2
+    assert metric._device_telemetry is not None
+    keys = [k for k in _SHARDED_FN_CACHE if k[0] == id(metric)]
+    assert len(keys) == 1, "superseded-config entry was not evicted"
+
+
+# ---------------------------------------------------------- exact health counts
+
+
+def test_drained_gauges_report_exact_nan_inf_counts():
+    device.enable(histogram=(16, -4.0, 4.0))
+    metric = SumMetric(distributed_available_fn=lambda: False)
+    step, state = make_jit_update(metric)
+    rng = np.random.RandomState(2)
+    n_nan, n_inf = 0, 0
+    for i in range(5):
+        batch = rng.randn(32).astype(np.float32)
+        batch[: i + 1] = np.nan
+        n_nan += i + 1
+        if i % 2 == 0:
+            batch[-1] = np.inf if i % 4 == 0 else -np.inf
+            n_inf += 1
+        state = step(state, jnp.asarray(batch))
+    fold_jit_state(metric, state)
+    assert metric._device_telemetry is not None  # pending, not yet drained
+    gauges_before = obs.snapshot()["gauges"]
+    assert "device.SumMetric.nan_count" not in gauges_before  # no per-batch host drain
+    metric.compute()
+    gauges = obs.snapshot()["gauges"]
+    assert gauges["device.SumMetric.nan_count"] == n_nan
+    assert gauges["device.SumMetric.inf_count"] == n_inf
+    assert gauges["device.SumMetric.updates"] == 5
+    assert gauges["device.SumMetric.in0.elems"] == 5 * 32
+    assert np.isfinite(gauges["device.SumMetric.in0.min"])
+    assert metric._device_telemetry is None  # drained exactly once
+
+
+def test_sharded_telemetry_counts_and_sync_boundary_drain():
+    device.enable()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    n_dev = len(jax.devices())
+    metric = MeanMetric(distributed_available_fn=lambda: False)
+    batch = np.ones(2 * n_dev, np.float32)
+    batch[0] = np.nan
+    sharded_update(metric, mesh, jnp.asarray(batch))
+    sharded_update(metric, mesh, jnp.ones(2 * n_dev, jnp.float32))
+    assert metric._device_telemetry is not None
+    metric.sync(distributed_available=lambda: False)  # sync is also a drain boundary
+    gauges = obs.snapshot()["gauges"]
+    assert gauges["device.MeanMetric.nan_count"] == 1
+    assert gauges["device.MeanMetric.in0.elems"] == 4 * n_dev
+    assert metric._device_telemetry is None
+
+
+def test_make_sharded_update_output_stays_clean_with_telemetry():
+    """Telemetry must not leak into the public state pytree: the docstring
+    contract (result is load_state_tree/tree_merge-ready) holds with the
+    flag on — the carry's only exit is the metric's pending accumulator."""
+    device.enable()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    metric = SumMetric(distributed_available_fn=lambda: False)
+    fn = make_sharded_update(metric, mesh)
+    merged = fn(jnp.arange(float(len(jax.devices()))))
+    assert "_telemetry" not in merged
+    fresh = SumMetric(distributed_available_fn=lambda: False)
+    fresh.load_state_tree(merged)  # strict validation passes on a clean tree
+    assert metric._device_telemetry is not None  # telemetry went to the accumulator
+
+
+def test_host_forward_preserves_pending_telemetry():
+    """A host-path forward() (whose internal detour resets the metric) must
+    not drop telemetry accumulated by earlier device steps."""
+    device.enable()
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    n_dev = len(jax.devices())
+    metric = MeanMetric(distributed_available_fn=lambda: False)
+    batch = np.ones(2 * n_dev, np.float32)
+    batch[0] = np.nan
+    sharded_update(metric, mesh, jnp.asarray(batch))
+    assert metric._device_telemetry is not None
+    metric(jnp.asarray([1.0, 2.0]))  # host forward: reset/restore detour inside
+    assert metric._device_telemetry is not None, "forward dropped pending telemetry"
+    metric.compute()
+    assert obs.snapshot()["gauges"]["device.MeanMetric.nan_count"] == 1
+
+
+def test_reset_clears_pending_telemetry():
+    device.enable()
+    metric = SumMetric(distributed_available_fn=lambda: False)
+    step, state = make_jit_update(metric)
+    fold_jit_state(metric, step(state, jnp.arange(4.0)))
+    assert metric._device_telemetry is not None
+    metric.reset()
+    assert metric._device_telemetry is None
+
+
+# --------------------------------------------------------------- unit semantics
+
+
+def test_telemetry_update_and_merge_semantics():
+    state = device.telemetry_init(2)
+    state = device.telemetry_update(state, (jnp.asarray([1.0, np.nan, -3.0]), jnp.asarray([2, 7])))
+    state = device.telemetry_update(state, (jnp.asarray([np.inf, 0.5]),))  # optional 2nd input omitted
+    other = device.telemetry_update(device.telemetry_init(2), (jnp.asarray([-10.0]), jnp.asarray([5])))
+    merged = device.telemetry_merge(state, other)
+    assert np.asarray(merged.nan_count).tolist() == [1, 0]
+    assert np.asarray(merged.inf_count).tolist() == [1, 0]
+    assert np.asarray(merged.elems).tolist() == [6, 3]
+    assert np.asarray(merged.min_val).tolist() == [-10.0, 2.0]
+    assert np.asarray(merged.max_val).tolist() == [1.0, 7.0]
+    assert np.asarray(merged.absmax).tolist() == [10.0, 7.0]
+    assert int(merged.updates) == 3
+
+
+def test_accumulate_across_config_change_drains_instead_of_crashing():
+    """A pending state from a different telemetry config (histogram flipped
+    between builds) cannot merge elementwise: accumulate drains it to gauges
+    and starts the new regime fresh — never a crash, never wrong slots."""
+    metric = SumMetric(distributed_available_fn=lambda: False)
+    with_hist = device.telemetry_update(
+        device.telemetry_init(1, (8, 0.0, 1.0)), (jnp.asarray([0.25, np.nan]),)
+    )
+    without_hist = device.telemetry_update(device.telemetry_init(1), (jnp.asarray([1.0]),))
+    device.accumulate(metric, with_hist, (8, 0.0, 1.0))
+    device.accumulate(metric, without_hist, None)  # config changed mid-stream
+    gauges = obs.snapshot()["gauges"]
+    assert gauges["device.SumMetric.nan_count"] == 1  # the old regime was drained, not lost
+    assert metric._device_telemetry is not None
+    assert int(metric._device_telemetry[0].updates) == 1  # ...and the new one started fresh
+
+    # same bin COUNT but a different range is still a different config: a
+    # shape-level check alone would merge counts across incompatible edges
+    rerange = device.telemetry_update(
+        device.telemetry_init(1, (8, -5.0, 5.0)), (jnp.asarray([2.0]),)
+    )
+    counters.clear()
+    device.accumulate(metric, rerange, (8, -5.0, 5.0))
+    assert obs.snapshot()["gauges"]["device.SumMetric.updates"] == 1  # old regime drained again
+    assert int(metric._device_telemetry[0].updates) == 1
+
+
+def test_device_telemetry_context_restores_config():
+    assert not device.is_enabled()
+    with device.device_telemetry(histogram=(8, 0.0, 1.0)):
+        assert device.is_enabled()
+        assert device.config_token() == (True, (8, 0.0, 1.0))
+    assert not device.is_enabled()
+    assert device.config_token() == (False, None)
+
+
+# ------------------------------------------------------------ overhead ratchet
+
+
+def test_enabled_overhead_ratchet():
+    """Committed enabled-path overhead factor (ISSUE 6 satellite): the
+    telemetry-ENABLED compiled classification-suite step stays within 1.3x
+    of the disabled one (median of 5 interleaved repeats). The workload is a
+    binned-AUROC metric — the threshold-sweep shape that dominates the
+    headline classification suite — so the ratchet guards the path the bench
+    actually runs; the telemetry itself is 4 fused elementwise reductions."""
+    from torchmetrics_tpu.classification import MulticlassAUROC
+
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.randn(8192, 32).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 32, 8192))
+
+    def build(enabled):
+        factory = lambda: MulticlassAUROC(
+            num_classes=32, thresholds=64, distributed_available_fn=lambda: False
+        )
+        if enabled:
+            with device.device_telemetry():
+                return make_jit_update(factory())
+        return make_jit_update(factory())
+
+    step_off, state_off0 = build(False)
+    step_on, state_on0 = build(True)
+
+    def timed(step, state0, n=20):
+        state = state0
+        state = step(state, preds, target)  # warm/compile outside the timed region
+        jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state = step(state, preds, target)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        return time.perf_counter() - t0
+
+    ratios = []
+    for _ in range(5):
+        t_off = timed(step_off, state_off0)
+        t_on = timed(step_on, state_on0)
+        ratios.append(t_on / t_off)
+    median_ratio = sorted(ratios)[2]
+    assert median_ratio < 1.3, f"telemetry-enabled step overhead ratio {median_ratio:.2f} (all: {ratios})"

@@ -15,7 +15,8 @@ import pytest
 from jax.sharding import Mesh
 
 from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric, obs
-from torchmetrics_tpu.obs import counters, trace
+from torchmetrics_tpu.obs import counters, device, trace
+from torchmetrics_tpu.obs import xla as obs_xla
 from torchmetrics_tpu.parallel import sharded_update
 from torchmetrics_tpu.robustness import SyncConfig
 from torchmetrics_tpu.utilities.exceptions import SyncWarning
@@ -25,13 +26,17 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.p
 
 @pytest.fixture(autouse=True)
 def _clean_obs():
+    device.disable()
     trace.disable()
     trace.clear()
     counters.clear()
+    obs_xla.clear_records()
     yield
+    device.disable()
     trace.disable()
     trace.clear()
     counters.clear()
+    obs_xla.clear_records()
 
 
 def _span_names(events):
@@ -90,18 +95,28 @@ def test_traced_smoke_suite():
     assert {"MeanMetric", "SumMetric"} <= update_metrics
 
 
-def _run_grouped_collection(traced: bool):
+def _run_grouped_collection(traced: bool, telemetry: bool = False):
     coll = MetricCollection({"m1": MeanMetric(), "m2": MeanMetric(), "s": SumMetric()})
     batches = [jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([4.0, 5.0]), jnp.asarray([0.5])]
-    if traced:
-        with obs.tracing():
-            for batch in batches:
-                coll.update(batch)
-            out = coll.compute()
-    else:
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    shard_batch = jnp.arange(float(len(jax.devices())))
+
+    def drive():
         for batch in batches:
             coll.update(batch)
-        out = coll.compute()
+        # the device plane rides the compiled sharded step of one member —
+        # telemetry on must not perturb any state bit
+        sharded_update(coll["s"], mesh, shard_batch)
+        return coll.compute()
+
+    if traced and telemetry:
+        with obs.tracing(), device.device_telemetry(histogram=(16, -8.0, 8.0)):
+            out = drive()
+    elif traced:
+        with obs.tracing():
+            out = drive()
+    else:
+        out = drive()
     assert coll.compute_groups and any(len(g) > 1 for g in coll.compute_groups.values())
     states = {
         name: metric.state_tree(include_count=True)
@@ -110,26 +125,43 @@ def _run_grouped_collection(traced: bool):
     return out, states
 
 
+def _assert_bitwise_equal(run_a, run_b):
+    out_a, states_a = run_a
+    out_b, states_b = run_b
+    assert out_a.keys() == out_b.keys()
+    for key in out_a:
+        assert np.asarray(out_a[key]).tobytes() == np.asarray(out_b[key]).tobytes(), key
+    assert states_a.keys() == states_b.keys()
+    for name in states_a:
+        tree_a, tree_b = states_a[name], states_b[name]
+        assert tree_a.keys() == tree_b.keys()
+        for state_key in tree_a:
+            leaf_a, leaf_b = tree_a[state_key], tree_b[state_key]
+            if isinstance(leaf_a, list):
+                assert len(leaf_a) == len(leaf_b)
+                for a, b in zip(leaf_a, leaf_b):
+                    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+            else:
+                assert np.asarray(leaf_a).tobytes() == np.asarray(leaf_b).tobytes(), (name, state_key)
+
+
 def test_instrumented_vs_plain_parity():
     """TM_TPU_TRACE must be observation only: a compute-grouped collection
     produces byte-identical results and identical state trees traced vs not."""
-    out_plain, states_plain = _run_grouped_collection(traced=False)
-    out_traced, states_traced = _run_grouped_collection(traced=True)
-    assert out_plain.keys() == out_traced.keys()
-    for key in out_plain:
-        assert np.asarray(out_plain[key]).tobytes() == np.asarray(out_traced[key]).tobytes(), key
-    assert states_plain.keys() == states_traced.keys()
-    for name in states_plain:
-        tree_p, tree_t = states_plain[name], states_traced[name]
-        assert tree_p.keys() == tree_t.keys()
-        for state_key in tree_p:
-            leaf_p, leaf_t = tree_p[state_key], tree_t[state_key]
-            if isinstance(leaf_p, list):
-                assert len(leaf_p) == len(leaf_t)
-                for a, b in zip(leaf_p, leaf_t):
-                    assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
-            else:
-                assert np.asarray(leaf_p).tobytes() == np.asarray(leaf_t).tobytes(), (name, state_key)
+    _assert_bitwise_equal(_run_grouped_collection(traced=False), _run_grouped_collection(traced=True))
+
+
+def test_telemetry_enabled_vs_plain_parity():
+    """ISSUE 6 acceptance: with tracing AND device telemetry (histogram
+    included) enabled, the compute-grouped collection — including a sharded
+    compiled step — stays bitwise identical to the uninstrumented run, and
+    the telemetry drained real gauges on the side."""
+    plain = _run_grouped_collection(traced=False)
+    telemetered = _run_grouped_collection(traced=True, telemetry=True)
+    _assert_bitwise_equal(plain, telemetered)
+    gauges = obs.snapshot()["gauges"]
+    assert gauges.get("device.SumMetric.nan_count") == 0
+    assert gauges.get("device.SumMetric.updates", 0) >= 1
 
 
 def test_sync_failure_telemetry():

@@ -75,6 +75,79 @@ def test_chrome_conversion(demo_trace, tmp_path, capsys):
     assert chrome["otherData"]["counters"]["sharded.cache.hit"] > 0
 
 
+def test_xla_ranks_compiled_steps(demo_trace, capsys):
+    """ISSUE 6 acceptance: ``metricscope xla`` ranks >= 2 distinct compiled
+    steps from a real run's export, each with compile time + flops/bytes."""
+    cli = _load_cli()
+    assert cli.main(["xla", demo_trace]) == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln and not ln.startswith(("rank", "-", "ranked"))]
+    assert len(lines) >= 2, f"expected >=2 compiled steps:\n{out}"
+    assert "jit_update" in out and "sharded" in out  # two distinct build kinds
+    for line in lines:
+        cells = line.split()
+        compile_ms, mflops, mbytes = float(cells[4]), cells[7], cells[8]
+        assert compile_ms > 0
+        assert mflops != "-" and mbytes != "-", f"cost analysis missing: {line}"
+
+
+def test_demo_trace_carries_device_telemetry_gauges(demo_trace):
+    """The demo records with device telemetry enabled: the exported counter
+    snapshot carries drained device.* gauges for the compiled metrics."""
+    cli = _load_cli()
+    obs = cli._load_obs_module()
+    _events, _ctrs, gauges, _meta = obs.read_jsonl(demo_trace)
+    device_gauges = {k for k in gauges if k.startswith("device.")}
+    assert any(k.endswith(".nan_count") for k in device_gauges), device_gauges
+    assert gauges["obs.trace.ring_high_water"] > 0
+
+
+def _poisoned_env(tmp_path):
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text("raise ImportError('metricscope must not import jax')\n")
+    return dict(os.environ, PYTHONPATH=str(poison))
+
+
+def _write_min_trace(path, extra_events=(), rank=None):
+    meta = {"type": "meta", "dropped": 0, "epoch_ns": 5_000_000, "mono_ns": 1_000_000}
+    if rank is not None:
+        meta["rank"] = rank
+    with open(path, "w") as fh:
+        for event in extra_events:
+            fh.write(json.dumps(event) + "\n")
+        fh.write(json.dumps({"type": "counters", "counters": {}, "gauges": {}}) + "\n")
+        fh.write(json.dumps(meta) + "\n")
+
+
+def test_xla_and_merge_standalone_do_not_import_jax(tmp_path):
+    """The new subcommands keep the metricdoctor/metricscope contract: a
+    poisoned jax on PYTHONPATH crashes any jax import, and both still work."""
+    env = _poisoned_env(tmp_path)
+    compile_span = {
+        "type": "span", "name": "sharded.compile", "ts": 10, "dur": 2_000_000, "tid": 1, "depth": 0,
+        "args": {"xla_key": "abc123", "metric": "SumMetric", "kind": "sharded",
+                 "lower_ms": 1.5, "compile_ms": 2.0, "flops": 1e6, "bytes_accessed": 4e6},
+    }
+    t0 = str(tmp_path / "r0.jsonl")
+    t1 = str(tmp_path / "r1.jsonl")
+    _write_min_trace(t0, [compile_span], rank=0)
+    _write_min_trace(t1, [{"type": "span", "name": "metric.update", "ts": 20, "dur": 1000,
+                           "tid": 1, "depth": 0, "args": None}], rank=1)
+
+    result = subprocess.run([sys.executable, CLI_PATH, "xla", t0],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr
+    assert "SumMetric" in result.stdout and "abc123" in result.stdout
+
+    merged_path = str(tmp_path / "merged.json")
+    result = subprocess.run([sys.executable, CLI_PATH, "merge", t0, t1, "-o", merged_path],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr
+    merged = json.load(open(merged_path))
+    assert {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"} == {0, 1}
+
+
 def test_summary_standalone_does_not_import_jax(tmp_path):
     """The summary/chrome subcommands load obs from its files — a trace can be
     inspected on a machine (or in a shell) without paying the jax import."""

@@ -134,33 +134,61 @@ def test_jsonl_round_trip(tmp_path):
     counters.inc("layer.comp.event", 3)
     counters.set_gauge("layer.comp.level", 1.5)
     path = str(tmp_path / "t.jsonl")
-    export.write_jsonl(path)
+    export.write_jsonl(path, rank=2)
     events, ctrs, gauges, meta = export.read_jsonl(path)
     assert [e["name"] for e in events] == ["a.event", "a.b"] or [e["name"] for e in events] == ["a.b", "a.event"]
     assert ctrs == {"layer.comp.event": 3}
-    assert gauges == {"layer.comp.level": 1.5}
-    # trailing meta line carries drop accounting
-    assert meta == {"dropped": 0}
+    assert gauges["layer.comp.level"] == 1.5
+    # a live export publishes the ring high-water gauge (2 events recorded)
+    assert gauges["obs.trace.ring_high_water"] == 2
+    # trailing meta line carries drop accounting + the merge anchors: wall
+    # epoch, monotonic clock at export, pid and the caller's rank
+    assert meta["dropped"] == 0
+    assert meta["epoch_ns"] > 0 and meta["mono_ns"] > 0
+    assert meta["rank"] == 2
     lines = [json.loads(line) for line in open(path)]
-    assert lines[-1] == {"type": "meta", "dropped": 0}
+    assert lines[-1]["type"] == "meta" and lines[-1]["dropped"] == 0
 
 
 def test_jsonl_surfaces_drops(tmp_path):
+    import warnings
+
     trace.configure(2)
     trace.enable()
     for i in range(5):
         trace.instant(f"e{i}")
     path = str(tmp_path / "drop.jsonl")
-    export.write_jsonl(path)
+    export._drop_warned = False  # the once-per-process latch, reset for the test
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        export.write_jsonl(path)
+        export.write_jsonl(path)  # second export: the warning fired once only
+    warned = [w for w in caught if issubclass(w.category, RuntimeWarning)]
+    assert len(warned) == 1, [str(w.message) for w in caught]
+    assert "dropped 3 span(s)" in str(warned[0].message)
     events, ctrs, gauges, meta = export.read_jsonl(path)
     assert meta["dropped"] == 3
     text = export.summarize(events, ctrs, gauges, dropped=meta["dropped"])
     assert "3 event(s) dropped" in text and "partial" in text
+    assert "ring buffer dropped = 3" in text  # footer restates it after the table
     # an explicitly passed recording does NOT inherit the live buffer's count
     export.write_jsonl(path, events=events, counter_snapshot={"counters": {}, "gauges": {}})
-    assert export.read_jsonl(path)[3] == {"dropped": 0}
+    assert export.read_jsonl(path)[3]["dropped"] == 0
     export.write_jsonl(path, events=events, counter_snapshot={"counters": {}, "gauges": {}}, dropped=7)
-    assert export.read_jsonl(path)[3] == {"dropped": 7}
+    assert export.read_jsonl(path)[3]["dropped"] == 7
+
+
+def test_ring_high_water_tracks_peak_occupancy():
+    trace.configure(4)
+    trace.enable()
+    for i in range(3):
+        trace.instant(f"e{i}")
+    assert trace.high_water() == 3
+    for i in range(5):
+        trace.instant(f"f{i}")
+    assert trace.high_water() == 4  # capped at capacity once the ring filled
+    trace.clear()
+    assert trace.high_water() == 0
 
 
 def test_chrome_trace_format(tmp_path):
@@ -182,6 +210,75 @@ def test_chrome_trace_format(tmp_path):
     path = str(tmp_path / "c.json")
     export.write_chrome_trace(path)
     assert json.load(open(path))["displayTimeUnit"] == "ms"
+
+
+def test_merge_traces_aligns_ranks_by_export_epoch(tmp_path):
+    """Two synthetic per-rank files with different monotonic-clock origins:
+    the merge must place both on one wall-clock timeline (pid = rank), using
+    each file's epoch/mono anchor, and rebase to the earliest event."""
+    from torchmetrics_tpu.obs import merge as obs_merge
+
+    def write_rank(path, rank, epoch_ns, mono_ns, ts):
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"type": "span", "name": f"work.r{rank}", "ts": ts, "dur": 1000,
+                                 "tid": 1, "depth": 0, "args": None}) + "\n")
+            fh.write(json.dumps({"type": "counters", "counters": {}, "gauges": {}}) + "\n")
+            fh.write(json.dumps({"type": "meta", "dropped": 0, "epoch_ns": epoch_ns,
+                                 "mono_ns": mono_ns, "rank": rank}) + "\n")
+
+    # rank 0: event at wall-clock 1_000_000ns; rank 1: same wall instant but a
+    # completely different monotonic origin — alignment must cancel it out
+    p0, p1 = str(tmp_path / "r0.jsonl"), str(tmp_path / "r1.jsonl")
+    write_rank(p0, 0, epoch_ns=10_000_000, mono_ns=9_500_000, ts=500_000)  # wall 1_000_000
+    write_rank(p1, 1, epoch_ns=10_000_000, mono_ns=99_000_000, ts=90_000_000)  # wall 1_000_000
+    merged = obs_merge.merge_traces([p0, p1])
+    spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in spans} == {0, 1}
+    by_pid = {e["pid"]: e for e in spans}
+    # same wall instant -> identical rebased timestamps across ranks
+    assert by_pid[0]["ts"] == by_pid[1]["ts"] == 0.0
+    assert "unaligned" not in merged["otherData"]
+
+    # completion-ordered buffers: the OUTERMOST span starts first but is
+    # recorded last — the rebase must scan all events, never just the first,
+    # so no span lands at a negative timestamp
+    p_nested = str(tmp_path / "nested.jsonl")
+    with open(p_nested, "w") as fh:
+        for name, ts in (("inner", 400_000), ("outer", 100_000)):  # outer recorded second
+            fh.write(json.dumps({"type": "span", "name": name, "ts": ts, "dur": 1000,
+                                 "tid": 1, "depth": 0, "args": None}) + "\n")
+        fh.write(json.dumps({"type": "meta", "dropped": 0, "epoch_ns": 10_000_000,
+                             "mono_ns": 9_000_000, "rank": 0}) + "\n")
+    merged_nested = obs_merge.merge_traces([p_nested])
+    nested_spans = {e["name"]: e["ts"] for e in merged_nested["traceEvents"] if e.get("ph") == "X"}
+    assert nested_spans["outer"] == 0.0 and nested_spans["inner"] == 300.0  # us
+
+    # a file without the epoch anchor is kept but flagged unaligned
+    p2 = str(tmp_path / "old.jsonl")
+    with open(p2, "w") as fh:
+        fh.write(json.dumps({"type": "span", "name": "work.old", "ts": 7, "dur": 5,
+                             "tid": 1, "depth": 0, "args": None}) + "\n")
+        fh.write(json.dumps({"type": "meta", "dropped": 0}) + "\n")
+    merged2 = obs_merge.merge_traces([p0, p2])
+    assert merged2["otherData"]["unaligned"] == [p2]
+
+
+def test_aggregate_reports_duration_percentiles():
+    trace.enable()
+    for dur_us in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):  # one straggler
+        event = {"type": "span", "name": "phase", "ts": 0, "dur": dur_us * 1000,
+                 "tid": 1, "depth": 0, "args": {"metric": "M"}}
+        trace._record(event)
+    (row,) = export.aggregate(trace.get_trace())
+    assert row["count"] == 10
+    assert row["p50_ms"] == pytest.approx(0.001)
+    assert row["max_ms"] == pytest.approx(0.1)
+    assert row["p50_ms"] <= row["p95_ms"] <= row["max_ms"]
+    # the straggler shows in p95/max but not p50 — the reason the table
+    # carries a distribution, not just a mean
+    assert row["mean_ms"] > row["p50_ms"]
+    text = export.summarize(trace.get_trace())
+    assert "p50_ms" in text and "p95_ms" in text
 
 
 def test_summarize_aggregates_per_metric_per_phase():

@@ -7,19 +7,27 @@ Usage::
 
     python tools/metricscope.py summary /tmp/metrics.trace.jsonl
     python tools/metricscope.py chrome /tmp/metrics.trace.jsonl -o /tmp/trace.json
+    python tools/metricscope.py xla /tmp/metrics.trace.jsonl
+    python tools/metricscope.py merge rank0.jsonl rank1.jsonl -o merged.json
     python tools/metricscope.py demo -o /tmp/metrics.trace.jsonl
 
-``summary`` prints the per-metric/per-phase span table (count, total/mean/max
-ms), instant events (sync retries, cache evictions, ...) and the counter
-snapshot embedded in the trace file. ``chrome`` converts the JSON-lines
-recording to Chrome trace format for ``chrome://tracing`` / Perfetto.
-``demo`` records a trace from a small jitted + synced ``MetricCollection``
-run and writes it — a self-contained way to see the whole pipeline.
+``summary`` prints the per-metric/per-phase span table (count, total/mean and
+the p50/p95/max duration distribution in ms), instant events (sync retries,
+cache evictions, ...) and the counter snapshot embedded in the trace file.
+``chrome`` converts the JSON-lines recording to Chrome trace format for
+``chrome://tracing`` / Perfetto. ``xla`` ranks the trace's compiled steps by
+estimated device cost — compile/lowering wall time plus the backend's own
+flops / bytes-accessed analysis, captured at every cold ``make_jit_update``/
+``sharded_update`` build. ``merge`` fuses per-rank trace files into ONE
+Chrome timeline (pid = rank, clocks aligned via each file's export epoch) so
+a multi-process run reads as a single picture. ``demo`` records a trace from
+a small jitted + synced ``MetricCollection`` run and writes it — a
+self-contained way to see the whole pipeline.
 
 Record a trace in your own run with ``TM_TPU_TRACE=1`` (then call
 ``torchmetrics_tpu.obs.write_jsonl(path)``) or the ``obs.tracing()`` context
-manager. ``summary``/``chrome`` load the obs package directly from its files,
-so they never pay the full ``torchmetrics_tpu`` (jax) import.
+manager. All subcommands except ``demo`` load the obs package directly from
+its files, so they never pay the full ``torchmetrics_tpu`` (jax) import.
 """
 from __future__ import annotations
 
@@ -72,20 +80,25 @@ def record_demo_trace(path: str) -> None:
     """Record a trace of a jitted + synced ``MetricCollection`` run to ``path``.
 
     Exercises every instrumented layer: per-metric update/compute/sync spans,
-    compute-group dedup spans, sharded jit-build/compile spans with
-    ``_SHARDED_FN_CACHE`` hit/miss counters, and a checkpoint round-trip.
+    compute-group dedup spans, sharded jit-build spans with
+    ``_SHARDED_FN_CACHE`` hit/miss counters, TWO distinct compiled steps (a
+    sharded update and a ``make_jit_update`` loop) with split
+    lower/compile/first-step spans + xla cost capture for the ``xla``
+    subcommand, in-graph device telemetry gauges, and a checkpoint
+    round-trip.
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric, obs
-    from torchmetrics_tpu.parallel import sharded_update
+    from torchmetrics_tpu.obs import device as obs_device
+    from torchmetrics_tpu.parallel import fold_jit_state, make_jit_update, sharded_update
     from jax.sharding import Mesh
 
     n_dev = len(jax.devices())
     mesh = Mesh(np.array(jax.devices()), ("data",))
-    with obs.tracing():
+    with obs.tracing(), obs_device.device_telemetry():
         collection = MetricCollection({"mean": MeanMetric(), "mean2": MeanMetric(), "sum": SumMetric()})
         sharded = SumMetric()
         for step in range(4):
@@ -94,8 +107,44 @@ def record_demo_trace(path: str) -> None:
             sharded_update(sharded, mesh, batch)  # miss+compile on step 0, hits after
         collection.compute()
         sharded.compute()
+        # a second compiled program: the single-metric jitted streaming loop
+        jit_metric = MeanMetric()
+        jit_step, jit_state = make_jit_update(jit_metric)
+        for step in range(4):
+            jit_state = jit_step(jit_state, jnp.arange(1.0 + step, 5.0 + step))
+        fold_jit_state(jit_metric, jit_state)
+        jit_metric.compute()
         sharded.load_checkpoint(sharded.save_checkpoint())
         obs.write_jsonl(path)
+
+
+def _cmd_xla(args) -> int:
+    obs = _load_obs_module()
+    events, _counters, _gauges, meta = obs.read_jsonl(args.trace)
+    dropped = meta.get("dropped", 0)
+    if dropped:
+        print(f"WARNING: {dropped} event(s) were dropped by the ring buffer — compile records may be missing")
+    print(obs.format_compile_table(obs.compile_rows(events)))
+    return 0
+
+
+def _cmd_merge(args) -> int:
+    obs = _load_obs_module()
+    out = args.output or "merged.chrome.json"
+    merged = obs.write_merged_chrome_trace(out, args.traces)
+    ranks = merged["otherData"]["ranks"]
+    for rank in sorted(ranks, key=lambda r: (0, int(r)) if r.lstrip("-").isdigit() else (1, r)):
+        info = ranks[rank]
+        drop_note = f" (DROPPED {info['dropped']} — partial!)" if info["dropped"] else ""
+        print(f"rank {rank}: {info['events']} events from {info['path']}{drop_note}")
+    if merged["otherData"].get("unaligned"):
+        print(
+            "WARNING: no export epoch in "
+            + ", ".join(merged["otherData"]["unaligned"])
+            + " — those lanes are NOT clock-aligned with the rest (re-export with this build)"
+        )
+    print(f"wrote {out} — one timeline, pid = rank; open in chrome://tracing or https://ui.perfetto.dev")
+    return 0
 
 
 def _cmd_demo(args) -> int:
@@ -119,6 +168,15 @@ def main(argv=None) -> int:
     p_chrome.add_argument("trace", help="JSON-lines trace file (obs.write_jsonl)")
     p_chrome.add_argument("-o", "--output", default=None, help="output path (default: <trace>.chrome.json)")
     p_chrome.set_defaults(fn=_cmd_chrome)
+
+    p_xla = sub.add_parser("xla", help="rank compiled steps by estimated device cost (compile time, flops, bytes)")
+    p_xla.add_argument("trace", help="JSON-lines trace file (obs.write_jsonl)")
+    p_xla.set_defaults(fn=_cmd_xla)
+
+    p_merge = sub.add_parser("merge", help="merge per-rank trace files into one Chrome timeline (pid = rank)")
+    p_merge.add_argument("traces", nargs="+", help="per-rank JSON-lines trace files, rank-0 first")
+    p_merge.add_argument("-o", "--output", default=None, help="output path (default: merged.chrome.json)")
+    p_merge.set_defaults(fn=_cmd_merge)
 
     p_demo = sub.add_parser("demo", help="record a demo trace from a jitted + synced MetricCollection run")
     p_demo.add_argument("-o", "--output", default="/tmp/metrics.trace.jsonl", help="trace file to write")

@@ -30,6 +30,7 @@ import numpy as np
 
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import device as _obs_device
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.sketch.registry import is_sketch_state as _is_sketch_state
 from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
@@ -359,6 +360,12 @@ class MetricCollection(dict):
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Compute/forward every metric and flatten results (reference ``collections.py:323-368``)."""
         self._compute_groups_create_state_ref()
+        # collection compute is a sanctioned device-telemetry sync boundary:
+        # drain every member's pending in-graph telemetry up front so the
+        # device.* gauges are complete even if a later member's compute raises
+        for m in self._base_metrics.values():
+            if m._device_telemetry is not None:
+                _obs_device.drain_metric(m)
         result = {}
         for k, m in self._base_metrics.items():
             if method_name == "compute":

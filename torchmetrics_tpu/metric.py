@@ -44,6 +44,7 @@ from torchmetrics_tpu.utilities.data import (
 )
 from torchmetrics_tpu._reduction_names import VALID_REDUCTION_NAMES
 from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import device as _obs_device
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness import faults
 from torchmetrics_tpu.sketch.registry import is_sketch_state, merge_states, reduce_merge_states
@@ -170,6 +171,11 @@ class Metric:
         self._to_sync = self.sync_on_compute
         self._should_unsync = True
         self._enable_grad = False
+
+        # pending in-graph telemetry (obs/device.py): accumulated as device
+        # arrays by the compiled update paths, drained into device.* gauges
+        # only at compute()/sync() boundaries — never per batch
+        self._device_telemetry: Optional[Any] = None
 
         # sync bookkeeping
         self._is_synced = False
@@ -398,6 +404,11 @@ class Metric:
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if self._device_telemetry is not None:
+                # compute() is THE host-sync boundary: pending in-graph
+                # telemetry becomes device.* gauges here (also on a
+                # cache-served compute — the gauges must not go stale)
+                _obs_device.drain_metric(self)
             if self._update_count == 0:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {self.__class__.__name__} was called before the ``update`` method"
@@ -453,6 +464,7 @@ class Metric:
         immutable makes the snapshot free."""
         self.update(*args, **kwargs)
         _update_count = self._update_count
+        _device_telemetry = self._device_telemetry  # reset() inside the detour must not drop it
         self._to_sync = self.dist_sync_on_step
         _temp_compute_with_cache = self.compute_with_cache
         self.compute_with_cache = False
@@ -466,6 +478,7 @@ class Metric:
         # restore context (self-snapshot: trusted installer, no validation)
         self._install_state_tree(cache)
         self._update_count = _update_count
+        self._device_telemetry = _device_telemetry
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
         self.compute_with_cache = _temp_compute_with_cache
@@ -478,6 +491,7 @@ class Metric:
         batch value on a fresh state, then merge the previous global state in."""
         global_state = self._copy_state_dict()
         _update_count = self._update_count
+        _device_telemetry = self._device_telemetry  # reset() below must not drop pending telemetry
         self.reset()
 
         self._to_sync = self.dist_sync_on_step
@@ -491,6 +505,7 @@ class Metric:
 
         self._reduce_states(global_state)
 
+        self._device_telemetry = _device_telemetry
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
         self.compute_with_cache = _temp_compute_with_cache
@@ -668,6 +683,9 @@ class Metric:
         with ``on_error="local"``, degrade to the local-only state with a
         single :class:`SyncWarning` so best-effort eval logging keeps flowing.
         """
+        if self._device_telemetry is not None:
+            # sync is the other sanctioned host boundary for device telemetry
+            _obs_device.drain_metric(self)
         if _obs_trace.ENABLED:
             with _obs_trace.span("metric.sync", metric=type(self).__name__, n=self._update_count):
                 return self._sync_impl(dist_sync_fn, process_group, should_sync, distributed_available, sync_config)
@@ -788,6 +806,7 @@ class Metric:
     def _reset_impl(self) -> None:
         self._update_count = 0
         self._computed = None
+        self._device_telemetry = None
         for attr, default in self._defaults.items():
             if isinstance(default, list):
                 setattr(self, attr, [])

@@ -22,6 +22,7 @@ paying the full library import.
 """
 from . import counters as _counters_mod
 from . import trace as _trace_mod
+from . import xla as _xla_mod
 from .counters import clear as counter_clear
 from .counters import get as counter_get
 from .counters import inc as counter_inc
@@ -34,29 +35,42 @@ from .export import (
     write_chrome_trace,
     write_jsonl,
 )
+from .merge import merge_traces, write_merged_chrome_trace
 from .trace import (
     configure,
     disable,
     dropped_events,
     enable,
     get_trace,
+    high_water,
     instant,
     is_enabled,
     span,
     tracing,
 )
+from .xla import compile_rows, format_compile_table
+from .xla import records as xla_records
+
+# NOTE: torchmetrics_tpu.obs.device (the in-graph telemetry plane) is NOT
+# imported here — it builds jnp programs and therefore imports jax, while
+# this package's contract is to stay importable standalone (the metricscope
+# CLI loads it without paying the library import). Reach it explicitly:
+# ``from torchmetrics_tpu.obs import device``.
 
 def clear() -> None:
-    """Reset the whole recorder: span ring buffer AND counters/gauges — the
-    manual ``enable()``/``disable()`` flow's analogue of what ``tracing()``
-    clears on entry. Use ``trace.clear()``/``counter_clear()`` for one side."""
+    """Reset the whole recorder: span ring buffer, counters/gauges AND the
+    xla compile-record registry — the manual ``enable()``/``disable()``
+    flow's analogue of what ``tracing()`` clears on entry. Use
+    ``trace.clear()``/``counter_clear()`` for one side."""
     _trace_mod.clear()
     _counters_mod.clear()
+    _xla_mod.clear_records()
 
 
 __all__ = [
     "aggregate",
     "clear",
+    "compile_rows",
     "configure",
     "counter_clear",
     "counter_get",
@@ -64,9 +78,12 @@ __all__ = [
     "disable",
     "dropped_events",
     "enable",
+    "format_compile_table",
     "get_trace",
+    "high_water",
     "instant",
     "is_enabled",
+    "merge_traces",
     "read_jsonl",
     "set_gauge",
     "snapshot",
@@ -76,4 +93,6 @@ __all__ = [
     "tracing",
     "write_chrome_trace",
     "write_jsonl",
+    "write_merged_chrome_trace",
+    "xla_records",
 ]

@@ -35,6 +35,15 @@ name                                incremented when
                                     started fresh from an empty store)
 ``runner.watchdog_stall``           an update/compute outlived the watchdog
                                     deadline and raised ``StallError``
+``xla.compile``                     an AOT compile capture ran (cold compiled
+                                    step under tracing; the ``xla.compile.last_ms``
+                                    gauge keeps the newest compile wall time)
+``device.telemetry.drain``          a pending in-graph telemetry state was
+                                    materialized into ``device.<Metric>.*`` gauges
+                                    at a compute/sync boundary
+``obs.trace.ring_high_water``       (gauge) most events the span ring buffer has
+                                    held — set by every live ``write_jsonl`` so a
+                                    trace file carries its own truncation evidence
 ==================================  ==============================================
 
 Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the

@@ -1,0 +1,339 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""In-graph device telemetry: a fixed-shape health state riding the compiled step.
+
+The host-side tracing of :mod:`~torchmetrics_tpu.obs.trace` stops at the XLA
+boundary: once ``make_jit_update``/``sharded_update`` hand a batch to a
+compiled program, the whole step is one opaque span. This module puts a small
+**fixed-shape telemetry pytree** (:class:`TelemetryState`) INSIDE that
+program: per-input NaN/Inf counts, min/max/absmax gauges, an update counter
+and an optional fixed-bin value histogram (riding
+:class:`~torchmetrics_tpu.sketch.histogram.HistogramSketch`). The state is
+threaded as an extra carry through the compiled update step and reduced with
+the metric's own collectives, so per-batch cost is a handful of fused
+elementwise reductions and **no host sync**: the accumulated state is only
+materialized ("drained") into ordinary obs gauges (``device.<Metric>.nan_count``,
+``device.<Metric>.in0.min``, ...) at ``compute()``/``sync()`` boundaries.
+
+**The trace-time static contract.** Telemetry is gated by a module-level flag
+(:data:`ENABLED`, env ``TM_TPU_DEVICE_TELEMETRY=1`` or
+:func:`enable`/:func:`device_telemetry`) read when the step is BUILT, never
+inside the traced function. With the flag off, the builders in
+``parallel/sharded.py`` do not touch this module's update functions at all,
+so the lowered program is byte-identical to a never-instrumented build (the
+zero-HLO-when-disabled parity is pinned by
+``tests/unittests/obs/test_device_telemetry.py``). Flipping the flag changes
+the ``_SHARDED_FN_CACHE`` key, so a cached compiled step can never silently
+serve the wrong instrumentation state.
+
+Unlike the rest of ``torchmetrics_tpu.obs`` this module imports jax (it
+builds jnp programs); it is therefore NOT imported by ``obs/__init__.py`` —
+the metricscope CLI keeps loading the obs package without paying the jax
+import.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.histogram import (
+    HistogramSketch,
+    hist_init,
+    hist_merge,
+    hist_quantile,
+    hist_update,
+)
+
+from . import counters as _counters
+from . import trace as _trace
+
+Array = jax.Array
+
+#: THE flag the step builders check (at build/trace time, never inside the
+#: traced function). Flip via enable()/disable()/device_telemetry().
+ENABLED: bool = os.environ.get("TM_TPU_DEVICE_TELEMETRY", "0") == "1"
+
+#: optional fixed-bin histogram config for input 0: (bins, lo, hi) or None
+_HISTOGRAM: Optional[Tuple[int, float, float]] = None
+
+
+def _env_histogram() -> Optional[Tuple[int, float, float]]:
+    """``TM_TPU_DEVICE_TELEMETRY_HIST=bins:lo:hi`` (e.g. ``64:-10:10``)."""
+    raw = os.environ.get("TM_TPU_DEVICE_TELEMETRY_HIST", "")
+    if not raw:
+        return None
+    try:
+        bins, lo, hi = raw.split(":")
+        return (int(bins), float(lo), float(hi))
+    except ValueError:
+        return None
+
+
+if ENABLED:
+    _HISTOGRAM = _env_histogram()
+
+
+def enable(histogram: Optional[Tuple[int, float, float]] = None) -> None:
+    """Turn device telemetry on for steps built AFTER this call.
+
+    ``histogram=(bins, lo, hi)`` additionally folds input 0's values into a
+    fixed-bin :class:`HistogramSketch` inside the compiled step.
+    """
+    global ENABLED, _HISTOGRAM
+    ENABLED = True
+    _HISTOGRAM = histogram
+
+
+def disable() -> None:
+    global ENABLED, _HISTOGRAM
+    ENABLED = False
+    _HISTOGRAM = None
+
+
+def is_enabled() -> bool:
+    return ENABLED
+
+
+def config_token() -> Tuple:
+    """Hashable build config — part of the ``_SHARDED_FN_CACHE`` key so a
+    flag/histogram flip invalidates cached compiled steps."""
+    return (ENABLED, _HISTOGRAM)
+
+
+@contextmanager
+def device_telemetry(histogram: Optional[Tuple[int, float, float]] = None) -> Iterator[None]:
+    """Scoped enable: ``with device_telemetry(): step, init = make_jit_update(m)``.
+
+    Only affects steps BUILT inside the scope (the flag is read at build
+    time); restores the previous flag + histogram config on exit.
+    """
+    global ENABLED, _HISTOGRAM
+    prev = (ENABLED, _HISTOGRAM)
+    ENABLED, _HISTOGRAM = True, histogram
+    try:
+        yield
+    finally:
+        ENABLED, _HISTOGRAM = prev
+
+
+# ---------------------------------------------------------------- the state
+
+
+class TelemetryState(NamedTuple):
+    """Fixed-shape per-step health accumulator (a jax pytree).
+
+    All per-input fields have shape ``(n_inputs,)``; ``n_inputs`` is fixed
+    when the step is built. NaN/Inf counts are exact; min/max/absmax track
+    FINITE values only (a NaN cannot poison the gauges). ``hist`` is ``None``
+    (an empty pytree subtree — no HLO) unless a histogram was configured.
+    """
+
+    nan_count: Array  #: (n,) int32 — exact count of NaN elements seen per input
+    inf_count: Array  #: (n,) int32 — exact count of +/-Inf elements per input
+    elems: Array  #: (n,) int32 — total elements folded per input
+    min_val: Array  #: (n,) float32 — min over finite elements (+inf when none)
+    max_val: Array  #: (n,) float32 — max over finite elements (-inf when none)
+    absmax: Array  #: (n,) float32 — max |x| over finite elements (0 when none)
+    updates: Array  #: () int32 — update steps folded in
+    hist: Optional[HistogramSketch]  #: optional fixed-bin histogram of input 0
+
+
+def telemetry_init(n_inputs: int, histogram: Optional[Tuple[int, float, float]] = None) -> TelemetryState:
+    """Empty telemetry state for a step taking ``n_inputs`` batch arrays."""
+    if n_inputs < 1:
+        raise ValueError(f"need n_inputs >= 1, got {n_inputs}")
+    return TelemetryState(
+        nan_count=jnp.zeros((n_inputs,), jnp.int32),
+        inf_count=jnp.zeros((n_inputs,), jnp.int32),
+        elems=jnp.zeros((n_inputs,), jnp.int32),
+        min_val=jnp.full((n_inputs,), jnp.inf, jnp.float32),
+        max_val=jnp.full((n_inputs,), -jnp.inf, jnp.float32),
+        absmax=jnp.zeros((n_inputs,), jnp.float32),
+        updates=jnp.asarray(0, jnp.int32),
+        hist=None if histogram is None else hist_init(*histogram),
+    )
+
+
+def telemetry_update(state: TelemetryState, inputs: Sequence[Any]) -> TelemetryState:
+    """Fold one batch's input arrays in (pure, jit-safe, shape-preserving).
+
+    Every input is folded — the loop is static at trace time. Inputs beyond
+    the state's ``n_inputs`` slots (an under-declared ``*args`` update
+    signature) collapse into the LAST slot, so the TOTAL nan/inf/element
+    counts stay exact even when per-input attribution cannot. Non-float
+    inputs contribute exact min/max and zero NaN/Inf. NaNs fold into the
+    histogram's total count but (by IEEE comparison) land in neither a bin
+    nor the out-of-range tallies.
+    """
+    n = state.nan_count.shape[0]
+    nan_c, inf_c, elems = state.nan_count, state.inf_count, state.elems
+    min_v, max_v, abs_v = state.min_val, state.max_val, state.absmax
+    hist = state.hist
+    for pos, raw in enumerate(inputs):
+        i = min(pos, n - 1)
+        x = jnp.ravel(jnp.asarray(raw))
+        if x.size == 0:  # static: an empty input contributes nothing
+            continue
+        xf = x.astype(jnp.float32)
+        # minimal op set — this runs per batch inside the compiled step:
+        # inf count derives from the finite count (no isinf pass), absmax
+        # from the finite min/max (no abs pass)
+        finite = jnp.isfinite(xf)
+        n_nan = jnp.sum(jnp.isnan(xf)).astype(jnp.int32)
+        n_finite = jnp.sum(finite).astype(jnp.int32)
+        batch_min = jnp.min(jnp.where(finite, xf, jnp.inf))
+        batch_max = jnp.max(jnp.where(finite, xf, -jnp.inf))
+        nan_c = nan_c.at[i].add(n_nan)
+        inf_c = inf_c.at[i].add(jnp.asarray(x.size, jnp.int32) - n_finite - n_nan)
+        elems = elems.at[i].add(jnp.asarray(x.size, jnp.int32))
+        min_v = min_v.at[i].min(batch_min)
+        max_v = max_v.at[i].max(batch_max)
+        abs_v = abs_v.at[i].max(
+            jnp.where(n_finite > 0, jnp.maximum(jnp.abs(batch_min), jnp.abs(batch_max)), 0.0)
+        )
+        if hist is not None and pos == 0:  # the histogram watches input 0 only
+            hist = hist_update(hist, xf)
+    return TelemetryState(nan_c, inf_c, elems, min_v, max_v, abs_v, state.updates + 1, hist)
+
+
+def telemetry_merge(a: TelemetryState, b: TelemetryState) -> TelemetryState:
+    """Pairwise merge (exact; associative/commutative)."""
+    return TelemetryState(
+        nan_count=a.nan_count + b.nan_count,
+        inf_count=a.inf_count + b.inf_count,
+        elems=a.elems + b.elems,
+        min_val=jnp.minimum(a.min_val, b.min_val),
+        max_val=jnp.maximum(a.max_val, b.max_val),
+        absmax=jnp.maximum(a.absmax, b.absmax),
+        updates=a.updates + b.updates,
+        hist=None if a.hist is None else hist_merge(a.hist, b.hist),
+    )
+
+
+def telemetry_mesh_reduce(state: TelemetryState, axis_name: str) -> TelemetryState:
+    """Reduce per-device partial telemetry across a mesh axis (inside
+    ``shard_map``): counts ``psum``, gauges ``pmin``/``pmax``. Histogram
+    counts sum; its edge vector is a replicated constant and passes through."""
+    psum = lambda v: jax.lax.psum(v, axis_name)
+    hist = state.hist
+    if hist is not None:
+        hist = HistogramSketch(
+            edges=hist.edges,
+            counts=psum(hist.counts),
+            low=psum(hist.low),
+            high=psum(hist.high),
+            count=psum(hist.count),
+        )
+    return TelemetryState(
+        nan_count=psum(state.nan_count),
+        inf_count=psum(state.inf_count),
+        elems=psum(state.elems),
+        min_val=jax.lax.pmin(state.min_val, axis_name),
+        max_val=jax.lax.pmax(state.max_val, axis_name),
+        absmax=jax.lax.pmax(state.absmax, axis_name),
+        updates=psum(state.updates),
+        hist=hist,
+    )
+
+
+# ------------------------------------------------------------------ draining
+
+
+def state_histogram_config(state: TelemetryState) -> Optional[Tuple[int, float, float]]:
+    """Recover the ``(bins, lo, hi)`` geometry a state's histogram was built
+    with, by reading its edge vector. This MATERIALIZES the edges (host
+    sync) — call it only from host-boundary code (``fold_jit_state``), never
+    per batch; per-batch callers pass the build config they already hold."""
+    if state.hist is None:
+        return None
+    import numpy as np
+
+    edges = np.asarray(state.hist.edges)
+    return (len(edges) - 1, float(edges[0]), float(edges[-1]))
+
+
+def accumulate(metric: Any, state: TelemetryState,
+               histogram: Optional[Tuple[int, float, float]] = None) -> None:
+    """Fold one step's (mesh-reduced) telemetry into the metric's pending
+    accumulator — a device-side merge of a handful of tiny arrays, NO host
+    sync; :func:`drain_metric` materializes it at a compute/sync boundary.
+
+    ``histogram`` is the ``(bins, lo, hi)`` config the producing step was
+    BUILT with (``None`` = no histogram); the pending slot remembers it so a
+    state from a DIFFERENT telemetry config (input arity, histogram presence,
+    bin count or RANGE changed between builds) is never merged elementwise —
+    equal-shape edge vectors over different ranges would silently corrupt the
+    hist gauges. On mismatch the pending state is drained to gauges first and
+    the new regime starts fresh.
+    """
+    prev = getattr(metric, "_device_telemetry", None)
+    if prev is not None:
+        prev_state, prev_hist = prev
+        incompatible = (
+            prev_state.nan_count.shape != state.nan_count.shape or prev_hist != histogram
+        )
+        if incompatible:
+            drain_state(prev_state, type(metric).__name__)
+            prev = None
+    metric._device_telemetry = (
+        (state, histogram) if prev is None else (telemetry_merge(prev[0], state), histogram)
+    )
+
+
+def drain_state(state: TelemetryState, name: str) -> Dict[str, float]:
+    """Materialize a telemetry state into obs gauges (host sync happens HERE).
+
+    Gauge names: ``device.<name>.nan_count``/``.inf_count``/``.updates``
+    (totals), ``device.<name>.in<i>.{nan_count,inf_count,elems,min,max,absmax}``
+    per input (min/max/absmax only for inputs that saw finite data), and —
+    with a histogram configured — ``device.<name>.hist.{p50,p95,p99,outliers}``.
+    """
+    import numpy as np
+
+    prefix = f"device.{name}"
+    out: Dict[str, float] = {
+        f"{prefix}.nan_count": int(np.sum(np.asarray(state.nan_count))),
+        f"{prefix}.inf_count": int(np.sum(np.asarray(state.inf_count))),
+        f"{prefix}.updates": int(np.asarray(state.updates)),
+    }
+    nan_c, inf_c = np.asarray(state.nan_count), np.asarray(state.inf_count)
+    elems = np.asarray(state.elems)
+    min_v, max_v, abs_v = np.asarray(state.min_val), np.asarray(state.max_val), np.asarray(state.absmax)
+    for i in range(nan_c.shape[0]):
+        out[f"{prefix}.in{i}.nan_count"] = int(nan_c[i])
+        out[f"{prefix}.in{i}.inf_count"] = int(inf_c[i])
+        out[f"{prefix}.in{i}.elems"] = int(elems[i])
+        if np.isfinite(min_v[i]):  # at least one finite element seen
+            out[f"{prefix}.in{i}.min"] = float(min_v[i])
+            out[f"{prefix}.in{i}.max"] = float(max_v[i])
+            out[f"{prefix}.in{i}.absmax"] = float(abs_v[i])
+    if state.hist is not None:
+        p50, p95, p99 = np.asarray(hist_quantile(state.hist, jnp.asarray([0.5, 0.95, 0.99])))
+        if np.isfinite(p50):
+            out[f"{prefix}.hist.p50"] = float(p50)
+            out[f"{prefix}.hist.p95"] = float(p95)
+            out[f"{prefix}.hist.p99"] = float(p99)
+        out[f"{prefix}.hist.outliers"] = int(np.asarray(state.hist.low) + np.asarray(state.hist.high))
+    for gauge, value in out.items():
+        _counters.set_gauge(gauge, value)
+    if _trace.ENABLED:
+        _counters.inc("device.telemetry.drain")
+    return out
+
+
+def drain_metric(metric: Any) -> Optional[Dict[str, float]]:
+    """Drain a metric's pending accumulator (if any) into gauges and clear it.
+
+    Called by ``Metric.compute``/``Metric.sync`` and the collection compute
+    boundary — the ONLY places device telemetry touches the host.
+    """
+    pending = getattr(metric, "_device_telemetry", None)
+    if pending is None:
+        return None
+    metric._device_telemetry = None
+    state, _histogram = pending
+    return drain_state(state, type(metric).__name__)

@@ -21,15 +21,21 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional, Tuple
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import counters as _counters
 from . import trace as _trace
 
+# one warning per process when an export first observes ring-buffer drops: a
+# truncated trace must announce itself even if nobody reads the meta line
+_drop_warned = False
+
 
 def write_jsonl(path: str, events: Optional[List[Dict[str, Any]]] = None,
                 counter_snapshot: Optional[Dict[str, Any]] = None,
-                dropped: Optional[int] = None) -> None:
+                dropped: Optional[int] = None, rank: Optional[int] = None) -> None:
     """Write a self-contained JSON-lines trace file.
 
     Defaults to the live ring buffer and the live counter registry; pass
@@ -37,16 +43,49 @@ def write_jsonl(path: str, events: Optional[List[Dict[str, Any]]] = None,
     the meta line's drop count then comes from ``dropped`` (a saved recording
     must carry its own accounting; the live buffer's count only applies to
     the live buffer's events).
+
+    The meta line anchors the file for cross-process merging: ``epoch_ns``
+    (wall clock) and ``mono_ns`` (the span clock at the same instant) let
+    :func:`~torchmetrics_tpu.obs.merge.merge_traces` place this file's
+    monotonic timestamps on a shared wall-clock timeline; pass ``rank`` so
+    the merged view labels this process's lane (without it, the merge falls
+    back to the file's position in its argument list — the recorded ``pid``
+    is informational only).
+
+    A live-buffer export also publishes the ``obs.trace.ring_high_water``
+    gauge and, the FIRST time drops are observed, emits one warning naming
+    how many spans were lost — a truncated profile must not read as complete.
     """
+    global _drop_warned
+    live = events is None
     if dropped is None:
-        dropped = _trace.dropped_events() if events is None else 0
-    events = _trace.get_trace() if events is None else events
+        dropped = _trace.dropped_events() if live else 0
+    events = _trace.get_trace() if live else events
+    if live:
+        _counters.set_gauge("obs.trace.ring_high_water", _trace.high_water())
+        if dropped and not _drop_warned:
+            _drop_warned = True
+            warnings.warn(
+                f"trace ring buffer dropped {dropped} span(s) before this export — the trace is"
+                " partial; raise TM_TPU_TRACE_BUFFER (or trace.configure) to keep the full profile",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     snap = _counters.snapshot() if counter_snapshot is None else counter_snapshot
+    meta = {
+        "type": "meta",
+        "dropped": dropped,
+        "epoch_ns": time.time_ns(),
+        "mono_ns": time.perf_counter_ns(),
+        "pid": os.getpid(),
+    }
+    if rank is not None:
+        meta["rank"] = rank
     with open(path, "w") as fh:
         for event in events:
             fh.write(json.dumps(event, separators=(",", ":")) + "\n")
         fh.write(json.dumps({"type": "counters", **snap}, separators=(",", ":")) + "\n")
-        fh.write(json.dumps({"type": "meta", "dropped": dropped}, separators=(",", ":")) + "\n")
+        fh.write(json.dumps(meta, separators=(",", ":")) + "\n")
 
 
 def read_jsonl(path: str) -> Tuple[List[Dict[str, Any]], Dict[str, Any], Dict[str, Any], Dict[str, Any]]:
@@ -112,12 +151,20 @@ def write_chrome_trace(path: str, events: Optional[List[Dict[str, Any]]] = None,
 # ----------------------------------------------------------------- summary
 
 
+def _percentile(sorted_ns: Sequence[int], q: float) -> float:
+    """Nearest-rank percentile over a pre-sorted duration list (ns)."""
+    idx = min(len(sorted_ns) - 1, max(0, int(round(q * (len(sorted_ns) - 1)))))
+    return float(sorted_ns[idx])
+
+
 def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Aggregate span events into per-(metric, span-name) rows.
 
     The grouping key is the span's ``metric`` arg (instrumented spans tag the
     metric class; untagged spans group under ``"-"``). Rows carry count,
-    total/mean/max duration in ms, sorted by total time descending.
+    total/mean duration plus the p50/p95/max distribution in ms (a mean hides
+    the recompile/straggler tail the distribution exists to show), sorted by
+    total time descending.
     """
     stats: Dict[Tuple[str, str], Dict[str, Any]] = {}
     for event in events:
@@ -127,20 +174,22 @@ def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         key = (str(args.get("metric", "-")), event["name"])
         row = stats.get(key)
         if row is None:
-            row = stats[key] = {"metric": key[0], "span": key[1], "count": 0, "total_ns": 0, "max_ns": 0}
-        row["count"] += 1
-        row["total_ns"] += event.get("dur", 0)
-        row["max_ns"] = max(row["max_ns"], event.get("dur", 0))
+            row = stats[key] = {"metric": key[0], "span": key[1], "durs_ns": []}
+        row["durs_ns"].append(event.get("dur", 0))
     rows = []
     for row in stats.values():
+        durs = sorted(row["durs_ns"])
+        total_ns = sum(durs)
         rows.append(
             {
                 "metric": row["metric"],
                 "span": row["span"],
-                "count": row["count"],
-                "total_ms": row["total_ns"] / 1e6,
-                "mean_ms": row["total_ns"] / row["count"] / 1e6,
-                "max_ms": row["max_ns"] / 1e6,
+                "count": len(durs),
+                "total_ms": total_ns / 1e6,
+                "mean_ms": total_ns / len(durs) / 1e6,
+                "p50_ms": _percentile(durs, 0.50) / 1e6,
+                "p95_ms": _percentile(durs, 0.95) / 1e6,
+                "max_ms": durs[-1] / 1e6,
             }
         )
     rows.sort(key=lambda r: (-r["total_ms"], r["metric"], r["span"]))
@@ -152,12 +201,14 @@ def summarize(events: List[Dict[str, Any]], counters: Optional[Dict[str, Any]] =
     """Render the per-metric/per-phase summary table plus counters as text.
 
     A nonzero ``dropped`` (the ring buffer discarded that many oldest events)
-    is surfaced up front — a truncated profile must not read as complete.
+    is surfaced up front AND restated in the footer — a truncated profile
+    must not read as complete.
     """
     rows = aggregate(events)
-    header = ("metric", "span", "count", "total_ms", "mean_ms", "max_ms")
+    header = ("metric", "span", "count", "total_ms", "mean_ms", "p50_ms", "p95_ms", "max_ms")
     table = [header] + [
-        (r["metric"], r["span"], str(r["count"]), f"{r['total_ms']:.3f}", f"{r['mean_ms']:.3f}", f"{r['max_ms']:.3f}")
+        (r["metric"], r["span"], str(r["count"]), f"{r['total_ms']:.3f}", f"{r['mean_ms']:.3f}",
+         f"{r['p50_ms']:.3f}", f"{r['p95_ms']:.3f}", f"{r['max_ms']:.3f}")
         for r in rows
     ]
     widths = [max(len(row[i]) for row in table) for i in range(len(header))]
@@ -191,4 +242,7 @@ def summarize(events: List[Dict[str, Any]], counters: Optional[Dict[str, Any]] =
             lines.append(f"  {name} = {counters[name]}")
         for name in sorted(gauges):
             lines.append(f"  {name} = {gauges[name]} (gauge)")
+    if dropped:
+        lines.append("")
+        lines.append(f"ring buffer dropped = {dropped} event(s) — totals above are partial")
     return "\n".join(lines)

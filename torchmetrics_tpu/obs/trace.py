@@ -51,6 +51,7 @@ def _env_capacity() -> int:
 _lock = threading.Lock()
 _events: deque = deque(maxlen=_env_capacity())
 _dropped = 0
+_high_water = 0
 _tls = threading.local()
 
 
@@ -82,11 +83,12 @@ def configure(capacity: int) -> None:
 
 
 def clear() -> None:
-    """Drop all recorded events and the drop counter."""
-    global _dropped
+    """Drop all recorded events, the drop counter and the high-water mark."""
+    global _dropped, _high_water
     with _lock:
         _events.clear()
         _dropped = 0
+        _high_water = 0
 
 
 def get_trace() -> List[Dict[str, Any]]:
@@ -101,12 +103,26 @@ def dropped_events() -> int:
         return _dropped
 
 
+def high_water() -> int:
+    """The most events the ring buffer has held since the last :func:`clear`.
+
+    ``high_water() == capacity`` means the buffer filled at least once — any
+    further recording dropped oldest events; exporters surface it as the
+    ``obs.trace.ring_high_water`` gauge so a trace file carries its own
+    truncation evidence.
+    """
+    with _lock:
+        return _high_water
+
+
 def _record(event: Dict[str, Any]) -> None:
-    global _dropped
+    global _dropped, _high_water
     with _lock:
         if len(_events) == _events.maxlen:
             _dropped += 1
         _events.append(event)
+        if len(_events) > _high_water:
+            _high_water = len(_events)
 
 
 def _stack() -> list:

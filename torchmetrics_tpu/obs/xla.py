@@ -1,0 +1,300 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""XLA compile observability: timed lowering/compilation + cost capture.
+
+``jax.jit`` is lazy — trace, lowering and XLA compilation all happen inside
+the first call, which is why PR-3's ``sharded.compile`` span could only time
+the *whole* first call (trace + compile + first-step execution fused). This
+module splits that wall into three spans by compiling ahead-of-time when
+tracing is enabled:
+
+- ``<prefix>.lower``   — trace + StableHLO lowering wall time
+- ``<prefix>.compile`` — XLA compilation wall time, tagged with the
+  backend's own ``cost_analysis()`` (flops, bytes accessed) and
+  ``memory_analysis()`` (temp/argument/output bytes) when available
+- ``<prefix>.first_step`` — the first execution, now measured alone
+
+Every capture is keyed by the caller's cache fingerprint (the
+``_SHARDED_FN_CACHE`` key digest for sharded steps, the walk fingerprint for
+``make_jit_update`` builds) and rides the ordinary span pipeline — so a
+JSON-lines export already contains the compile records, and
+``tools/metricscope.py xla`` can rank compiled steps by estimated device
+cost with no new file format. An in-process registry (:func:`records`)
+serves tests and live inspection.
+
+This module imports NO jax at module level (the metricscope CLI loads the
+obs package standalone); the capture paths lazily import jax, which is
+already resident in any process that has a jitted function to hand us.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import counters as _counters
+from . import trace as _trace
+
+_lock = threading.Lock()
+_records: List[Dict[str, Any]] = []
+
+
+def records() -> List[Dict[str, Any]]:
+    """Point-in-time copy of every compile record captured this process."""
+    with _lock:
+        return [dict(r) for r in _records]
+
+
+def clear_records() -> None:
+    with _lock:
+        _records.clear()
+
+
+# ------------------------------------------------------------------ aval keys
+
+
+def _leaf_key(leaf: Any) -> Tuple:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype), bool(getattr(leaf, "weak_type", False)))
+    return ("py", type(leaf).__name__)
+
+
+def _aval_key(value: Any) -> Tuple:
+    """Structural (shape, dtype) fingerprint of an argument pytree — what
+    decides whether a captured AOT-compiled executable can serve a call."""
+    if isinstance(value, dict):
+        return ("dict",) + tuple((k, _aval_key(value[k])) for k in sorted(value))
+    if isinstance(value, (list, tuple)) and not hasattr(value, "shape"):
+        return ("seq",) + tuple(_aval_key(v) for v in value)
+    return _leaf_key(value)
+
+
+def _has_tracers(args: Sequence[Any]) -> bool:
+    import jax
+
+    return any(isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(args))
+
+
+# ------------------------------------------------------------------- capture
+
+
+def _cost_analysis(compiled: Any) -> Dict[str, Optional[float]]:
+    """Normalize ``compiled.cost_analysis()``/``memory_analysis()`` across
+    jax versions/backends; every field is None when the backend won't say."""
+    out: Dict[str, Optional[float]] = {
+        "flops": None, "bytes_accessed": None, "temp_bytes": None,
+        "argument_bytes": None, "output_bytes": None, "code_bytes": None,
+    }
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        if cost:
+            if cost.get("flops", -1.0) >= 0:
+                out["flops"] = float(cost["flops"])
+            if cost.get("bytes accessed", -1.0) >= 0:
+                out["bytes_accessed"] = float(cost["bytes accessed"])
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            out["temp_bytes"] = float(getattr(mem, "temp_size_in_bytes", 0))
+            out["argument_bytes"] = float(getattr(mem, "argument_size_in_bytes", 0))
+            out["output_bytes"] = float(getattr(mem, "output_size_in_bytes", 0))
+            out["code_bytes"] = float(getattr(mem, "generated_code_size_in_bytes", 0))
+    except Exception:
+        pass
+    return out
+
+
+def capture_compile(
+    jitted: Any,
+    args: Sequence[Any],
+    *,
+    key: str,
+    metric: str,
+    kind: str,
+    span_prefix: str,
+) -> Tuple[Optional[Any], Optional[Dict[str, Any]]]:
+    """Explicitly lower + compile ``jitted`` for ``args``, timing each stage.
+
+    Emits ``<span_prefix>.lower`` and ``<span_prefix>.compile`` spans (the
+    compile span carries the cost/memory analysis in its args, so the record
+    rides any JSONL/Chrome export), appends to the in-process registry, and
+    returns the compiled executable. Returns ``(None, None)`` if the backend
+    refuses AOT lowering — callers fall back to the lazy jit path.
+    """
+    try:
+        lower_span = _trace.span(f"{span_prefix}.lower", xla_key=key, metric=metric, kind=kind)
+        with lower_span:
+            t0 = time.perf_counter_ns()
+            lowered = jitted.lower(*args)
+            lower_ns = time.perf_counter_ns() - t0
+        compile_span = _trace.span(f"{span_prefix}.compile", xla_key=key, metric=metric, kind=kind)
+        with compile_span:
+            t0 = time.perf_counter_ns()
+            compiled = lowered.compile()
+            compile_ns = time.perf_counter_ns() - t0
+            cost = _cost_analysis(compiled)
+            if compile_span.args is not None:  # ride the exported span
+                compile_span.args.update(
+                    lower_ms=lower_ns / 1e6,
+                    compile_ms=compile_ns / 1e6,
+                    **{k: v for k, v in cost.items() if v is not None},
+                )
+    except Exception as err:  # pragma: no cover - backend-dependent
+        _trace.instant(f"{span_prefix}.capture_failed", xla_key=key, error=type(err).__name__)
+        return None, None
+    record = {
+        "key": key, "metric": metric, "kind": kind,
+        "lower_ms": lower_ns / 1e6, "compile_ms": compile_ns / 1e6, **cost,
+    }
+    with _lock:
+        _records.append(record)
+    if _trace.ENABLED:
+        _counters.inc("xla.compile")
+        _counters.set_gauge("xla.compile.last_ms", record["compile_ms"])
+    return compiled, record
+
+
+class _InstrumentedJit:
+    """A jitted function that AOT-captures its own compilation when tracing
+    is enabled at first call, then dispatches to the captured executable.
+
+    Disabled-tracing behavior is exactly the wrapped jit: one attribute check
+    per call, no lowering, no extra compilation, no capture. After a capture,
+    calls whose argument structure matches the captured avals go straight to
+    the compiled executable — the lazy jit path is never paid twice for the
+    same shapes. Tracer arguments (the step used inside ``lax.scan``/another
+    jit) always take the plain jit path.
+    """
+
+    __slots__ = ("_jitted", "_key", "_metric", "_kind", "_prefix", "_compiled", "_aval", "_warm", "lower")
+
+    def __init__(self, jitted: Any, *, key: str, metric: str, kind: str, span_prefix: str) -> None:
+        self._jitted = jitted
+        self._key = key
+        self._metric = metric
+        self._kind = kind
+        self._prefix = span_prefix
+        self._compiled: Optional[Any] = None
+        self._aval: Optional[Tuple] = None
+        self._warm = False  # capture only a genuinely cold compile: a first
+        # call served untraced already paid the lazy compile — enabling
+        # tracing later must not recompile a warm program just to time it
+        self.lower = jitted.lower  # AOT inspection passthrough (HLO parity tests)
+
+    def __call__(self, *args: Any) -> Any:
+        compiled = self._compiled
+        if compiled is not None:
+            # the captured executable serves only calls it was compiled for:
+            # matching avals AND concrete arguments. Tracers (the step inside
+            # lax.scan/another jit) and new shapes route to the lazy jit up
+            # front; a TypeError/ValueError from the compiled call itself
+            # means the arguments differ in something the aval key cannot see
+            # (sharding/placement/weak-type drift) — plain jit recompiles for
+            # those transparently, and an observability capture must not
+            # change that. Real execution failures (XlaRuntimeError) propagate.
+            if self._aval == _aval_key(args) and not _has_tracers(args):
+                try:
+                    return compiled(*args)
+                except (TypeError, ValueError):
+                    return self._jitted(*args)
+            return self._jitted(*args)
+        if _trace.ENABLED and not self._warm and not _has_tracers(args):
+            compiled, _ = capture_compile(
+                self._jitted, args, key=self._key, metric=self._metric,
+                kind=self._kind, span_prefix=self._prefix,
+            )
+            if compiled is not None:
+                self._compiled = compiled
+                self._aval = _aval_key(args)
+                with _trace.span(f"{self._prefix}.first_step", xla_key=self._key, metric=self._metric):
+                    return compiled(*args)
+        self._warm = True
+        return self._jitted(*args)
+
+
+def instrument_jit(jitted: Any, *, key: str, metric: str, kind: str, span_prefix: str) -> _InstrumentedJit:
+    """Wrap a jitted callable with first-call compile capture (see
+    :class:`_InstrumentedJit`)."""
+    return _InstrumentedJit(jitted, key=key, metric=metric, kind=kind, span_prefix=span_prefix)
+
+
+# -------------------------------------------------------------- CLI rendering
+
+
+def compile_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Extract compile records from exported span events, one row per capture,
+    ranked by estimated device cost (flops, then bytes accessed, then compile
+    time — the best signal the backend offered), most expensive first. Rows
+    join in the matching ``*.first_step`` execution time by capture key."""
+    first_step_ms: Dict[str, float] = {}
+    for event in events:
+        args = event.get("args") or {}
+        if event.get("type") == "span" and "xla_key" in args and event["name"].endswith(".first_step"):
+            first_step_ms[args["xla_key"]] = event.get("dur", 0) / 1e6
+    rows = []
+    for event in events:
+        args = event.get("args") or {}
+        if event.get("type") != "span" or "xla_key" not in args or not event["name"].endswith(".compile"):
+            continue
+        rows.append(
+            {
+                "key": args["xla_key"],
+                "metric": args.get("metric", "-"),
+                "kind": args.get("kind", "-"),
+                "lower_ms": args.get("lower_ms"),
+                "compile_ms": args.get("compile_ms", event.get("dur", 0) / 1e6),
+                "flops": args.get("flops"),
+                "bytes_accessed": args.get("bytes_accessed"),
+                "temp_bytes": args.get("temp_bytes"),
+                "first_step_ms": first_step_ms.get(args["xla_key"]),
+            }
+        )
+    rows.sort(
+        key=lambda r: (
+            -(r["flops"] if r["flops"] is not None else -1.0),
+            -(r["bytes_accessed"] if r["bytes_accessed"] is not None else -1.0),
+            -(r["compile_ms"] or 0.0),
+        )
+    )
+    return rows
+
+
+def _fmt(value: Optional[float], pattern: str = "{:.3f}") -> str:
+    return "-" if value is None else pattern.format(value)
+
+
+def format_compile_table(rows: List[Dict[str, Any]]) -> str:
+    """Render :func:`compile_rows` as the ``metricscope xla`` table."""
+    if not rows:
+        return "(no xla compile records in this trace — record with TM_TPU_TRACE=1 and a cold compiled step)"
+    header = ("rank", "metric", "kind", "key", "compile_ms", "lower_ms", "first_step_ms", "mflops", "mbytes")
+    table = [header]
+    for i, row in enumerate(rows):
+        table.append(
+            (
+                str(i + 1),
+                row["metric"],
+                row["kind"],
+                row["key"][:16],
+                _fmt(row["compile_ms"]),
+                _fmt(row["lower_ms"]),
+                _fmt(row["first_step_ms"]),
+                _fmt(None if row["flops"] is None else row["flops"] / 1e6),
+                _fmt(None if row["bytes_accessed"] is None else row["bytes_accessed"] / 1e6),
+            )
+        )
+    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(cell.ljust(widths[j]) for j, cell in enumerate(row)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    lines.append("")
+    lines.append("ranked by estimated device cost: flops, then bytes accessed, then compile time")
+    return "\n".join(lines)

@@ -28,6 +28,8 @@ riding ICI instead of NCCL.
 """
 from __future__ import annotations
 
+import hashlib
+import inspect
 import weakref
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -36,7 +38,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import device as _obs_device
 from torchmetrics_tpu.obs import trace as _obs_trace
+from torchmetrics_tpu.obs import xla as _obs_xla
 from torchmetrics_tpu.parallel.cat_buffer import (
     CatBuffer,
     cat_buffer_append,
@@ -203,6 +207,15 @@ def make_jit_update(
     ``"_update_count"`` so ``"mean"`` states merge as a correctly weighted
     running average (reference ``metric.py:317``) instead of decaying
     pairwise means.
+
+    With device telemetry enabled at build time
+    (``torchmetrics_tpu.obs.device``), the state additionally carries a
+    fixed-shape ``"_telemetry"`` health accumulator (per-input NaN/Inf
+    counts, min/max/absmax, optional histogram) updated INSIDE the compiled
+    step; :func:`fold_jit_state` moves it to the metric, and the next
+    ``compute()`` drains it into ``device.<Metric>.*`` gauges. Disabled
+    (the default) the traced program is byte-identical to this docstring's
+    plain contract — zero extra HLO ops.
     """
     if _obs_trace.ENABLED:
         with _obs_trace.span("parallel.jit_build", metric=type(metric).__name__):
@@ -210,11 +223,73 @@ def make_jit_update(
     return _make_jit_update(metric, cat_capacity, example_batch)
 
 
+def _fingerprint_digest(*parts: Any) -> str:
+    """Short stable digest of build-identity parts — the key xla compile
+    records are filed under (ISSUE 6: cost capture keyed by cache fingerprint)."""
+    return hashlib.sha1(repr(parts).encode()).hexdigest()[:12]
+
+
+def _update_arity(metric: "Any") -> int:
+    """Number of positional batch inputs ``metric.update`` declares — sizes
+    the per-input telemetry arrays when no ``example_batch`` is given. Calls
+    may legally pass fewer (optional args: extra slots stay zero) or more
+    (``*args`` signatures: overflow inputs collapse into the last slot, so
+    telemetry TOTALS stay exact even when attribution cannot)."""
+    params = [
+        p
+        for name, p in inspect.signature(type(metric).update).parameters.items()
+        if name != "self" and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    return max(1, len(params))
+
+
 def _make_jit_update(
     metric: "Any",
     cat_capacity: Optional[int] = None,
     example_batch: Optional[Tuple[Any, ...]] = None,
 ) -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
+    base_step, init_state = _build_update_step(metric, cat_capacity, example_batch)
+    telemetry_on, histogram = _obs_device.config_token()
+    if telemetry_on:
+        # the in-graph telemetry carry (obs/device.py): decided at BUILD time
+        # so the disabled path's traced program is byte-identical to a
+        # never-instrumented build (zero extra HLO ops, pinned by test)
+        n_inputs = len(example_batch) if example_batch is not None else _update_arity(metric)
+        init_state = dict(init_state)
+        init_state["_telemetry"] = _obs_device.telemetry_init(n_inputs, histogram)
+
+        def step(state: Dict[str, Any], *batch: Any) -> Dict[str, Any]:
+            state = dict(state)
+            telemetry = state.pop("_telemetry")
+            out = base_step(state, *batch)
+            out["_telemetry"] = _obs_device.telemetry_update(telemetry, batch)
+            return out
+
+        # deliberately NOT donated here: an observability flag must never
+        # change buffer semantics the caller sees (donation would delete
+        # state a caller still holds). Callers that want donation wrap the
+        # step in their own ``jax.jit(..., donate_argnums=0)`` — the
+        # telemetry carry rides whatever aliasing the outer jit declares.
+        jitted = jax.jit(step)
+    else:
+        jitted = jax.jit(base_step)
+    key = _fingerprint_digest("jit_update", type(metric).__name__, _walk_fingerprint(metric), telemetry_on)
+    return (
+        _obs_xla.instrument_jit(
+            jitted, key=key, metric=type(metric).__name__, kind="jit_update", span_prefix="parallel.jit_update"
+        ),
+        init_state,
+    )
+
+
+def _build_update_step(
+    metric: "Any",
+    cat_capacity: Optional[int] = None,
+    example_batch: Optional[Tuple[Any, ...]] = None,
+) -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
+    """The raw (unjitted, never-instrumented) update step + init state —
+    the program :func:`make_jit_update` jits; kept separate so the
+    zero-HLO-when-disabled parity test has an uninstrumented reference."""
     walk = _walk_metrics(metric)
     for path, m in walk:
         reason = getattr(m, "_sharded_update_unsupported", None)
@@ -271,15 +346,24 @@ def _make_jit_update(
         merged["_update_count"] = count + 1
         return merged
 
-    return jax.jit(step), init_state
+    return step, init_state
 
 
 def fold_jit_state(metric: "Any", state: Dict[str, Any]) -> None:
     """Load a :func:`make_jit_update` final state back into the metric.
 
     Converts :class:`CatBuffer` states to the metric's host-side list states
-    (raising if any buffer overflowed) and restores the update count.
+    (raising if any buffer overflowed) and restores the update count. A
+    ``"_telemetry"`` carry (device telemetry was enabled at build) moves to
+    the metric's pending accumulator, drained into ``device.*`` gauges at the
+    next ``compute()``/``sync()`` boundary.
     """
+    state = dict(state)
+    telemetry = state.pop("_telemetry", None)
+    if telemetry is not None:
+        # fold is a host boundary already: deriving the histogram config from
+        # the state's edge vector (a tiny materialization) is fine here
+        _obs_device.accumulate(metric, telemetry, _obs_device.state_histogram_config(telemetry))
     tree = {}
     for k, v in state.items():
         if isinstance(v, CatBuffer):
@@ -415,18 +499,22 @@ def deep_state_tree(metric: "Any") -> Dict[str, Any]:
 def _deep_snapshot(metric: "Any") -> list:
     return [
         (m, m._copy_state_dict(), m._update_count, m._computed,
-         {a: getattr(m, a) for a in getattr(m, "_host_counters", ())})
+         {a: getattr(m, a) for a in getattr(m, "_host_counters", ())},
+         getattr(m, "_device_telemetry", None))
         for _, m in _walk_metrics(metric)
     ]
 
 
 def _deep_restore(snapshot: list) -> None:
-    for m, state, count, computed, counters in snapshot:
+    for m, state, count, computed, counters, telemetry in snapshot:
         m._install_state_tree(state)  # self-snapshot: trusted, no validation
         m._update_count = count
         m._computed = computed
         for attr, val in counters.items():
             setattr(m, attr, val)
+        # pending device telemetry survives trace-time resets and forward's
+        # batch-local detour (which would otherwise double-count the batch)
+        m._device_telemetry = telemetry
 
 
 def _deep_batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> Dict[str, Any]:
@@ -454,6 +542,7 @@ def _batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> D
     saved = metric._copy_state_dict()
     saved_count = metric._update_count
     saved_computed = metric._computed
+    saved_telemetry = getattr(metric, "_device_telemetry", None)
     try:
         metric.reset()
         metric.update(*args, **kwargs)
@@ -462,6 +551,7 @@ def _batch_update_state(metric: "Any", args: Tuple, kwargs: Dict[str, Any]) -> D
         metric._install_state_tree(saved)  # self-snapshot: trusted
         metric._update_count = saved_count
         metric._computed = saved_computed
+        metric._device_telemetry = saved_telemetry  # trace-time reset must not drop pending telemetry
 
 
 def make_sharded_update(
@@ -499,23 +589,36 @@ def make_sharded_update(
             where = f" (at {path!r})" if path else ""
             raise ValueError(f"{type(m).__name__} does not support sharded_update{where}: {reason}")
     reductions = deep_reductions(metric)
+    # device telemetry is a BUILD-time decision (obs/device.py): with the flag
+    # off the traced program below is byte-identical to a never-instrumented
+    # build; sharded_update keys its cache on the config so a flip rebuilds
+    telemetry_on, histogram = _obs_device.config_token()
 
     def per_device(*args: Any, **kwargs: Any) -> Dict[str, Any]:
         partial_state = _deep_batch_update_state(metric, args, kwargs)
-        return mesh_reduce_tree(reductions, partial_state, axis_name)
+        out = mesh_reduce_tree(reductions, partial_state, axis_name)
+        if telemetry_on:
+            telemetry = _obs_device.telemetry_update(
+                _obs_device.telemetry_init(max(1, len(args)), histogram), args
+            )
+            out["_telemetry"] = _obs_device.telemetry_mesh_reduce(telemetry, axis_name)
+        return out
 
     def build_specs(args: Sequence[Any]) -> Tuple:
         # batch args shard along axis_name; scalars/0-d args are replicated
         return tuple(P(axis_name) if getattr(jnp.asarray(a), "ndim", 0) >= 1 else P() for a in args)
 
+    key_base = _fingerprint_digest(
+        "sharded", type(metric).__name__, axis_name, _walk_fingerprint(metric), telemetry_on
+    )
     fn_cache: Dict[Tuple, Callable] = {}
 
     def sharded(*args: Any) -> Dict[str, Any]:
         specs = in_specs if in_specs is not None else build_specs(args)
         key = tuple(specs)
-        cold = key not in fn_cache
-        if cold:
-            fn_cache[key] = jax.jit(
+        fn = fn_cache.get(key)
+        if fn is None:
+            jitted = jax.jit(
                 shard_map(
                     per_device,
                     mesh=mesh,
@@ -524,13 +627,33 @@ def make_sharded_update(
                     check_rep=False,
                 )
             )
-        if cold and _obs_trace.ENABLED:
-            # jax.jit is lazy: trace + XLA compile happen on the first call,
-            # so this span's duration IS the compile time for these specs
-            with _obs_trace.span("sharded.compile", metric=type(metric).__name__, specs=str(key)):
-                return fn_cache[key](*args)
-        return fn_cache[key](*args)
+            # jax.jit is lazy — trace, XLA compile and the first execution
+            # all hide inside the first call. The instrumented wrapper
+            # splits them under tracing: ``sharded.lower`` / ``sharded.compile``
+            # (tagged with the backend's flops/bytes cost analysis, keyed by
+            # the cache fingerprint) / ``sharded.first_step`` — so compile
+            # time is no longer conflated with first-step execution.
+            fn = _obs_xla.instrument_jit(
+                jitted,
+                key=f"{key_base}:{_fingerprint_digest(key)}",
+                metric=type(metric).__name__,
+                kind="sharded",
+                span_prefix="sharded",
+            )
+            fn_cache[key] = fn
+        out = fn(*args)
+        if telemetry_on:
+            # strip the carry HERE so the public contract is unchanged: the
+            # returned pytree stays load_state_tree/tree_merge-ready whether
+            # telemetry is on or off; the pending accumulator on the metric
+            # (device-side merge, no host sync) is the telemetry's only exit
+            out = dict(out)
+            telemetry = out.pop("_telemetry", None)
+            if telemetry is not None:
+                _obs_device.accumulate(metric, telemetry, histogram)
+        return out
 
+    sharded._fn_cache = fn_cache  # per-spec instrumented jits (tests lower through this)
     return sharded
 
 
@@ -557,8 +680,10 @@ def sharded_update(
     # cached compiled step, or it would silently fold the OLD children
     # (ADVICE.md round-5). The fingerprint walk re-runs per call but is a
     # cheap host-side attribute scan; the expensive parts (trace + compile +
-    # fold-target resolution) stay cached.
-    key = (id(metric), id(mesh), axis_name, _walk_fingerprint(metric))
+    # fold-target resolution) stay cached. The device-telemetry config rides
+    # the key too: telemetry is baked into the traced program at build, so a
+    # flag flip must rebuild, never serve the wrong instrumentation state.
+    key = (id(metric), id(mesh), axis_name, _walk_fingerprint(metric), _obs_device.config_token())
     entry = _SHARDED_FN_CACHE.get(key)
     cold = entry is None or entry[0]() is not metric or entry[1]() is not mesh
     if cold:
@@ -589,6 +714,8 @@ def sharded_update(
             merged = update_fn(*args)
     else:
         merged = update_fn(*args)
+    # telemetry (if enabled at build) was already stripped and accumulated by
+    # the make_sharded_update closure — `merged` is a clean state pytree here
     for path, m in walk:
         prev_count = m._update_count
         m._computed = None
@@ -635,6 +762,11 @@ class ShardedMetric:
             for _, m in _walk_metrics(self._metric):
                 m.reset()
             sharded_update(self._metric, self._mesh, *args, axis_name=self._axis_name)
+            # the detour re-measured the SAME batch the real update already
+            # accumulated telemetry for: discard the duplicate so the detour
+            # compute() cannot drain batch-local numbers over the cumulative
+            # device.* gauges (the snapshot restores the true pending state)
+            self._metric._device_telemetry = None
             self._metric._to_sync = False
             batch_val = self._metric.compute()
             self._metric._to_sync = self._metric.sync_on_compute
