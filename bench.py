@@ -320,6 +320,7 @@ def main() -> None:
             bench_coco_map_scale,
             bench_device_telemetry,
             bench_fid50k,
+            bench_live_publish,
             bench_retrieval_ndcg,
             bench_sketch_quantile,
             bench_ssim,
@@ -337,6 +338,9 @@ def main() -> None:
             # in-graph telemetry cost on the compiled classification step
             # (ISSUE 6): enabled-vs-disabled ratio rides the record
             ("device_telemetry_overhead", bench_device_telemetry, (), 60),
+            # live telemetry publisher cost on a streaming evaluation
+            # (ISSUE 7): host+disk only, cheap, runs early
+            ("live_publish_overhead", bench_live_publish, (), 30),
             ("fid50k", bench_fid50k, (), 120),
             ("coco_map_scale", bench_coco_map_scale, (), 180),
             # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;

@@ -269,6 +269,66 @@ def bench_checkpoint_roundtrip(repeats: int = 3) -> Dict:
     }
 
 
+def bench_live_publish(n_batches: int = 48, repeats: int = 3) -> Dict:
+    """``live_publish_overhead``: cost of the live telemetry plane (ISSUE 7)
+    on a ``StreamingEvaluator`` pass. The same classification stream runs
+    with publishing OFF and ON (file sink into a temp dir, deliberately
+    tight 20ms cadence — far hotter than the 1s production default, so the
+    measured ratio is an upper bound); headline is the ENABLED throughput
+    and ``ratio_vs_disabled`` is the number the tier-1 1.3x ratchet guards.
+    The per-batch producer cost is a few counter bumps + one EWMA update;
+    the publisher thread snapshots and fsyncs off the driving thread."""
+    import shutil
+    import tempfile
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.obs import live
+    from torchmetrics_tpu.robustness import StreamingEvaluator
+
+    rng = np.random.RandomState(0)
+    batch = 4096
+    batches = [
+        (rng.randint(0, 5, batch), rng.randint(0, 5, batch)) for _ in range(n_batches)
+    ]
+    metric = MulticlassAccuracy(num_classes=5, distributed_available_fn=lambda: False)
+    metric.update(*batches[0])  # warm the dispatch path
+    metric.reset()
+    n_samples = n_batches * batch
+
+    base = tempfile.mkdtemp(prefix="tm_tpu_live_bench_")
+
+    def run_once(publish: bool) -> float:
+        try:
+            if publish:
+                live.enable(directory=base, cadence_s=0.02, rank=0)
+            t0 = time.perf_counter()
+            StreamingEvaluator(metric).run(batches)
+            elapsed = time.perf_counter() - t0
+        finally:
+            if publish:
+                live.disable()
+            metric.reset()
+        return n_samples / elapsed
+
+    timed: Dict[str, list] = {"disabled": [], "enabled": []}
+    try:
+        for _ in range(repeats):  # interleaved so drift hits both sides alike
+            timed["disabled"].append(run_once(publish=False))
+            timed["enabled"].append(run_once(publish=True))
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    disabled_med = sorted(timed["disabled"])[len(timed["disabled"]) // 2]
+    enabled_med = sorted(timed["enabled"])[len(timed["enabled"]) // 2]
+    return {
+        "runs": timed["enabled"],
+        "unit": "samples/s",
+        "baseline": None,
+        "disabled_sps": round(disabled_med, 1),
+        "ratio_vs_disabled": round(disabled_med / enabled_med, 3),
+        "cadence_s": 0.02,
+    }
+
+
 def _synth_detections(n_images, n_dets, n_gts, n_classes, seed=0):
     rng = np.random.default_rng(seed)
     preds, target = [], []

@@ -42,6 +42,14 @@ anchors; the parent test merges the two files with ``metricscope merge``
 (under a poisoned jax — the CLI must never import it) and asserts one Chrome
 timeline with both ranks' pids and sync spans.
 
+A sixth scenario, ``live``, exercises the live telemetry plane (ISSUE 7):
+each rank's ``StreamingEvaluator`` drives a replica-synced streaming run
+while a ``TelemetryPublisher`` writes atomic ``status.rank<k>.json`` files
+into the shared ``TM_TPU_PUBLISH_DIR``; after the synced run rank 1 freezes
+(stops publishing) while rank 0 keeps ticking for a while longer, so the
+parent's ``metricscope watch --once`` (under a poisoned jax) must see both
+ranks clock-aligned — and flag rank 1 as STALE via the epoch anchors.
+
 A fourth scenario, ``durable``, exercises preemption-safe evaluation
 (ISSUE 5): on each rank a ``StreamingEvaluator`` accumulates its shard of
 the stream into a per-rank ``CheckpointStore`` (``TM_TPU_STORE_DIR`` set by
@@ -340,6 +348,56 @@ def run_obs_scenario(pid: int, nproc: int) -> None:
     print(f"rank {pid}: obs trace written and synced value verified")
 
 
+def run_live_scenario(pid: int, nproc: int) -> None:
+    """Both ranks publish live status into one shared directory during a
+    replica-synced streaming run, then rank 1 deliberately freezes (stops
+    publishing) while rank 0 keeps ticking — producing exactly the on-disk
+    state ``metricscope watch`` must read as 'rank 1 went dark'."""
+    import json
+    import os
+    import time
+
+    import numpy as np
+
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.obs import live
+    from torchmetrics_tpu.robustness import StreamingEvaluator
+
+    out_dir = os.environ["TM_TPU_PUBLISH_DIR"]
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 48
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    lo, hi = (0, 30) if pid == 0 else (30, n_total)
+    batches = list(zip(np.array_split(preds[lo:hi], 6), np.array_split(target[lo:hi], 6)))
+
+    def slowish(metric, batch):
+        time.sleep(0.05)  # keeps the run alive across several publisher ticks
+        metric.update(*batch)
+
+    pub = live.enable(directory=out_dir, cadence_s=0.1, rank=pid)
+    ev = StreamingEvaluator(BinaryAccuracy(), update_fn=slowish, watchdog_timeout_s=60.0)
+    got = float(ev.run(batches))  # final compute() syncs across the group
+    ref = BinaryAccuracy(distributed_available_fn=lambda: False)
+    ref.update(preds, target)
+    assert abs(got - float(ref.compute())) < 1e-6, f"synced accuracy {got}"
+
+    if pid == 1:
+        live.disable()  # the freeze: rank 1 publishes nothing from here on
+    else:
+        time.sleep(1.5)  # rank 0's publisher keeps ticking past the freeze
+        live.disable()
+    assert pub.publish_errors == 0, f"publisher dropped {pub.publish_errors} tick(s)"
+
+    status = json.load(open(os.path.join(out_dir, f"status.rank{pid}.json")))
+    assert status["rank"] == pid and status["epoch_ns"] > 0 and status["mono_ns"] > 0
+    assert status["counters"]["runner.progress.batches"] == len(batches), status["counters"]
+    assert status["gauges"]["runner.cursor"] == len(batches), "cursor missing from the published payload"
+    assert status["gauges"]["runner.throughput.samples_per_s"] > 0
+    assert status["health"]["state"] == "ok", status["health"]
+    print(f"rank {pid}: live status published and synced value verified")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -356,6 +414,9 @@ def main() -> None:
         return
     if scenario == "obs":
         run_obs_scenario(pid, nproc)
+        return
+    if scenario == "live":
+        run_live_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

@@ -142,6 +142,43 @@ def test_two_process_trace_merge(tmp_path):
 
 
 @pytest.mark.timeout(240)
+def test_two_process_live_status_watch(tmp_path):
+    """The live plane under a REAL 2-process group (ISSUE 7 satellite): both
+    ranks publish atomic status files into one shared directory during a
+    synced streaming run, then rank 1 deliberately freezes while rank 0 keeps
+    publishing — ``metricscope watch --once`` (under a poisoned jax, the CLI
+    must never import it) sees both ranks clock-aligned and flags the frozen
+    rank as STALE via the epoch anchors."""
+    status_dir = tmp_path / "status"
+    status_dir.mkdir()
+    results = _run_workers("live", timeout=180, extra_env={"TM_TPU_PUBLISH_DIR": str(status_dir)})
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: live status published" in out, out
+
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text("raise ImportError('metricscope watch must not import jax')\n")
+    cli = str(_REPO_ROOT / "tools" / "metricscope.py")
+    result = subprocess.run(
+        [sys.executable, cli, "watch", "--once", "--stale-after", "1.0", str(status_dir)],
+        capture_output=True, text=True, timeout=60, env=dict(os.environ, PYTHONPATH=str(poison)),
+    )
+    assert result.returncode == 0, result.stderr
+    lines = result.stdout.splitlines()
+    rank_rows = {ln.split()[0]: ln for ln in lines if ln and ln.split()[0] in ("0", "1")}
+    assert set(rank_rows) == {"0", "1"}, f"watch missed a rank:\n{result.stdout}"
+    # the frozen rank is flagged stale, the live one is not, and both lanes
+    # are clock-aligned (no UNANCHORED flag anywhere)
+    assert "STALE" in rank_rows["1"], result.stdout
+    assert "STALE" not in rank_rows["0"], result.stdout
+    assert "UNANCHORED" not in result.stdout
+    # the dashboard shows real progress for both ranks (6 batches each)
+    for rank in ("0", "1"):
+        assert rank_rows[rank].split()[2] == "6", result.stdout
+
+
+@pytest.mark.timeout(240)
 def test_two_process_injected_faults():
     """The robustness layer under REAL injected faults across the group: a
     corrupt object-gather payload raises ``SyncError`` naming the rank, a

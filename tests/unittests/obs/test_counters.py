@@ -45,6 +45,30 @@ def test_clear_resets_everything():
     assert counters.snapshot() == {"counters": {}, "gauges": {}}
 
 
+def test_snapshot_include_ts_records_last_set_instants():
+    """Each gauge remembers its last-set monotonic instant so exporters can
+    flag a gauge that stopped updating; the default snapshot shape (two keys,
+    structural equality) is unchanged."""
+    import time
+
+    t0 = time.monotonic_ns()
+    counters.set_gauge("a.level", 1)
+    time.sleep(0.01)
+    counters.set_gauge("b.level", 2)
+    t1 = time.monotonic_ns()
+    snap = counters.snapshot(include_ts=True)
+    assert set(snap) == {"counters", "gauges", "gauge_ts_mono_ns"}
+    ts = snap["gauge_ts_mono_ns"]
+    assert t0 <= ts["a.level"] < ts["b.level"] <= t1
+    # re-setting refreshes the timestamp even with the same value
+    counters.set_gauge("a.level", 1)
+    assert counters.snapshot(include_ts=True)["gauge_ts_mono_ns"]["a.level"] > ts["b.level"]
+    # default shape untouched: two keys, comparable across calls
+    assert set(counters.snapshot()) == {"counters", "gauges"}
+    counters.clear()
+    assert counters.snapshot(include_ts=True)["gauge_ts_mono_ns"] == {}
+
+
 def test_concurrent_increments_do_not_lose_updates():
     n_threads, n_inc = 8, 500
 

@@ -148,6 +148,78 @@ def test_xla_and_merge_standalone_do_not_import_jax(tmp_path):
     assert {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"} == {0, 1}
 
 
+def _write_status_file(directory, rank, epoch_ns, batches=6):
+    payload = {
+        "type": "status", "status_version": 1, "seq": 2, "epoch_ns": epoch_ns, "mono_ns": 1,
+        "pid": 100 + rank, "rank": rank, "cadence_s": 0.1,
+        "counters": {"runner.progress.batches": batches, "runner.progress.samples": batches * 32},
+        "gauges": {"runner.throughput.samples_per_s": 640.0, "runner.cursor": batches},
+        "gauge_age_s": {}, "ring": {"high_water": 0, "dropped": 0},
+        "health": {"state": "ok", "reason": None, "http_status": 200},
+    }
+    with open(os.path.join(directory, f"status.rank{rank}.json"), "w") as fh:
+        json.dump(payload, fh)
+
+
+def test_watch_once_standalone_does_not_import_jax(tmp_path):
+    """ISSUE 7 satellite: inspecting live status must never import jax — a
+    poisoned jax on PYTHONPATH crashes any import, and ``watch --once`` still
+    renders both ranks and flags the frozen one as STALE."""
+    env = _poisoned_env(tmp_path)
+    status_dir = tmp_path / "status"
+    status_dir.mkdir()
+    now = 1_000_000_000_000_000_000
+    _write_status_file(str(status_dir), 0, now)
+    _write_status_file(str(status_dir), 1, now - 5_000_000_000)  # frozen 5s behind
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "watch", "--once", "--stale-after", "2.0", str(status_dir)],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    rows = {ln.split()[0]: ln for ln in result.stdout.splitlines() if ln.split()[:1] and ln.split()[0] in ("0", "1")}
+    assert set(rows) == {"0", "1"}, result.stdout
+    assert "STALE" in rows["1"] and "STALE" not in rows["0"], result.stdout
+    assert "640" in rows["0"]  # throughput column rendered
+
+
+def _write_span_trace(path, dur_scale=1.0):
+    events = [
+        {"type": "span", "name": "metric.update", "ts": i * 1000, "dur": int(1_000_000 * dur_scale),
+         "tid": 1, "depth": 0, "args": {"metric": "Accuracy"}}
+        for i in range(20)
+    ]
+    _write_min_trace(path, events)
+
+
+def test_diff_standalone_gates_regressions(tmp_path):
+    """ISSUE 7 acceptance: ``diff`` exits 0 for identical traces, exits
+    non-zero under ``--fail-on-regress`` for a synthetically slowed run, and
+    never imports jax (poisoned PYTHONPATH)."""
+    env = _poisoned_env(tmp_path)
+    a, b = str(tmp_path / "a.jsonl"), str(tmp_path / "b.jsonl")
+    _write_span_trace(a)
+    _write_span_trace(b, dur_scale=2.0)
+
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "diff", a, a, "--fail-on-regress", "20"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "+0.0" in result.stdout and "OK:" in result.stdout
+
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "diff", a, b, "--fail-on-regress", "20"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 1, result.stdout
+    assert "+100.0" in result.stdout and "REGRESSED" in result.stdout and "FAIL:" in result.stdout
+    # without the gate the same diff is informational: exit 0
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "diff", a, b], capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stdout
+
+
 def test_summary_standalone_does_not_import_jax(tmp_path):
     """The summary/chrome subcommands load obs from its files — a trace can be
     inspected on a machine (or in a shell) without paying the jax import."""

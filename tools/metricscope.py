@@ -9,6 +9,8 @@ Usage::
     python tools/metricscope.py chrome /tmp/metrics.trace.jsonl -o /tmp/trace.json
     python tools/metricscope.py xla /tmp/metrics.trace.jsonl
     python tools/metricscope.py merge rank0.jsonl rank1.jsonl -o merged.json
+    python tools/metricscope.py watch /tmp/status --interval 2
+    python tools/metricscope.py diff before.jsonl after.jsonl --fail-on-regress 20
     python tools/metricscope.py demo -o /tmp/metrics.trace.jsonl
 
 ``summary`` prints the per-metric/per-phase span table (count, total/mean and
@@ -20,9 +22,17 @@ estimated device cost — compile/lowering wall time plus the backend's own
 flops / bytes-accessed analysis, captured at every cold ``make_jit_update``/
 ``sharded_update`` build. ``merge`` fuses per-rank trace files into ONE
 Chrome timeline (pid = rank, clocks aligned via each file's export epoch) so
-a multi-process run reads as a single picture. ``demo`` records a trace from
-a small jitted + synced ``MetricCollection`` run and writes it — a
-self-contained way to see the whole pipeline.
+a multi-process run reads as a single picture. ``watch`` renders the LIVE
+plane: a terminal dashboard over the ``status.rank<k>.json`` files a
+``TM_TPU_PUBLISH=<dir>`` run's publisher writes — per-rank throughput,
+progress, health and watchdog margin, with stale-rank detection via the
+payloads' wall-clock anchors (``--once`` prints a single frame and exits).
+``diff`` compares two recorded traces span by span (count, p50, p95 deltas
+per ``(metric, span)`` row) and, with ``--fail-on-regress <pct>``, exits
+non-zero when any common span slowed beyond the threshold — a CI perf gate
+over ordinary trace files. ``demo`` records a trace from a small jitted +
+synced ``MetricCollection`` run and writes it — a self-contained way to see
+the whole pipeline.
 
 Record a trace in your own run with ``TM_TPU_TRACE=1`` (then call
 ``torchmetrics_tpu.obs.write_jsonl(path)``) or the ``obs.tracing()`` context
@@ -147,6 +157,43 @@ def _cmd_merge(args) -> int:
     return 0
 
 
+def _cmd_watch(args) -> int:
+    import time
+
+    obs = _load_obs_module()
+    while True:
+        try:
+            statuses = obs.live.read_status_dir(args.directory)
+        except FileNotFoundError as err:
+            print(err, file=sys.stderr)
+            return 1
+        frame = obs.live.format_watch_table(statuses, stale_after_s=args.stale_after)
+        if args.once:
+            print(frame)
+            return 0
+        # one ANSI clear per frame, then the dashboard — a poor man's top(1)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+def _cmd_diff(args) -> int:
+    obs = _load_obs_module()
+    events_a, _c, _g, meta_a = obs.read_jsonl(args.trace_a)
+    events_b, _c, _g, meta_b = obs.read_jsonl(args.trace_b)
+    for label, meta, path in (("a", meta_a, args.trace_a), ("b", meta_b, args.trace_b)):
+        if meta.get("dropped"):
+            print(f"WARNING: trace {label} ({path}) dropped {meta['dropped']} event(s) — deltas may be partial")
+    rows = obs.diff_aggregates(obs.aggregate(events_a), obs.aggregate(events_b))
+    text, regressions = obs.format_diff_table(rows, fail_on_regress_pct=args.fail_on_regress)
+    print(f"a = {args.trace_a}\nb = {args.trace_b}  (positive Δ% = b slower)")
+    print(text)
+    return 1 if regressions else 0
+
+
 def _cmd_demo(args) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if _REPO_ROOT not in sys.path:  # script lives in tools/; import the repo package
@@ -177,6 +224,25 @@ def main(argv=None) -> int:
     p_merge.add_argument("traces", nargs="+", help="per-rank JSON-lines trace files, rank-0 first")
     p_merge.add_argument("-o", "--output", default=None, help="output path (default: merged.chrome.json)")
     p_merge.set_defaults(fn=_cmd_merge)
+
+    p_watch = sub.add_parser("watch", help="live dashboard over a TM_TPU_PUBLISH status-file directory")
+    p_watch.add_argument("directory", help="directory the publisher writes status.rank<k>.json files into")
+    p_watch.add_argument("--once", action="store_true", help="print one frame and exit (scripts/tests)")
+    p_watch.add_argument("--interval", type=float, default=2.0, help="refresh period in seconds (default 2)")
+    p_watch.add_argument(
+        "--stale-after", type=float, default=10.0,
+        help="flag a rank STALE when its last status is this many seconds behind the newest rank's (default 10)",
+    )
+    p_watch.set_defaults(fn=_cmd_watch)
+
+    p_diff = sub.add_parser("diff", help="span-level p50/p95/count regression table between two trace files")
+    p_diff.add_argument("trace_a", help="baseline JSON-lines trace file")
+    p_diff.add_argument("trace_b", help="candidate JSON-lines trace file (positive deltas = slower than a)")
+    p_diff.add_argument(
+        "--fail-on-regress", type=float, default=None, metavar="PCT",
+        help="exit 1 when any common span's p50 or p95 slowed more than PCT percent (CI perf gate)",
+    )
+    p_diff.set_defaults(fn=_cmd_diff)
 
     p_demo = sub.add_parser("demo", help="record a demo trace from a jitted + synced MetricCollection run")
     p_demo.add_argument("-o", "--output", default="/tmp/metrics.trace.jsonl", help="trace file to write")

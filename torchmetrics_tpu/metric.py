@@ -45,6 +45,7 @@ from torchmetrics_tpu.utilities.data import (
 from torchmetrics_tpu._reduction_names import VALID_REDUCTION_NAMES
 from torchmetrics_tpu.obs import counters as _obs_counters
 from torchmetrics_tpu.obs import device as _obs_device
+from torchmetrics_tpu.obs import live as _obs_live
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness import faults
 from torchmetrics_tpu.sketch.registry import is_sketch_state, merge_states, reduce_merge_states
@@ -718,7 +719,10 @@ class Metric:
             try:
                 if faults._ACTIVE:
                     faults.fire("sync.attempt")
-                if _obs_trace.ENABLED:
+                # the sync health counters also feed the live plane's
+                # liveness derivation (obs/live.py), so they fire when EITHER
+                # recorder is on — still nothing on the all-off default path
+                if _obs_trace.ENABLED or _obs_live.ENABLED:
                     _obs_counters.inc("metric.sync.attempt")
                 self._sync_dist_bounded(dist_sync_fn, group, cfg.timeout_s)
                 self._is_synced = True
@@ -728,8 +732,9 @@ class Metric:
                 # fresh list copies so a later attempt cannot alias the cache
                 self._install_state_tree({k: list(v) if isinstance(v, list) else v for k, v in self._cache.items()})
                 last_err = err
-                if _obs_trace.ENABLED:
+                if _obs_trace.ENABLED or _obs_live.ENABLED:
                     _obs_counters.inc("metric.sync.rollback")
+                if _obs_trace.ENABLED:
                     _obs_trace.instant(
                         "metric.sync.rollback",
                         metric=type(self).__name__,
@@ -746,8 +751,9 @@ class Metric:
                     time.sleep(backoff_s)
         self._cache = None
         if cfg.on_error == "local":
-            if _obs_trace.ENABLED:
+            if _obs_trace.ENABLED or _obs_live.ENABLED:
                 _obs_counters.inc("metric.sync.degrade")
+            if _obs_trace.ENABLED:
                 _obs_trace.instant(
                     "metric.sync.degrade",
                     metric=type(self).__name__,
@@ -760,8 +766,9 @@ class Metric:
                 SyncWarning,
             )
             return
-        if _obs_trace.ENABLED:
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
             _obs_counters.inc("metric.sync.failure")
+        if _obs_trace.ENABLED:
             _obs_trace.instant(
                 "metric.sync.failure",
                 metric=type(self).__name__,

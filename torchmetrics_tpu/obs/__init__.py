@@ -3,8 +3,11 @@
 """``torchmetrics_tpu.obs`` — opt-in, near-zero-overhead-when-disabled
 observability: nestable spans into a bounded ring buffer
 (:mod:`~torchmetrics_tpu.obs.trace`), named monotonic counters and gauges
-(:mod:`~torchmetrics_tpu.obs.counters`), JSON-lines / Chrome-trace export and
-per-metric summaries (:mod:`~torchmetrics_tpu.obs.export`).
+(:mod:`~torchmetrics_tpu.obs.counters`), JSON-lines / Chrome-trace export,
+per-metric summaries and span-level trace diffs
+(:mod:`~torchmetrics_tpu.obs.export`), and the live plane — a background
+status/OpenMetrics publisher with health derivation
+(:mod:`~torchmetrics_tpu.obs.live`, :mod:`~torchmetrics_tpu.obs.openmetrics`).
 
 Quick start::
 
@@ -21,6 +24,8 @@ This package is standalone (no jax import) so tooling can load it without
 paying the full library import.
 """
 from . import counters as _counters_mod
+from . import live as live
+from . import openmetrics as openmetrics
 from . import trace as _trace_mod
 from . import xla as _xla_mod
 from .counters import clear as counter_clear
@@ -29,12 +34,15 @@ from .counters import inc as counter_inc
 from .counters import set_gauge, snapshot
 from .export import (
     aggregate,
+    diff_aggregates,
+    format_diff_table,
     read_jsonl,
     summarize,
     to_chrome_trace,
     write_chrome_trace,
     write_jsonl,
 )
+from .live import publishing
 from .merge import merge_traces, write_merged_chrome_trace
 from .trace import (
     configure,
@@ -75,15 +83,20 @@ __all__ = [
     "counter_clear",
     "counter_get",
     "counter_inc",
+    "diff_aggregates",
     "disable",
     "dropped_events",
     "enable",
     "format_compile_table",
+    "format_diff_table",
     "get_trace",
     "high_water",
     "instant",
     "is_enabled",
+    "live",
     "merge_traces",
+    "openmetrics",
+    "publishing",
     "read_jsonl",
     "set_gauge",
     "snapshot",

@@ -53,11 +53,13 @@ jax) and thread-safe.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Union
 
 _lock = threading.Lock()
 _counters: Dict[str, int] = {}
 _gauges: Dict[str, float] = {}
+_gauge_ts: Dict[str, int] = {}  # name -> monotonic ns of the last set_gauge
 
 
 def inc(name: str, n: int = 1) -> None:
@@ -70,6 +72,7 @@ def set_gauge(name: str, value: Union[int, float]) -> None:
     """Set the gauge ``name`` to its latest observed value."""
     with _lock:
         _gauges[name] = value
+        _gauge_ts[name] = time.monotonic_ns()
 
 
 def get(name: str) -> int:
@@ -78,18 +81,30 @@ def get(name: str) -> int:
         return _counters.get(name, 0)
 
 
-def snapshot() -> Dict[str, Dict[str, Union[int, float]]]:
+def snapshot(include_ts: bool = False) -> Dict[str, Dict[str, Union[int, float]]]:
     """Stable point-in-time copy: ``{"counters": {...}, "gauges": {...}}``,
-    keys sorted so repeated snapshots of the same state compare equal."""
+    keys sorted so repeated snapshots of the same state compare equal.
+
+    ``include_ts=True`` adds a third key ``"gauge_ts_mono_ns"`` mapping each
+    gauge to the ``time.monotonic_ns()`` instant of its last ``set_gauge``
+    call, so exporters (OpenMetrics, ``metricscope watch``) can flag a gauge
+    that stopped updating instead of rendering its dead value as live. The
+    default two-key shape is unchanged — existing consumers compare
+    snapshots structurally.
+    """
     with _lock:
-        return {
+        snap: Dict[str, Dict[str, Union[int, float]]] = {
             "counters": {k: _counters[k] for k in sorted(_counters)},
             "gauges": {k: _gauges[k] for k in sorted(_gauges)},
         }
+        if include_ts:
+            snap["gauge_ts_mono_ns"] = {k: _gauge_ts[k] for k in sorted(_gauge_ts)}
+        return snap
 
 
 def clear() -> None:
-    """Reset every counter and gauge."""
+    """Reset every counter and gauge (and the gauge timestamps)."""
     with _lock:
         _counters.clear()
         _gauges.clear()
+        _gauge_ts.clear()

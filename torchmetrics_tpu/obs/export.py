@@ -151,6 +151,19 @@ def write_chrome_trace(path: str, events: Optional[List[Dict[str, Any]]] = None,
 # ----------------------------------------------------------------- summary
 
 
+def render_table(rows: Sequence[Tuple[str, ...]]) -> List[str]:
+    """Column-aligned text lines for a header + data rows, with a dash rule
+    under the header — THE table renderer every obs/CLI view shares
+    (``summary``, ``diff``, ``xla``, ``watch``)."""
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    lines = []
+    for i, row in enumerate(rows):
+        lines.append("  ".join(cell.ljust(widths[j]) for j, cell in enumerate(row)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return lines
+
+
 def _percentile(sorted_ns: Sequence[int], q: float) -> float:
     """Nearest-rank percentile over a pre-sorted duration list (ns)."""
     idx = min(len(sorted_ns) - 1, max(0, int(round(q * (len(sorted_ns) - 1)))))
@@ -196,6 +209,97 @@ def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return rows
 
 
+def diff_aggregates(
+    rows_a: List[Dict[str, Any]], rows_b: List[Dict[str, Any]]
+) -> List[Dict[str, Any]]:
+    """Span-level regression diff between two :func:`aggregate` outputs.
+
+    Joins on ``(metric, span)``. Each joined row carries both sides' count/
+    p50/p95 plus signed percentage deltas (``b`` relative to ``a`` — positive
+    means ``b`` is slower); rows present on one side only get ``status``
+    ``"added"``/``"removed"`` with null deltas, so a diff surfaces a span
+    that disappeared (instrumentation drift) as loudly as one that slowed.
+    Sorted by worst regression first.
+    """
+    by_key_a = {(r["metric"], r["span"]): r for r in rows_a}
+    by_key_b = {(r["metric"], r["span"]): r for r in rows_b}
+
+    def _delta_pct(a: float, b: float) -> Optional[float]:
+        if a <= 0:
+            return None  # zero-duration base: a ratio would be meaningless
+        return (b - a) / a * 100.0
+
+    rows = []
+    for key in sorted(set(by_key_a) | set(by_key_b)):
+        a, b = by_key_a.get(key), by_key_b.get(key)
+        row: Dict[str, Any] = {"metric": key[0], "span": key[1]}
+        if a is None or b is None:
+            row.update(
+                status="added" if a is None else "removed",
+                count_a=a["count"] if a else None, count_b=b["count"] if b else None,
+                p50_a_ms=a["p50_ms"] if a else None, p50_b_ms=b["p50_ms"] if b else None,
+                p95_a_ms=a["p95_ms"] if a else None, p95_b_ms=b["p95_ms"] if b else None,
+                p50_delta_pct=None, p95_delta_pct=None,
+            )
+        else:
+            row.update(
+                status="common",
+                count_a=a["count"], count_b=b["count"],
+                p50_a_ms=a["p50_ms"], p50_b_ms=b["p50_ms"],
+                p95_a_ms=a["p95_ms"], p95_b_ms=b["p95_ms"],
+                p50_delta_pct=_delta_pct(a["p50_ms"], b["p50_ms"]),
+                p95_delta_pct=_delta_pct(a["p95_ms"], b["p95_ms"]),
+            )
+        rows.append(row)
+    rows.sort(
+        key=lambda r: -max(r["p50_delta_pct"] or float("-inf"), r["p95_delta_pct"] or float("-inf"))
+        if r["status"] == "common" else float("inf")
+    )
+    return rows
+
+
+def format_diff_table(rows: List[Dict[str, Any]], fail_on_regress_pct: Optional[float] = None) -> Tuple[str, List[Dict[str, Any]]]:
+    """Render a :func:`diff_aggregates` result; returns ``(text, regressions)``
+    where ``regressions`` are the common rows whose p50 OR p95 delta exceeds
+    ``fail_on_regress_pct`` (empty when no threshold given) — the CI gate
+    ``metricscope diff --fail-on-regress`` exits non-zero on."""
+    header = ("metric", "span", "count", "p50_a_ms", "p50_b_ms", "p50_Δ%", "p95_a_ms", "p95_b_ms", "p95_Δ%", "status")
+
+    def _fmt(v: Optional[float], pattern: str = "{:.3f}") -> str:
+        return "-" if v is None else pattern.format(v)
+
+    regressions = []
+    table = [header]
+    for r in rows:
+        regressed = (
+            fail_on_regress_pct is not None
+            and r["status"] == "common"
+            and max(r["p50_delta_pct"] or float("-inf"), r["p95_delta_pct"] or float("-inf")) > fail_on_regress_pct
+        )
+        if regressed:
+            regressions.append(r)
+        count = f"{r['count_a'] if r['count_a'] is not None else '-'}/{r['count_b'] if r['count_b'] is not None else '-'}"
+        table.append((
+            r["metric"], r["span"], count,
+            _fmt(r["p50_a_ms"]), _fmt(r["p50_b_ms"]), _fmt(r["p50_delta_pct"], "{:+.1f}"),
+            _fmt(r["p95_a_ms"]), _fmt(r["p95_b_ms"]), _fmt(r["p95_delta_pct"], "{:+.1f}"),
+            r["status"] + (" REGRESSED" if regressed else ""),
+        ))
+    lines = render_table(table)
+    if fail_on_regress_pct is not None:
+        lines.append("")
+        if regressions:
+            worst = ", ".join(
+                f"{r['metric']}/{r['span']} "
+                f"(+{max(r['p50_delta_pct'] or float('-inf'), r['p95_delta_pct'] or float('-inf')):.1f}%)"
+                for r in regressions[:5]
+            )
+            lines.append(f"FAIL: {len(regressions)} span(s) regressed beyond {fail_on_regress_pct:.1f}%: {worst}")
+        else:
+            lines.append(f"OK: no span regressed beyond {fail_on_regress_pct:.1f}%")
+    return "\n".join(lines), regressions
+
+
 def summarize(events: List[Dict[str, Any]], counters: Optional[Dict[str, Any]] = None,
               gauges: Optional[Dict[str, Any]] = None, dropped: int = 0) -> str:
     """Render the per-metric/per-phase summary table plus counters as text.
@@ -211,16 +315,12 @@ def summarize(events: List[Dict[str, Any]], counters: Optional[Dict[str, Any]] =
          f"{r['p50_ms']:.3f}", f"{r['p95_ms']:.3f}", f"{r['max_ms']:.3f}")
         for r in rows
     ]
-    widths = [max(len(row[i]) for row in table) for i in range(len(header))]
     lines = []
     if dropped:
         lines.append(f"WARNING: {dropped} event(s) dropped by the bounded ring buffer — totals are partial"
                      " (raise TM_TPU_TRACE_BUFFER)")
         lines.append("")
-    for i, row in enumerate(table):
-        lines.append("  ".join(cell.ljust(widths[j]) for j, cell in enumerate(row)).rstrip())
-        if i == 0:
-            lines.append("  ".join("-" * w for w in widths))
+    lines.extend(render_table(table))
     if not rows:
         lines.append("(no spans recorded)")
 

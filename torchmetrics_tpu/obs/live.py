@@ -1,0 +1,599 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Live operational telemetry: background publisher + status-file/HTTP sinks.
+
+Spans and counters (PR 3) drain to files you inspect AFTER a run; the device
+plane (PR 6) materializes at compute/sync. A multi-hour
+:class:`~torchmetrics_tpu.robustness.runner.StreamingEvaluator` pass on a
+preemptible fleet is a black box while it is ALIVE. This module adds the
+live plane: an opt-in background :class:`TelemetryPublisher` thread that,
+every ``cadence_s``, snapshots the counter/gauge registry (plus the span
+ring's high-water/drop accounting and any registered :func:`probes <
+register_probe>`) and publishes it two ways:
+
+- **status files** — one atomic ``status.rank<k>.json`` per tick
+  (temp + fsync + ``os.replace``, the ``store_format.py`` idiom) carrying the
+  PR-6 ``epoch_ns``/``mono_ns``/``pid``/``rank`` meta anchors, so
+  ``metricscope watch <dir>`` can aggregate a whole fleet's files
+  clock-aligned and flag a rank that stopped publishing;
+- **HTTP** — an optional stdlib ``http.server`` endpoint (localhost by
+  default) serving ``/metrics`` in OpenMetrics text format
+  (:mod:`~torchmetrics_tpu.obs.openmetrics`) and ``/healthz`` JSON whose
+  HTTP status matches the derived liveness state.
+
+**Liveness states** (:func:`derive_health`): ``ok`` | ``stalling`` |
+``degraded`` | ``stalled``, derived from the runner's live watchdog margin
+(sampled through a probe, so it decays in real time DURING a stalled update
+— ``/healthz`` flips to ``stalled`` before ``StallError`` is even raised)
+and the fault-tolerant sync's degrade/failure counters.
+
+**Disabled-path contract** (same discipline as ``trace.ENABLED``): off — the
+default — there is NO publisher thread and every producer call site is one
+module-flag check with nothing allocated behind it. Opt in with
+``TM_TPU_PUBLISH=<dir-or-host:port>`` in the environment (the runner checks
+it once at construction) or scoped with :func:`publishing`.
+
+Standalone (stdlib only, no jax import) like the rest of the obs package, so
+``metricscope watch`` renders status files without paying the library import.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from . import counters as _counters
+from . import openmetrics as _openmetrics
+from . import trace as _trace
+from .export import render_table as _render_table
+
+#: THE flag every producer call site checks (``if live.ENABLED:``). True only
+#: while a publisher is running; flip via enable()/disable()/publishing().
+ENABLED: bool = False
+
+#: status-payload layout version
+STATUS_VERSION = 1
+
+_DEFAULT_CADENCE_S = 1.0
+
+#: watchdog-margin fractions the health derivation switches on: below
+#: ``_STALLING_FRACTION`` of the deadline remaining the run is "stalling",
+#: below ``_STALLED_FRACTION`` it is "stalled" — strictly before the margin
+#: hits zero and ``StallError`` fires, so an external scraper sees the stall
+#: while the process can still be inspected.
+_STALLING_FRACTION = 0.5
+_STALLED_FRACTION = 0.1
+
+#: liveness state -> HTTP status for /healthz. ``stalling`` stays 200 (the
+#: run is still making progress — it is an early warning, not a failure);
+#: ``degraded`` (sync fell back to local-only state: reported values no
+#: longer cover the fleet) and ``stalled`` are 503 so load-balancer-style
+#: checks fail fast.
+HEALTH_HTTP_STATUS = {"ok": 200, "stalling": 200, "degraded": 503, "stalled": 503}
+
+_STATUS_RE = re.compile(r"^status\.rank(-?\d+)\.json$")
+
+_lock = threading.Lock()
+_probes: Dict[str, Callable[[], Dict[str, float]]] = {}
+_publisher: Optional["TelemetryPublisher"] = None
+_env_checked = False
+
+
+# ------------------------------------------------------------------- probes
+
+
+def register_probe(name: str, fn: Callable[[], Dict[str, float]]) -> None:
+    """Register a live gauge source: ``fn()`` returns ``{gauge_name: value}``
+    and is called on every publisher tick AND every ``/metrics``/``/healthz``
+    request — unlike ``set_gauge`` values (which age between sets), a probe
+    is always current. Last registration per name wins."""
+    with _lock:
+        _probes[name] = fn
+
+
+def unregister_probe(name: str) -> None:
+    with _lock:
+        _probes.pop(name, None)
+
+
+def probes() -> List[str]:
+    """Names of the registered probes (for tests/diagnostics)."""
+    with _lock:
+        return sorted(_probes)
+
+
+def sample_probes() -> Dict[str, float]:
+    """One merged gauge dict from every registered probe. A raising probe is
+    skipped and counted (``obs.live.probe_errors``) — the publisher thread
+    must never die on a producer's bug."""
+    with _lock:
+        items = list(_probes.items())
+    merged: Dict[str, float] = {}
+    for _name, fn in items:
+        try:
+            merged.update(fn())
+        except Exception:
+            _counters.inc("obs.live.probe_errors")
+    return merged
+
+
+# ------------------------------------------------------------------- health
+
+
+#: severity ladder — the derived state is the MOST severe signal, so a
+#: degraded run (a latched condition: the counters never reset) can never be
+#: reported healthier than "degraded" just because a long-but-fine step dips
+#: into the stalling window: /healthz must not flap 503 -> 200 -> 503
+_SEVERITY = {"ok": 0, "stalling": 1, "degraded": 2, "stalled": 3}
+
+
+def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[str, Any]:
+    """Liveness state from a counter/gauge snapshot (see the module table).
+
+    Severity-monotone: ``stalled`` > ``degraded`` > ``stalling`` > ``ok``.
+    """
+    margin = gauges.get("runner.watchdog.margin_s")
+    timeout = gauges.get("runner.watchdog.timeout_s")
+    state, reason = "ok", None
+
+    def escalate(candidate: str, why: str) -> None:
+        nonlocal state, reason
+        if _SEVERITY[candidate] > _SEVERITY[state]:
+            state, reason = candidate, why
+
+    degrades = counters.get("metric.sync.degrade", 0)
+    failures = counters.get("metric.sync.failure", 0)
+    stalls = counters.get("runner.watchdog_stall", 0)
+    if margin is not None and timeout:
+        fraction = margin / timeout
+        if fraction <= _STALLED_FRACTION:
+            escalate("stalled", f"watchdog margin {margin:.3f}s of {timeout:.3f}s — the in-flight step has stalled")
+        elif fraction <= _STALLING_FRACTION:
+            escalate("stalling", f"watchdog margin {margin:.3f}s of {timeout:.3f}s is shrinking")
+    if degrades or failures:
+        escalate(
+            "degraded",
+            f"sync degraded {degrades} time(s), failed {failures} time(s) — values may be local-only",
+        )
+    if stalls:
+        escalate("stalled", f"watchdog raised StallError {stalls} time(s)")
+    return {"state": state, "reason": reason, "http_status": HEALTH_HTTP_STATUS[state]}
+
+
+# ------------------------------------------------------- file-sink plumbing
+
+
+def status_filename(rank: int) -> str:
+    return f"status.rank{int(rank)}.json"
+
+
+def _atomic_write_bytes(path: str, data: bytes) -> None:
+    # store_format.atomic_write's idiom, re-implemented so obs stays a
+    # standalone package: temp sibling + fsync + os.replace — a reader (or a
+    # concurrent `metricscope watch`) never observes a torn status file
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp-", dir=directory)
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _detect_rank() -> int:  # metriclint: disable=ML002 -- host-side process index, never traced: obs runs no jit code
+    """Process rank WITHOUT importing jax: use it only when the host program
+    already did (the obs package must stay importable standalone)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            return int(jax.process_index())
+        except Exception:
+            pass
+    try:
+        return int(os.environ.get("TM_TPU_RANK", "0"))
+    except ValueError:
+        return 0
+
+
+# ---------------------------------------------------------------- publisher
+
+
+class TelemetryPublisher:
+    """Background thread publishing periodic status snapshots.
+
+    Args:
+        directory: file sink — one atomic ``status.rank<k>.json`` per tick
+            (``None`` disables the file sink).
+        http: HTTP sink — ``"host:port"`` / ``":port"`` / bare port int,
+            default host ``127.0.0.1``, port 0 binds an ephemeral port
+            (``None`` disables the HTTP sink). Serves ``/metrics``
+            (OpenMetrics) and ``/healthz`` (JSON, status-mapped).
+        cadence_s: tick period for the file sink (HTTP renders on demand).
+        rank: process rank for the file name and the ``rank`` label;
+            default auto-detects (jax process index if jax is already
+            imported, else ``TM_TPU_RANK``, else 0).
+    """
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        http: Optional[Any] = None,
+        cadence_s: float = _DEFAULT_CADENCE_S,
+        rank: Optional[int] = None,
+    ) -> None:
+        if directory is None and http is None:
+            raise ValueError("TelemetryPublisher needs a directory and/or an http address")
+        if cadence_s <= 0:
+            raise ValueError(f"cadence_s must be > 0, got {cadence_s}")
+        self.directory = None if directory is None else str(directory)
+        self.cadence_s = float(cadence_s)
+        self.rank = _detect_rank() if rank is None else int(rank)
+        self.seq = 0
+        self.publish_errors = 0
+        self._http_spec = http
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+        self._server_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- payloads
+    def status(self) -> Dict[str, Any]:
+        """One self-contained status payload: registry snapshot + live probe
+        samples + ring accounting + derived health, anchored with the same
+        ``epoch_ns``/``mono_ns``/``pid``/``rank`` meta fields trace exports
+        carry — so multi-rank aggregation clock-aligns status files exactly
+        like trace merges."""
+        snap = _counters.snapshot(include_ts=True)
+        mono_ns = time.monotonic_ns()
+        gauge_age_s = {
+            name: max(0.0, (mono_ns - ts) / 1e9) for name, ts in snap.get("gauge_ts_mono_ns", {}).items()
+        }
+        live_gauges = sample_probes()
+        gauges = {**snap["gauges"], **live_gauges}
+        for name in live_gauges:
+            gauge_age_s[name] = 0.0  # probes are sampled at publish time
+        health = derive_health(snap["counters"], gauges)
+        return {
+            "type": "status",
+            "status_version": STATUS_VERSION,
+            "seq": self.seq,
+            "epoch_ns": time.time_ns(),
+            "mono_ns": time.perf_counter_ns(),
+            "pid": os.getpid(),
+            "rank": self.rank,
+            "cadence_s": self.cadence_s,
+            "counters": snap["counters"],
+            "gauges": gauges,
+            "gauge_age_s": gauge_age_s,
+            "ring": {"high_water": _trace.high_water(), "dropped": _trace.dropped_events()},
+            "health": health,
+        }
+
+    def health(self) -> Dict[str, Any]:
+        """Fresh liveness derivation (probes sampled now), plus the runner's
+        cursor when a runner probe is live — the ``/healthz`` body."""
+        snap = _counters.snapshot()
+        gauges = {**snap["gauges"], **sample_probes()}
+        health = derive_health(snap["counters"], gauges)
+        health["rank"] = self.rank
+        health["seq"] = self.seq
+        if "runner.cursor" in gauges:
+            health["cursor"] = int(gauges["runner.cursor"])
+        return health
+
+    def render_metrics(self) -> str:
+        """The current registry + probes as one OpenMetrics exposition."""
+        payload = self.status()
+        now_epoch_s = payload["epoch_ns"] / 1e9
+        gauge_epoch_s = {k: now_epoch_s - age for k, age in payload["gauge_age_s"].items()}
+        counters = dict(payload["counters"])
+        gauges = dict(payload["gauges"])
+        # the derived health state rides along as a numeric gauge so scrapers
+        # can alert on it: 0 ok, 1 stalling, 2 degraded, 3 stalled
+        state_code = {"ok": 0, "stalling": 1, "degraded": 2, "stalled": 3}[payload["health"]["state"]]
+        gauges["obs.live.health_state"] = state_code
+        gauges["obs.live.seq"] = payload["seq"]
+        # the SAME name trace exports publish as a registry gauge — assigning
+        # (not adding a spelled-differently twin) overwrites any stale copy,
+        # so the exposition never carries duplicate samples of one family
+        gauges["obs.trace.ring_high_water"] = payload["ring"]["high_water"]
+        gauge_epoch_s["obs.trace.ring_high_water"] = now_epoch_s
+        counters["obs.trace.ring_dropped"] = payload["ring"]["dropped"]
+        return _openmetrics.render(counters, gauges, labels={"rank": str(self.rank)}, gauge_epoch_s=gauge_epoch_s)
+
+    # ------------------------------------------------------------ lifecycle
+    def tick(self) -> Dict[str, Any]:
+        """Publish one status snapshot now (the loop calls this per cadence)."""
+        payload = self.status()
+        self.seq += 1
+        if self.directory is not None:
+            data = json.dumps(payload, separators=(",", ":")).encode()
+            try:
+                _atomic_write_bytes(os.path.join(self.directory, status_filename(self.rank)), data)
+            except OSError:
+                self.publish_errors += 1
+        return payload
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cadence_s):
+            try:
+                self.tick()
+            except Exception:
+                self.publish_errors += 1  # the publisher thread must outlive any tick bug
+
+    def start(self) -> "TelemetryPublisher":
+        if self.directory is not None:
+            os.makedirs(self.directory, exist_ok=True)
+        if self._http_spec is not None:
+            self._start_http(self._http_spec)
+        self.tick()  # an immediate first snapshot: the file exists before the first cadence
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="tm-tpu-telemetry-publisher")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the thread (one final flush tick so the status file carries
+        the end-of-run state) and shut the HTTP server down."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        try:
+            self.tick()
+        except Exception:
+            self.publish_errors += 1
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            if self._server_thread is not None:
+                self._server_thread.join(timeout=10.0)
+            self._server = None
+            self._server_thread = None
+
+    # ----------------------------------------------------------------- http
+    @property
+    def http_address(self) -> Optional[Tuple[str, int]]:
+        """``(host, port)`` actually bound (port 0 resolves here), or None."""
+        if self._server is None:
+            return None
+        return self._server.server_address[:2]
+
+    def _start_http(self, spec: Any) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        host, port = _parse_http_spec(spec)
+        publisher = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: Any) -> None:  # silence per-request stderr
+                pass
+
+            def _send(self, code: int, content_type: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, _openmetrics.CONTENT_TYPE, publisher.render_metrics().encode())
+                    elif path == "/healthz":
+                        health = publisher.health()
+                        self._send(health["http_status"], "application/json", json.dumps(health).encode())
+                    else:
+                        self._send(404, "text/plain", b"metricscope live plane: /metrics or /healthz\n")
+                except Exception:
+                    publisher.publish_errors += 1
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._server_thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="tm-tpu-telemetry-http"
+        )
+        self._server_thread.start()
+
+
+def _parse_http_spec(spec: Any) -> Tuple[str, int]:
+    if isinstance(spec, int):
+        return "127.0.0.1", spec
+    text = str(spec)
+    host, _, port_s = text.rpartition(":")
+    return host or "127.0.0.1", int(port_s)
+
+
+# --------------------------------------------------------- module lifecycle
+
+
+def enable(
+    directory: Optional[str] = None,
+    http: Optional[Any] = None,
+    cadence_s: float = _DEFAULT_CADENCE_S,
+    rank: Optional[int] = None,
+) -> TelemetryPublisher:
+    """Start THE process publisher and flip :data:`ENABLED`. One publisher
+    per process: enabling twice replaces the first (stopping it)."""
+    global ENABLED, _publisher
+    disable()
+    _publisher = TelemetryPublisher(directory=directory, http=http, cadence_s=cadence_s, rank=rank).start()
+    ENABLED = True
+    return _publisher
+
+
+def disable() -> None:
+    """Stop the publisher (final flush included) and clear :data:`ENABLED`."""
+    global ENABLED, _publisher
+    ENABLED = False
+    if _publisher is not None:
+        publisher, _publisher = _publisher, None
+        publisher.stop()
+
+
+def publisher() -> Optional[TelemetryPublisher]:
+    return _publisher
+
+
+def is_enabled() -> bool:
+    return ENABLED
+
+
+@contextmanager
+def publishing(
+    directory: Optional[str] = None,
+    http: Optional[Any] = None,
+    cadence_s: float = _DEFAULT_CADENCE_S,
+    rank: Optional[int] = None,
+) -> Iterator[TelemetryPublisher]:
+    """Scoped live publishing: ``with obs.publishing("/tmp/status"): ev.run(...)``."""
+    pub = enable(directory=directory, http=http, cadence_s=cadence_s, rank=rank)
+    try:
+        yield pub
+    finally:
+        disable()
+
+
+def maybe_enable_from_env() -> Optional[TelemetryPublisher]:
+    """Honor ``TM_TPU_PUBLISH=<dir-or-host:port>`` exactly once per process.
+
+    A value shaped like ``host:port`` / ``:port`` becomes the HTTP sink;
+    anything else is the status-file directory. ``TM_TPU_PUBLISH_CADENCE_S``
+    overrides the tick period. Called by producers at construction time
+    (NOT at import: starting a thread from an import is a side effect the
+    obs package must not have) — the repeated-call cost is one bool check.
+    """
+    global _env_checked
+    if _env_checked or ENABLED:
+        return _publisher
+    _env_checked = True
+    value = os.environ.get("TM_TPU_PUBLISH", "").strip()
+    if not value:
+        return None
+    try:
+        cadence_s = float(os.environ.get("TM_TPU_PUBLISH_CADENCE_S", str(_DEFAULT_CADENCE_S)))
+    except ValueError:
+        cadence_s = _DEFAULT_CADENCE_S
+    if re.match(r"^[^/\\]*:\d+$", value):
+        return enable(http=value, cadence_s=cadence_s)
+    return enable(directory=value, cadence_s=cadence_s)
+
+
+# ------------------------------------------------------------ watch consumer
+
+
+def read_status_dir(directory: str) -> List[Dict[str, Any]]:
+    """Parse every ``status.rank<k>.json`` in ``directory``, sorted by rank.
+
+    Unparseable files are skipped with a ``_problem`` placeholder row rather
+    than hiding a rank that IS publishing, however damaged.
+    """
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError as err:
+        raise FileNotFoundError(f"cannot read status directory {directory}: {err}") from err
+    statuses: List[Dict[str, Any]] = []
+    for name in names:
+        match = _STATUS_RE.match(name)
+        if not match:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as fh:
+                payload = json.load(fh)
+            if not isinstance(payload, dict):
+                raise ValueError(f"payload is a {type(payload).__name__}")
+        except (OSError, ValueError) as err:
+            statuses.append({"rank": int(match.group(1)), "_problem": str(err), "_path": path})
+            continue
+        payload.setdefault("rank", int(match.group(1)))
+        payload["_path"] = path
+        statuses.append(payload)
+    statuses.sort(key=lambda s: s.get("rank", 0))
+    return statuses
+
+
+def _fmt_num(value: Any, pattern: str = "{:.1f}") -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, int) or (isinstance(value, float) and value.is_integer() and abs(value) < 1e12):
+        return str(int(value))
+    return pattern.format(value)
+
+
+def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10.0) -> str:
+    """Render the per-rank dashboard ``metricscope watch`` prints.
+
+    Stale-rank detection is **fleet-relative** via the payloads' ``epoch_ns``
+    wall-clock anchors: a rank whose last status is more than
+    ``stale_after_s`` behind the NEWEST rank's has stopped publishing while
+    the fleet moved on — flagged ``STALE`` (comparing against the viewer's
+    own clock would flag every rank of a finished run). The footer reports
+    how long ago the fleet as a whole last published.
+    """
+    if not statuses:
+        return "(no status.rank<k>.json files found)"
+    anchored = [s for s in statuses if isinstance(s.get("epoch_ns"), int)]
+    ref_epoch_ns = max(s["epoch_ns"] for s in anchored) if anchored else None
+
+    header = (
+        "rank", "state", "batches", "samples", "samples/s", "cursor",
+        "snap_age_s", "snap_bytes", "margin_s", "behind_s", "flags",
+    )
+    rows = [header]
+    n_stale = 0
+    states: Dict[str, int] = {}
+    for status in statuses:
+        rank = str(status.get("rank", "?"))
+        if "_problem" in status:
+            rows.append((rank, "unreadable", "-", "-", "-", "-", "-", "-", "-", "-", "UNREADABLE"))
+            states["unreadable"] = states.get("unreadable", 0) + 1
+            continue
+        counters = status.get("counters", {})
+        gauges = status.get("gauges", {})
+        health = status.get("health", {})
+        state = health.get("state", "?")
+        flags = []
+        behind_s = None
+        if ref_epoch_ns is not None and isinstance(status.get("epoch_ns"), int):
+            behind_s = (ref_epoch_ns - status["epoch_ns"]) / 1e9
+            if behind_s > stale_after_s:
+                flags.append("STALE")
+                n_stale += 1
+        elif ref_epoch_ns is not None:
+            flags.append("UNANCHORED")  # old/foreign payload: not clock-comparable
+        states[state] = states.get(state, 0) + 1
+        rows.append((
+            rank,
+            state,
+            _fmt_num(counters.get("runner.progress.batches")),
+            _fmt_num(counters.get("runner.progress.samples")),
+            _fmt_num(gauges.get("runner.throughput.samples_per_s"), "{:.1f}"),
+            _fmt_num(gauges.get("runner.cursor")),
+            _fmt_num(gauges.get("runner.snapshot.age_s"), "{:.1f}"),
+            _fmt_num(gauges.get("runner.snapshot.bytes_last")),
+            _fmt_num(gauges.get("runner.watchdog.margin_s"), "{:.2f}"),
+            "-" if behind_s is None else f"{behind_s:.1f}",
+            ",".join(flags),
+        ))
+    lines = _render_table(rows)
+    summary = ", ".join(f"{n} {state}" for state, n in sorted(states.items()))
+    lines.append("")
+    lines.append(f"{len(statuses)} rank(s): {summary}" + (f"; {n_stale} STALE (> {stale_after_s:.1f}s behind)" if n_stale else ""))
+    if ref_epoch_ns is not None:
+        lines.append(f"fleet last published {max(0.0, (time.time_ns() - ref_epoch_ns) / 1e9):.1f}s ago")
+    return "\n".join(lines)

@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import counters as _counters
 from . import trace as _trace
+from .export import render_table
 
 _lock = threading.Lock()
 _records: List[Dict[str, Any]] = []
@@ -289,12 +290,7 @@ def format_compile_table(rows: List[Dict[str, Any]]) -> str:
                 _fmt(None if row["bytes_accessed"] is None else row["bytes_accessed"] / 1e6),
             )
         )
-    widths = [max(len(r[i]) for r in table) for i in range(len(header))]
-    lines = []
-    for i, row in enumerate(table):
-        lines.append("  ".join(cell.ljust(widths[j]) for j, cell in enumerate(row)).rstrip())
-        if i == 0:
-            lines.append("  ".join("-" * w for w in widths))
+    lines = render_table(table)
     lines.append("")
     lines.append("ranked by estimated device cost: flops, then bytes accessed, then compile time")
     return "\n".join(lines)

@@ -32,11 +32,13 @@ from the live (possibly half-mutated) metric.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
 from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import live as _obs_live
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness import faults
 from torchmetrics_tpu.robustness.store import CheckpointStore
@@ -117,6 +119,17 @@ class StreamingEvaluator:
         self.cursor = 0
         self._last_snapshot_t: Optional[float] = None
         self._last_good_payload: Optional[Dict[str, Any]] = None
+        # live-plane producer state (obs/live.py): deadline of the in-flight
+        # bounded step (the watchdog-margin probe reads it while the step
+        # runs — a stalled update shows a shrinking margin in real time),
+        # last persisted snapshot size, and the throughput EWMA
+        self._watchdog_deadline: Optional[float] = None
+        self._snapshot_bytes_last: Optional[int] = None
+        self._ewma_sps: Optional[float] = None
+        self._last_batch_t: Optional[float] = None
+        # honor TM_TPU_PUBLISH exactly once per process (no-op when unset):
+        # constructing an evaluator is the natural "a long run starts here"
+        _obs_live.maybe_enable_from_env()
         if store is not None and store.fingerprint is None:
             # pin the metric's registry fingerprint into the manifest so a
             # drifted metric definition is refused with a NAMED error at the
@@ -234,11 +247,20 @@ class StreamingEvaluator:
         last = self.store.last_step()
         if last is not None and self.cursor <= last:
             return None
-        if self.store.save(self._payload(), step=self.cursor) is None:
+        name = self.store.save(self._payload(), step=self.cursor)
+        if name is None:
             return None
         self._last_snapshot_t = time.monotonic()
-        if _obs_trace.ENABLED:
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
             _obs_counters.inc("runner.snapshot")
+            try:
+                self._snapshot_bytes_last = os.path.getsize(os.path.join(self.store.directory, name))
+            except OSError:
+                self._snapshot_bytes_last = None
+            if self._snapshot_bytes_last is not None:
+                # "what would survive a kill" next to "where the run is":
+                # operators correlate the two without opening the store
+                _obs_counters.set_gauge("runner.snapshot.bytes_last", self._snapshot_bytes_last)
         return self.cursor
 
     def _maybe_snapshot(self) -> None:
@@ -270,11 +292,17 @@ class StreamingEvaluator:
                 box["err"] = err
 
         thread = threading.Thread(target=_worker, daemon=True, name=f"tm-tpu-runner-{what}")
+        # published BEFORE the step starts so the live watchdog-margin probe
+        # decays across the whole deadline window; deliberately NOT cleared on
+        # a stall — the abandoned step is dead, the margin stays <= 0 and the
+        # health state stays "stalled" for post-mortem scrapes
+        self._watchdog_deadline = time.monotonic() + self.watchdog_timeout_s
         thread.start()
         thread.join(self.watchdog_timeout_s)
         if thread.is_alive():
-            if _obs_trace.ENABLED:
+            if _obs_trace.ENABLED or _obs_live.ENABLED:
                 _obs_counters.inc("runner.watchdog_stall")
+            if _obs_trace.ENABLED:
                 _obs_trace.instant("runner.watchdog_stall", what=what, cursor=self.cursor)
             saved = None
             if self.on_stall == "snapshot_then_raise" and self.store is not None:
@@ -285,6 +313,7 @@ class StreamingEvaluator:
                 + (f" — last-good state saved at step {saved}" if saved is not None else "")
                 + "; the stalled step cannot be cancelled, resume in a fresh process"
             )
+        self._watchdog_deadline = None  # step finished inside the deadline
         if "err" in box:
             raise box["err"]
         return box.get("value")
@@ -344,12 +373,80 @@ class StreamingEvaluator:
             step, payload = restored
             # _validate_payload already installed the checkpoint
             self.cursor = int(payload["cursor"])
-        if _obs_trace.ENABLED:
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
             _obs_counters.inc("runner.resume")
+        if _obs_trace.ENABLED:
             _obs_trace.instant("runner.resume", cursor=self.cursor, restored=restored is not None)
         return self._drive(batches, skip=self.cursor)
 
+    # ------------------------------------------------------------ live plane
+    def _live_probe(self) -> Dict[str, Any]:
+        """Sampled by the :mod:`~torchmetrics_tpu.obs.live` publisher thread
+        (and every ``/metrics``/``/healthz`` request) while a drive is in
+        flight: the exactly-once cursor, snapshot freshness/size, and the
+        REAL-TIME watchdog margin — reads of immutable floats/ints under the
+        GIL, so no locking against the driving thread is needed."""
+        now = time.monotonic()
+        gauges: Dict[str, Any] = {"runner.cursor": self.cursor}
+        if self._last_snapshot_t is not None:
+            gauges["runner.snapshot.age_s"] = now - self._last_snapshot_t
+        if self._snapshot_bytes_last is not None:
+            gauges["runner.snapshot.bytes_last"] = self._snapshot_bytes_last
+        if self.watchdog_timeout_s:
+            gauges["runner.watchdog.timeout_s"] = self.watchdog_timeout_s
+            deadline = self._watchdog_deadline
+            gauges["runner.watchdog.margin_s"] = (
+                self.watchdog_timeout_s if deadline is None else deadline - now
+            )
+        if self._ewma_sps is not None:
+            gauges["runner.throughput.samples_per_s"] = self._ewma_sps
+        return gauges
+
+    @staticmethod
+    def _batch_size(batch: Any) -> int:
+        """Best-effort samples-per-batch for the progress counters: leading
+        dim of the first tuple element (the preds array), else ``len``, else 1."""
+        head = batch[0] if isinstance(batch, tuple) and batch else batch
+        try:
+            return int(head.shape[0])
+        except Exception:
+            try:
+                return len(head)
+            except Exception:
+                return 1
+
+    def _record_progress(self, batch: Any) -> None:
+        """Per-batch producer: progress counters + EWMA throughput gauge.
+        Callers guard with the live/trace flags — nothing here runs (or
+        allocates) on the disabled path."""
+        n = self._batch_size(batch)
+        _obs_counters.inc("runner.progress.batches")
+        _obs_counters.inc("runner.progress.samples", n)
+        # also a registry gauge (not just the live probe) so the cursor rides
+        # every published payload — including the final flush after the drive
+        # ends and the probe is gone
+        _obs_counters.set_gauge("runner.cursor", self.cursor)
+        now = time.monotonic()
+        if self._last_batch_t is not None and now > self._last_batch_t:
+            inst = n / (now - self._last_batch_t)
+            self._ewma_sps = inst if self._ewma_sps is None else 0.2 * inst + 0.8 * self._ewma_sps
+            _obs_counters.set_gauge("runner.throughput.samples_per_s", self._ewma_sps)
+        self._last_batch_t = now
+
     def _drive(self, batches: Iterable[Any], skip: int) -> Any:
+        if _obs_live.ENABLED:
+            # per-instance probe name: two evaluators driving concurrently in
+            # one process must not clobber (or, on finish, unregister) each
+            # other's live telemetry
+            probe_name = f"runner-{id(self)}"
+            _obs_live.register_probe(probe_name, self._live_probe)
+            try:
+                return self._drive_impl(batches, skip)
+            finally:
+                _obs_live.unregister_probe(probe_name)
+        return self._drive_impl(batches, skip)
+
+    def _drive_impl(self, batches: Iterable[Any], skip: int) -> Any:
         self.cursor = skip
         self._last_snapshot_t = time.monotonic()
         snapshotting_stalls = self.on_stall == "snapshot_then_raise" and self.watchdog_timeout_s
@@ -373,6 +470,8 @@ class StreamingEvaluator:
                 self._last_good_payload = self._payload()
             self._bounded(lambda: self.update_fn(self.metric, batch), "update")
             self.cursor += 1
+            if _obs_live.ENABLED or _obs_trace.ENABLED:
+                self._record_progress(batch)
             if faults._ACTIVE:  # preemption drill: die after batch k, before its snapshot
                 faults.fire("runner.preempt")
             self._maybe_snapshot()

@@ -37,6 +37,7 @@ import warnings
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import live as _obs_live
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.robustness import faults
 from torchmetrics_tpu.robustness import store_format as _fmt
@@ -179,7 +180,9 @@ class CheckpointStore:
                 os.unlink(os.path.join(self.directory, entry["file"]))
             except OSError:
                 pass  # already gone — the manifest no longer references it
-        if _obs_trace.ENABLED:
+        # the store health counters also feed the live plane (obs/live.py):
+        # fire when either recorder is on — still nothing on the default path
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
             _obs_counters.inc("robustness.store.save")
             _obs_counters.set_gauge("robustness.store.snapshot_bytes", len(data))
         return name
@@ -199,9 +202,10 @@ class CheckpointStore:
         half-restores: it returns the newest snapshot that is valid END TO
         END, or ``None`` when none is.
         """
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
+            _obs_counters.inc("robustness.store.load")
         if _obs_trace.ENABLED:
             with _obs_trace.span("robustness.store.load"):
-                _obs_counters.inc("robustness.store.load")
                 return self._latest(validate)
         return self._latest(validate)
 
@@ -237,7 +241,7 @@ class CheckpointStore:
         return None
 
     def _skip(self, step: int, why: str) -> None:
-        if _obs_trace.ENABLED:
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
             _obs_counters.inc("robustness.store.recovery_skipped")
         warnings.warn(
             f"checkpoint store {self.directory}: skipping snapshot at step {step} — {why};"
