@@ -395,12 +395,24 @@ def main() -> None:
     except Exception:
         pass
 
+    # provenance fingerprint: python/jax versions, OS/arch, accelerator kind,
+    # CPU model, git rev — `metricscope bench diff` refuses to compare runs
+    # whose platform/device/cpu differ (the r01-accelerator-vs-r02-CPU trap)
+    # unless forced, and records without one are treated as incomparable.
+    try:
+        from torchmetrics_tpu.obs.benchhist import collect_fingerprint
+
+        fingerprint = collect_fingerprint()
+    except Exception:  # pragma: no cover - bench resilience
+        fingerprint = None
+
     result = {
         "metric": "classification_suite_throughput",
         "value": round(ours_sps / 1e6, 3),
         "unit": "Msamples/s",
         "vs_baseline": round(ours_sps / ref_sps, 3),
         "baseline_device": "torch-cpu" + ("" if baseline_live else " (recorded)"),
+        "fingerprint": fingerprint,
         "stats": {
             "repeats": len(runs),
             "min": round(min(runs) / 1e6, 3),

@@ -15,7 +15,7 @@ import pytest
 from jax.sharding import Mesh
 
 from torchmetrics_tpu import MeanMetric, MetricCollection, SumMetric, obs
-from torchmetrics_tpu.obs import counters, device, trace
+from torchmetrics_tpu.obs import attribution, counters, device, trace
 from torchmetrics_tpu.obs import xla as obs_xla
 from torchmetrics_tpu.parallel import sharded_update
 from torchmetrics_tpu.robustness import SyncConfig
@@ -31,12 +31,14 @@ def _clean_obs():
     trace.clear()
     counters.clear()
     obs_xla.clear_records()
+    attribution.clear()
     yield
     device.disable()
     trace.disable()
     trace.clear()
     counters.clear()
     obs_xla.clear_records()
+    attribution.clear()
 
 
 def _span_names(events):
@@ -205,6 +207,7 @@ def test_disabled_path_records_and_allocates_nothing():
     assert obs.get_trace() == []
     assert obs.snapshot() == {"counters": {}, "gauges": {}}
     assert obs.dropped_events() == 0
+    assert attribution.registry_rows() == {}  # the cost ledger saw nothing either
 
 
 def test_disabled_overhead_ratchet():

@@ -220,6 +220,136 @@ def test_diff_standalone_gates_regressions(tmp_path):
     assert result.returncode == 0, result.stdout
 
 
+def test_top_standalone_does_not_import_jax(tmp_path):
+    """ISSUE 8 satellite: ``top`` reads both artifact shapes — a trace file
+    (ledger rebuilt from events + the embedded counter line) and a costs.json
+    — without ever importing jax."""
+    env = _poisoned_env(tmp_path)
+    trace_path = str(tmp_path / "t.jsonl")
+    compile_span = {
+        "type": "span", "name": "sharded.compile", "ts": 10, "dur": 2_000_000, "tid": 1, "depth": 0,
+        "args": {"xla_key": "k1", "metric": "SumMetric", "kind": "sharded",
+                 "lower_ms": 1.0, "compile_ms": 2.0, "flops": 5e6, "bytes_accessed": 1e6},
+    }
+    update_span = {"type": "span", "name": "metric.update", "ts": 20, "dur": 3_000_000,
+                   "tid": 1, "depth": 0, "args": {"metric": "SumMetric"}}
+    with open(trace_path, "w") as fh:
+        for event in (compile_span, update_span):
+            fh.write(json.dumps(event) + "\n")
+        fh.write(json.dumps({"type": "counters", "counters": {},
+                             "gauges": {"metric.SumMetric.state_bytes": 128,
+                                        "metric.SumMetric.sync_bytes": 64}}) + "\n")
+        fh.write(json.dumps({"type": "meta", "dropped": 0, "epoch_ns": 1, "mono_ns": 1}) + "\n")
+
+    result = subprocess.run([sys.executable, CLI_PATH, "top", trace_path, "--by", "device_flops"],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr
+    lines = result.stdout.splitlines()
+    assert "*device_mflops" in lines[0]
+    row = next(ln for ln in lines if "SumMetric" in ln)
+    assert "128" in row and "64" in row and "5.000" in row  # state/sync bytes + mflops joined
+
+    result = subprocess.run([sys.executable, CLI_PATH, "top", trace_path, "--explain", "SumMetric"],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr
+    assert "metric.update" in result.stdout and "compiled build(s)" in result.stdout
+
+    # an unknown column / metric is a readable exit-1, not a traceback
+    result = subprocess.run([sys.executable, CLI_PATH, "top", trace_path, "--by", "nope"],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 1 and "unknown cost column" in result.stderr
+
+
+def _bench_record(value=2.9, ssim=2100.0, device_kind="cpu:cpu", fingerprint=True):
+    record = {
+        "metric": "classification_suite_throughput", "value": value, "unit": "Msamples/s",
+        "extras": {"ssim": {"value": ssim, "unit": "images/s"}},
+    }
+    if fingerprint:
+        record["fingerprint"] = {
+            "python": "3.11.8", "jax": "0.4.3", "platform": "Linux-x86_64",
+            "device_kind": device_kind, "cpu_model": "TestCPU", "git_rev": "abc123",
+        }
+    return record
+
+
+def test_bench_append_diff_standalone_gates_regressions(tmp_path):
+    """ISSUE 8 acceptance: ``bench append`` persists runs (raw record AND
+    driver-wrapper shapes), ``bench diff`` flags an injected regressed leg
+    and exits 1 under ``--fail-on-regress`` — all without importing jax."""
+    env = _poisoned_env(tmp_path)
+    hist = str(tmp_path / "hist")
+    baseline = str(tmp_path / "baseline.json")
+    regressed = str(tmp_path / "regressed.json")
+    json.dump(_bench_record(), open(baseline, "w"))
+    # the injected regression arrives via a driver wrapper's noisy tail
+    with open(regressed, "w") as fh:
+        json.dump({"n": 5, "rc": 0, "tail": "log noise\n" + json.dumps(_bench_record(value=2.95, ssim=1200.0))}, fh)
+
+    for source in (baseline, regressed):
+        result = subprocess.run([sys.executable, CLI_PATH, "bench", "append", hist, source],
+                                capture_output=True, text=True, timeout=60, env=env)
+        assert result.returncode == 0, result.stderr
+
+    # informational diff: exit 0, trajectory + provenance rendered
+    result = subprocess.run([sys.executable, CLI_PATH, "bench", "diff", hist],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr + result.stdout
+    assert "ssim" in result.stdout and "-42.9" in result.stdout and "provenance" in result.stdout
+
+    # CI gate: the injected ssim regression trips it
+    result = subprocess.run([sys.executable, CLI_PATH, "bench", "diff", hist, "--fail-on-regress", "10"],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 1, result.stdout
+    assert "REGRESSED" in result.stdout and "FAIL:" in result.stdout and "ssim" in result.stdout
+    # the headline (+1.7%) is not a regression
+    assert "classification_suite_throughput (" not in result.stdout.split("FAIL:")[1]
+
+
+def test_bench_diff_refuses_cross_platform_by_default(tmp_path):
+    """The r01→r02 trap: an accelerator run appended after a CPU run is NOT
+    comparable — diff refuses (exit 2) unless --allow-cross-platform."""
+    env = _poisoned_env(tmp_path)
+    hist = str(tmp_path / "hist")
+    cpu_run = str(tmp_path / "cpu.json")
+    tpu_run = str(tmp_path / "tpu.json")
+    json.dump(_bench_record(), open(cpu_run, "w"))
+    json.dump(_bench_record(value=6.4, ssim=9000.0, device_kind="tpu:TPU v5e"), open(tpu_run, "w"))
+    for source in (cpu_run, tpu_run):
+        subprocess.run([sys.executable, CLI_PATH, "bench", "append", hist, source],
+                       capture_output=True, text=True, timeout=60, env=env, check=True)
+
+    result = subprocess.run([sys.executable, CLI_PATH, "bench", "diff", hist, "--fail-on-regress", "10"],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 2, result.stdout
+    assert "REFUSED" in result.stdout and "device_kind" in result.stdout
+    assert "FAIL" not in result.stdout  # deltas are withheld, not gated
+
+    result = subprocess.run([sys.executable, CLI_PATH, "bench", "diff", hist, "--allow-cross-platform"],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stdout
+    assert "WARNING: cross-platform diff forced" in result.stdout
+
+
+def test_bench_append_warns_on_missing_fingerprint(tmp_path):
+    """Pre-fingerprint records (the repo's own BENCH_r0*.json) append fine
+    but announce that diff will refuse them by default."""
+    env = _poisoned_env(tmp_path)
+    hist = str(tmp_path / "hist")
+    legacy = str(tmp_path / "legacy.json")
+    json.dump(_bench_record(fingerprint=False), open(legacy, "w"))
+    result = subprocess.run([sys.executable, CLI_PATH, "bench", "append", hist, legacy],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 0, result.stderr
+    assert "no provenance fingerprint" in result.stdout
+    json.dump(_bench_record(), open(legacy, "w"))
+    subprocess.run([sys.executable, CLI_PATH, "bench", "append", hist, legacy],
+                   capture_output=True, text=True, timeout=60, env=env, check=True)
+    result = subprocess.run([sys.executable, CLI_PATH, "bench", "diff", hist],
+                            capture_output=True, text=True, timeout=60, env=env)
+    assert result.returncode == 2 and "no provenance fingerprint" in result.stdout
+
+
 def test_summary_standalone_does_not_import_jax(tmp_path):
     """The summary/chrome subcommands load obs from its files — a trace can be
     inspected on a machine (or in a shell) without paying the jax import."""

@@ -8,9 +8,13 @@ Usage::
     python tools/metricscope.py summary /tmp/metrics.trace.jsonl
     python tools/metricscope.py chrome /tmp/metrics.trace.jsonl -o /tmp/trace.json
     python tools/metricscope.py xla /tmp/metrics.trace.jsonl
+    python tools/metricscope.py top /tmp/costs.json --by device_flops
+    python tools/metricscope.py top /tmp/metrics.trace.jsonl --explain MulticlassAUROC
     python tools/metricscope.py merge rank0.jsonl rank1.jsonl -o merged.json
     python tools/metricscope.py watch /tmp/status --interval 2
     python tools/metricscope.py diff before.jsonl after.jsonl --fail-on-regress 20
+    python tools/metricscope.py bench append bench_history/ bench_out.json
+    python tools/metricscope.py bench diff bench_history/ --fail-on-regress 10
     python tools/metricscope.py demo -o /tmp/metrics.trace.jsonl
 
 ``summary`` prints the per-metric/per-phase span table (count, total/mean and
@@ -30,9 +34,23 @@ payloads' wall-clock anchors (``--once`` prints a single frame and exits).
 ``diff`` compares two recorded traces span by span (count, p50, p95 deltas
 per ``(metric, span)`` row) and, with ``--fail-on-regress <pct>``, exits
 non-zero when any common span slowed beyond the threshold — a CI perf gate
-over ordinary trace files. ``demo`` records a trace from a small jitted +
-synced ``MetricCollection`` run and writes it — a self-contained way to see
-the whole pipeline.
+over ordinary trace files. ``top`` ranks the COST LEDGER — the per-metric
+join of host span time (incl. exclusive self-time), XLA flops/bytes/compile
+time, state-memory bytes and sync payload bytes — by a chosen cost column;
+it reads either a ``costs.json`` artifact (``TM_TPU_COSTS=<path>`` /
+``obs.write_costs``) or an ordinary trace file (the ledger is rebuilt from
+the trace), and ``--explain <Metric>`` drills into one metric's full
+breakdown — the concrete input for picking Pallas kernel targets. ``bench``
+manages the bench trajectory: ``bench append <dir> <bench.json>`` persists a
+``bench.py`` record (raw JSON line or a driver wrapper) into a history
+directory with its provenance fingerprint; ``bench diff <dir>`` renders the
+per-leg trajectory/regression table across runs, REFUSES a cross-platform
+comparison (mismatched or missing fingerprints) unless
+``--allow-cross-platform``, and with ``--fail-on-regress <pct>`` exits
+non-zero when any leg's throughput fell beyond the threshold — the CI gate
+the repo's loose BENCH_r0*.json trajectory never had. ``demo`` records a
+trace from a small jitted + synced ``MetricCollection`` run and writes it —
+a self-contained way to see the whole pipeline.
 
 Record a trace in your own run with ``TM_TPU_TRACE=1`` (then call
 ``torchmetrics_tpu.obs.write_jsonl(path)``) or the ``obs.tracing()`` context
@@ -138,6 +156,61 @@ def _cmd_xla(args) -> int:
     return 0
 
 
+def _cmd_top(args) -> int:
+    obs = _load_obs_module()
+    try:
+        ledger = obs.load_ledger(args.source)
+    except (OSError, ValueError) as err:
+        print(err, file=sys.stderr)
+        return 1
+    if args.explain:
+        try:
+            print(obs.attribution.format_explain(ledger, args.explain))
+        except ValueError as err:
+            print(err, file=sys.stderr)
+            return 1
+        return 0
+    try:
+        print(obs.attribution.format_top_table(ledger, by=args.by, limit=args.limit))
+    except ValueError as err:
+        print(err, file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    obs = _load_obs_module()
+    if args.bench_command == "append":
+        try:
+            entry = obs.benchhist.append(args.history, args.bench_json, label=args.label)
+        except (OSError, ValueError) as err:
+            print(err, file=sys.stderr)
+            return 1
+        print(f"appended run {entry['seq']} ({obs.benchhist._entry_label(entry)}) -> {entry['_path']}")
+        if not entry.get("fingerprint"):
+            print(
+                "WARNING: the record carries no provenance fingerprint — `bench diff` will refuse"
+                " to compare it without --allow-cross-platform (re-run bench.py from this build"
+                " to embed one)"
+            )
+        return 0
+    # diff
+    try:
+        history = obs.benchhist.entries(args.history)
+    except (OSError, ValueError) as err:
+        print(err, file=sys.stderr)
+        return 1
+    text, regressions, refusal = obs.benchhist.format_bench_table(
+        history,
+        fail_on_regress_pct=args.fail_on_regress,
+        allow_cross_platform=args.allow_cross_platform,
+    )
+    print(text)
+    if refusal is not None:
+        return 2
+    return 1 if regressions else 0
+
+
 def _cmd_merge(args) -> int:
     obs = _load_obs_module()
     out = args.output or "merged.chrome.json"
@@ -219,6 +292,41 @@ def main(argv=None) -> int:
     p_xla = sub.add_parser("xla", help="rank compiled steps by estimated device cost (compile time, flops, bytes)")
     p_xla.add_argument("trace", help="JSON-lines trace file (obs.write_jsonl)")
     p_xla.set_defaults(fn=_cmd_xla)
+
+    p_top = sub.add_parser(
+        "top", help="rank metrics by a cost-ledger column (host self-time, device flops, state bytes, ...)"
+    )
+    p_top.add_argument("source", help="a costs.json artifact OR a JSON-lines trace file (ledger rebuilt)")
+    p_top.add_argument(
+        "--by", default="host_self_ms",
+        help="cost column to rank by: host_self_ms (default), host_total_ms, updates,"
+        " device_flops, device_bytes, compile_ms, state_bytes, sync_bytes",
+    )
+    p_top.add_argument("--limit", type=int, default=None, help="show only the top N rows")
+    p_top.add_argument(
+        "--explain", default=None, metavar="METRIC",
+        help="full cost breakdown for one metric class instead of the ranking",
+    )
+    p_top.set_defaults(fn=_cmd_top)
+
+    p_bench = sub.add_parser("bench", help="bench-history trajectory: append runs, diff/gate regressions")
+    bench_sub = p_bench.add_subparsers(dest="bench_command", required=True)
+    p_bappend = bench_sub.add_parser("append", help="persist one bench.py record into the history directory")
+    p_bappend.add_argument("history", help="bench history directory (created if missing)")
+    p_bappend.add_argument("bench_json", help="bench.py JSON output (raw object/line or a driver wrapper with 'tail')")
+    p_bappend.add_argument("--label", default=None, help="optional run label (default r<seq>)")
+    p_bappend.set_defaults(fn=_cmd_bench)
+    p_bdiff = bench_sub.add_parser("diff", help="per-leg trajectory/regression table across the recorded runs")
+    p_bdiff.add_argument("history", help="bench history directory (see `bench append`)")
+    p_bdiff.add_argument(
+        "--fail-on-regress", type=float, default=None, metavar="PCT",
+        help="exit 1 when any leg's newest value fell more than PCT percent below the previous run's (CI gate)",
+    )
+    p_bdiff.add_argument(
+        "--allow-cross-platform", action="store_true",
+        help="compare runs even when their platform fingerprints differ or are missing (exit 2 refusal otherwise)",
+    )
+    p_bdiff.set_defaults(fn=_cmd_bench)
 
     p_merge = sub.add_parser("merge", help="merge per-rank trace files into one Chrome timeline (pid = rank)")
     p_merge.add_argument("traces", nargs="+", help="per-rank JSON-lines trace files, rank-0 first")

@@ -29,8 +29,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.obs import attribution as _obs_attr
 from torchmetrics_tpu.obs import counters as _obs_counters
 from torchmetrics_tpu.obs import device as _obs_device
+from torchmetrics_tpu.obs import live as _obs_live
 from torchmetrics_tpu.obs import trace as _obs_trace
 from torchmetrics_tpu.sketch.registry import is_sketch_state as _is_sketch_state
 from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
@@ -353,8 +355,14 @@ class MetricCollection(dict):
 
     def compute(self) -> Dict[str, Any]:
         if _obs_trace.ENABLED:
-            with _obs_trace.span("collection.compute", metric=type(self).__name__, size=len(self)):
-                return self._compute_and_reduce("compute")
+            # each member compute hits its own attribution boundary; defer
+            # the per-member costs.json rewrites and emit ONE ledger at the
+            # end, with every member's row (and instance name) in place
+            with _obs_trace.span("collection.compute", metric=type(self).__name__, size=len(self)), \
+                    _obs_attr.defer_emission():
+                result = self._compute_and_reduce("compute")
+            _obs_attr.maybe_emit()
+            return result
         return self._compute_and_reduce("compute")
 
     def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -366,6 +374,12 @@ class MetricCollection(dict):
         for m in self._base_metrics.values():
             if m._device_telemetry is not None:
                 _obs_device.drain_metric(m)
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
+            # cost-ledger rows join on the metric CLASS (the span tag); the
+            # member names ride along so `metricscope top` can say which
+            # collection entries a class row covers
+            for k, m in self._base_metrics.items():
+                _obs_attr.note_instance(type(m).__name__, k)
         result = {}
         for k, m in self._base_metrics.items():
             if method_name == "compute":

@@ -43,6 +43,7 @@ from torchmetrics_tpu.utilities.data import (
     dim_zero_sum,
 )
 from torchmetrics_tpu._reduction_names import VALID_REDUCTION_NAMES
+from torchmetrics_tpu.obs import attribution as _obs_attr
 from torchmetrics_tpu.obs import counters as _obs_counters
 from torchmetrics_tpu.obs import device as _obs_device
 from torchmetrics_tpu.obs import live as _obs_live
@@ -410,6 +411,13 @@ class Metric:
                 # telemetry becomes device.* gauges here (also on a
                 # cache-served compute — the gauges must not go stale)
                 _obs_device.drain_metric(self)
+            if (_obs_trace.ENABLED or _obs_live.ENABLED) and self._should_unsync:
+                # same boundary for cost attribution: state-bytes gauge +
+                # ledger row. TOP-LEVEL computes only — forward's per-batch
+                # detours (_should_unsync=False) run this wrapper on a
+                # temporarily reset single-batch state, which must not
+                # overwrite the real footprint
+                _obs_attr.metric_boundary(self)
             if self._update_count == 0:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {self.__class__.__name__} was called before the ``update`` method"
@@ -417,8 +425,8 @@ class Metric:
                     UserWarning,
                 )
             if self._computed is not None:
-                return self._computed
-            if _obs_trace.ENABLED:
+                value = self._computed
+            elif _obs_trace.ENABLED:
                 with _obs_trace.span("metric.compute", metric=type(self).__name__, n=self._update_count), self.sync_context(
                     dist_sync_fn=self.dist_sync_fn,
                     should_sync=self._to_sync,
@@ -434,6 +442,14 @@ class Metric:
                     value = _squeeze_if_scalar(compute(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
+            if _obs_trace.ENABLED and self._should_unsync:
+                # costs.json emission only from a TOP-LEVEL compute, and only
+                # now: the metric.compute/metric.sync spans just closed, so
+                # the ledger includes this compute's own cost (forward's
+                # per-batch detours run with _should_unsync=False and must
+                # not rebuild the ledger per batch; collection members are
+                # deferred and emitted once by the collection)
+                _obs_attr.maybe_emit()
             return value
 
         return wrapped_func
@@ -558,6 +574,10 @@ class Metric:
                 # rank with no data: contribute an empty tensor (reference ``metric.py:443-450``)
                 input_dict[attr] = [jnp.zeros((0,), dtype=self._dtype)]
 
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
+            # the payload this rank contributes to the gather (nbytes is
+            # array metadata — no device sync happens here)
+            _obs_attr.publish_sync_bytes(self, input_dict)
         output_dict: Dict[str, Any] = {}
         for attr, value in input_dict.items():
             if faults._ACTIVE:  # mid-sync fault point: earlier states are already gathered
@@ -687,6 +707,12 @@ class Metric:
         if self._device_telemetry is not None:
             # sync is the other sanctioned host boundary for device telemetry
             _obs_device.drain_metric(self)
+        if (_obs_trace.ENABLED or _obs_live.ENABLED) and should_sync and self._should_unsync:
+            # pre-sync state footprint: the bytes about to ride the gather.
+            # forward's detour computes reach here on a temporarily reset
+            # single-batch state (should_sync=False normally, True under
+            # dist_sync_on_step) — not a boundary either way
+            _obs_attr.metric_boundary(self)
         if _obs_trace.ENABLED:
             with _obs_trace.span("metric.sync", metric=type(self).__name__, n=self._update_count):
                 return self._sync_impl(dist_sync_fn, process_group, should_sync, distributed_available, sync_config)

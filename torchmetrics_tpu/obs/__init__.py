@@ -23,11 +23,14 @@ Or set ``TM_TPU_TRACE=1`` in the environment to trace the whole process.
 This package is standalone (no jax import) so tooling can load it without
 paying the full library import.
 """
+from . import attribution as attribution
+from . import benchhist as benchhist
 from . import counters as _counters_mod
 from . import live as live
 from . import openmetrics as openmetrics
 from . import trace as _trace_mod
 from . import xla as _xla_mod
+from .attribution import build_ledger, load_ledger, read_costs, write_costs
 from .counters import clear as counter_clear
 from .counters import get as counter_get
 from .counters import inc as counter_inc
@@ -66,17 +69,21 @@ from .xla import records as xla_records
 # ``from torchmetrics_tpu.obs import device``.
 
 def clear() -> None:
-    """Reset the whole recorder: span ring buffer, counters/gauges AND the
-    xla compile-record registry — the manual ``enable()``/``disable()``
-    flow's analogue of what ``tracing()`` clears on entry. Use
-    ``trace.clear()``/``counter_clear()`` for one side."""
+    """Reset the whole recorder: span ring buffer, counters/gauges, the
+    xla compile-record registry AND the cost-attribution registry — the
+    manual ``enable()``/``disable()`` flow's analogue of what ``tracing()``
+    clears on entry. Use ``trace.clear()``/``counter_clear()`` for one side."""
     _trace_mod.clear()
     _counters_mod.clear()
     _xla_mod.clear_records()
+    attribution.clear()
 
 
 __all__ = [
     "aggregate",
+    "attribution",
+    "benchhist",
+    "build_ledger",
     "clear",
     "compile_rows",
     "configure",
@@ -94,9 +101,11 @@ __all__ = [
     "instant",
     "is_enabled",
     "live",
+    "load_ledger",
     "merge_traces",
     "openmetrics",
     "publishing",
+    "read_costs",
     "read_jsonl",
     "set_gauge",
     "snapshot",
@@ -105,6 +114,7 @@ __all__ = [
     "to_chrome_trace",
     "tracing",
     "write_chrome_trace",
+    "write_costs",
     "write_jsonl",
     "write_merged_chrome_trace",
     "xla_records",

@@ -44,6 +44,19 @@ name                                incremented when
 ``obs.trace.ring_high_water``       (gauge) most events the span ring buffer has
                                     held — set by every live ``write_jsonl`` so a
                                     trace file carries its own truncation evidence
+``metric.<Class>.state_bytes``      (gauge) bytes held by the class's registered
+                                    states, refreshed at every attribution
+                                    boundary (compute/sync/runner snapshot) —
+                                    the state-memory column of the cost ledger
+                                    and ``metricscope watch``
+``metric.<Class>.sync_bytes``       (gauge) bytes this rank contributed to the
+                                    last cross-process state gather for the class
+``metric.state_bytes_total``        (gauge) whole-process state footprint with
+                                    compute-group-shared arrays counted ONCE —
+                                    the ``metricscope watch`` state_bytes column
+``obs.costs.emit_errors``           a configured ``costs.json`` emission failed
+                                    (I/O error; attribution never raises into
+                                    the evaluation it observes)
 ==================================  ==============================================
 
 Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the

@@ -170,15 +170,54 @@ def _percentile(sorted_ns: Sequence[int], q: float) -> float:
     return float(sorted_ns[idx])
 
 
+def fmt_num(value: Optional[float], pattern: str = "{:.3f}") -> str:
+    """None-safe cell formatter for the CLI tables: ``-`` when absent."""
+    return "-" if value is None else pattern.format(value)
+
+
+def _direct_child_ns(events: List[Dict[str, Any]]) -> Dict[int, int]:
+    """Summed duration of each span's DIRECT children, keyed by ``id(event)``.
+
+    Containment is per-thread and interval-based (a child starts at or after
+    its parent and ends no later), resolved with one sorted sweep per thread
+    — the stack invariant mirrors how spans actually nest at record time.
+    Only same-thread nesting counts: a bounded sync's daemon worker records
+    under its own tid, so the parent ``metric.sync`` span keeps that wall
+    time as self (it IS the parent's wall time — the host thread is blocked).
+    """
+    by_tid: Dict[Any, List[Dict[str, Any]]] = {}
+    for event in events:
+        if event.get("type") == "span":
+            by_tid.setdefault(event.get("tid", 0), []).append(event)
+    child_ns: Dict[int, int] = {}
+    for spans in by_tid.values():
+        # parents sort before equal-start children via the longer duration
+        spans.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack: List[Tuple[Dict[str, Any], int]] = []  # (event, end_ts)
+        for event in spans:
+            end = event["ts"] + event.get("dur", 0)
+            while stack and event["ts"] >= stack[-1][1]:
+                stack.pop()
+            if stack:
+                parent = stack[-1][0]
+                child_ns[id(parent)] = child_ns.get(id(parent), 0) + event.get("dur", 0)
+            stack.append((event, end))
+    return child_ns
+
+
 def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     """Aggregate span events into per-(metric, span-name) rows.
 
     The grouping key is the span's ``metric`` arg (instrumented spans tag the
     metric class; untagged spans group under ``"-"``). Rows carry count,
-    total/mean duration plus the p50/p95/max distribution in ms (a mean hides
-    the recompile/straggler tail the distribution exists to show), sorted by
-    total time descending.
+    total/mean duration, **exclusive self-time** (direct-child span time
+    subtracted, so a ``collection.group_update`` wrapping member updates and
+    a ``forward`` wrapping update+compute stop double-counting in totals)
+    plus the p50/p95/max distribution in ms (a mean hides the recompile/
+    straggler tail the distribution exists to show), sorted by total time
+    descending.
     """
+    child_ns_by_event = _direct_child_ns(events)
     stats: Dict[Tuple[str, str], Dict[str, Any]] = {}
     for event in events:
         if event.get("type") != "span":
@@ -187,8 +226,10 @@ def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         key = (str(args.get("metric", "-")), event["name"])
         row = stats.get(key)
         if row is None:
-            row = stats[key] = {"metric": key[0], "span": key[1], "durs_ns": []}
-        row["durs_ns"].append(event.get("dur", 0))
+            row = stats[key] = {"metric": key[0], "span": key[1], "durs_ns": [], "self_ns": 0}
+        dur = event.get("dur", 0)
+        row["durs_ns"].append(dur)
+        row["self_ns"] += max(0, dur - child_ns_by_event.get(id(event), 0))
     rows = []
     for row in stats.values():
         durs = sorted(row["durs_ns"])
@@ -199,6 +240,7 @@ def aggregate(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                 "span": row["span"],
                 "count": len(durs),
                 "total_ms": total_ns / 1e6,
+                "self_ms": row["self_ns"] / 1e6,
                 "mean_ms": total_ns / len(durs) / 1e6,
                 "p50_ms": _percentile(durs, 0.50) / 1e6,
                 "p95_ms": _percentile(durs, 0.95) / 1e6,
@@ -309,10 +351,10 @@ def summarize(events: List[Dict[str, Any]], counters: Optional[Dict[str, Any]] =
     must not read as complete.
     """
     rows = aggregate(events)
-    header = ("metric", "span", "count", "total_ms", "mean_ms", "p50_ms", "p95_ms", "max_ms")
+    header = ("metric", "span", "count", "total_ms", "self_ms", "mean_ms", "p50_ms", "p95_ms", "max_ms")
     table = [header] + [
-        (r["metric"], r["span"], str(r["count"]), f"{r['total_ms']:.3f}", f"{r['mean_ms']:.3f}",
-         f"{r['p50_ms']:.3f}", f"{r['p95_ms']:.3f}", f"{r['max_ms']:.3f}")
+        (r["metric"], r["span"], str(r["count"]), f"{r['total_ms']:.3f}", f"{r['self_ms']:.3f}",
+         f"{r['mean_ms']:.3f}", f"{r['p50_ms']:.3f}", f"{r['p95_ms']:.3f}", f"{r['max_ms']:.3f}")
         for r in rows
     ]
     lines = []

@@ -552,7 +552,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
 
     header = (
         "rank", "state", "batches", "samples", "samples/s", "cursor",
-        "snap_age_s", "snap_bytes", "margin_s", "behind_s", "flags",
+        "snap_age_s", "snap_bytes", "state_bytes", "margin_s", "behind_s", "flags",
     )
     rows = [header]
     n_stale = 0
@@ -560,7 +560,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
     for status in statuses:
         rank = str(status.get("rank", "?"))
         if "_problem" in status:
-            rows.append((rank, "unreadable", "-", "-", "-", "-", "-", "-", "-", "-", "UNREADABLE"))
+            rows.append((rank, "unreadable", "-", "-", "-", "-", "-", "-", "-", "-", "-", "UNREADABLE"))
             states["unreadable"] = states.get("unreadable", 0) + 1
             continue
         counters = status.get("counters", {})
@@ -577,6 +577,14 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
         elif ref_epoch_ns is not None:
             flags.append("UNANCHORED")  # old/foreign payload: not clock-comparable
         states[state] = states.get(state, 0) + 1
+        # state-memory footprint: prefer the deduplicated process total the
+        # attribution boundary publishes (compute-group-shared arrays counted
+        # once); older payloads fall back to summing the per-class gauges.
+        # None when the run never hit a boundary (or predates the gauges)
+        state_total = gauges.get("metric.state_bytes_total")
+        state_gauges = [state_total] if state_total is not None else [
+            v for k, v in gauges.items() if k.startswith("metric.") and k.endswith(".state_bytes")
+        ]
         rows.append((
             rank,
             state,
@@ -586,6 +594,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
             _fmt_num(gauges.get("runner.cursor")),
             _fmt_num(gauges.get("runner.snapshot.age_s"), "{:.1f}"),
             _fmt_num(gauges.get("runner.snapshot.bytes_last")),
+            _fmt_num(sum(state_gauges) if state_gauges else None),
             _fmt_num(gauges.get("runner.watchdog.margin_s"), "{:.2f}"),
             "-" if behind_s is None else f"{behind_s:.1f}",
             ",".join(flags),

@@ -34,7 +34,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import counters as _counters
 from . import trace as _trace
-from .export import render_table
+from .export import fmt_num as _fmt, render_table
 
 _lock = threading.Lock()
 _records: List[Dict[str, Any]] = []
@@ -264,10 +264,6 @@ def compile_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         )
     )
     return rows
-
-
-def _fmt(value: Optional[float], pattern: str = "{:.3f}") -> str:
-    return "-" if value is None else pattern.format(value)
 
 
 def format_compile_table(rows: List[Dict[str, Any]]) -> str:

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
+from torchmetrics_tpu.obs import attribution as _obs_attr
 from torchmetrics_tpu.obs import counters as _obs_counters
 from torchmetrics_tpu.obs import live as _obs_live
 from torchmetrics_tpu.obs import trace as _obs_trace
@@ -253,6 +254,7 @@ class StreamingEvaluator:
         self._last_snapshot_t = time.monotonic()
         if _obs_trace.ENABLED or _obs_live.ENABLED:
             _obs_counters.inc("runner.snapshot")
+            self._attribution_boundary()
             try:
                 self._snapshot_bytes_last = os.path.getsize(os.path.join(self.store.directory, name))
             except OSError:
@@ -262,6 +264,18 @@ class StreamingEvaluator:
                 # operators correlate the two without opening the store
                 _obs_counters.set_gauge("runner.snapshot.bytes_last", self._snapshot_bytes_last)
         return self.cursor
+
+    def _attribution_boundary(self) -> None:
+        """Refresh the per-metric ``metric.<Class>.state_bytes`` gauges (and
+        the cost-ledger registry) at a snapshot boundary, so the live plane
+        shows the state-memory footprint next to throughput. Callers guard
+        with the trace/live flags."""
+        if self._is_collection():
+            for name, member in self.metric.items(keep_base=True, copy_state=False):
+                _obs_attr.note_instance(type(member).__name__, name)
+                _obs_attr.metric_boundary(member)
+        else:
+            _obs_attr.metric_boundary(self.metric)
 
     def _maybe_snapshot(self) -> None:
         if self.store is None:
@@ -481,4 +495,11 @@ class StreamingEvaluator:
             self._last_good_payload = self._payload()
         # ... then compute (which may sync across the process group) under the
         # same watchdog deadline
-        return self._bounded(self.metric.compute, "compute")
+        result = self._bounded(self.metric.compute, "compute")
+        if _obs_trace.ENABLED:
+            # the evaluation is over: every plane (spans, xla records, state
+            # bytes, sync bytes) is final — emit the cost ledger. compute()
+            # already emitted for Metric/MetricCollection targets; this
+            # covers custom update_fn targets too, newest write wins.
+            _obs_attr.maybe_emit()
+        return result
