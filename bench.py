@@ -320,6 +320,7 @@ def main() -> None:
             bench_coco_map_scale,
             bench_device_telemetry,
             bench_fid50k,
+            bench_fused_suite,
             bench_live_publish,
             bench_retrieval_ndcg,
             bench_sketch_quantile,
@@ -328,6 +329,10 @@ def main() -> None:
         )
 
         for name, fn, args, est_s in (
+            # the fused evaluation plane on the headline workload (ISSUE 9):
+            # runs FIRST so `metricscope bench diff` always has the
+            # fused-vs-unfused pair even in a degraded session
+            ("fused_suite_throughput", bench_fused_suite, (n_batches,), 120),
             ("wer", bench_wer, (max(512, n_batches * 256),), 45),
             # bounded-memory sketch throughput + peak-state-bytes vs the
             # equivalent cat-state metric (ISSUE 4): cheap, runs early

@@ -1,0 +1,534 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tests for the one-dispatch fused evaluation plane (ISSUE 9).
+
+The contract under test: fusing a whole ``MetricCollection`` into ONE
+compiled, donated step changes NOTHING observable — state trees and compute
+results are bitwise-identical to the unfused path for every state kind
+(elementwise, cat/CatBuffer, sketch "merge"), under plain jit, ``lax.scan``,
+the sharded mesh, and kill-and-resume through ``CheckpointStore``.
+"""
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import Metric, MetricCollection, obs
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from torchmetrics_tpu.parallel import (
+    DeviceFeed,
+    FusedCollectionPlan,
+    fusion_ineligibility,
+    fusion_report,
+    sharded_update,
+)
+from torchmetrics_tpu.robustness import CheckpointStore, StreamingEvaluator
+from torchmetrics_tpu.sketch import kll_init, kll_quantile, kll_update
+
+NUM_CLASSES = 5
+BATCH = 48
+NUM_DEVICES = 8
+
+
+def _mesh() -> Mesh:
+    return Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+
+
+def _kw(**extra):
+    return dict(validate_args=False, distributed_available_fn=lambda: False, **extra)
+
+
+class _ScoreQuantile(Metric):
+    """Sketch ('merge') state coverage: KLL over the max predicted prob."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("sketch", kll_init(capacity=256, levels=8), dist_reduce_fx="merge")
+
+    def update(self, preds, target):
+        self.sketch = kll_update(self.sketch, jax.nn.softmax(preds, -1).max(-1))
+
+    def compute(self):
+        return kll_quantile(self.sketch, jnp.asarray([0.5]))[0]
+
+
+def _suite(with_exact: bool = True) -> MetricCollection:
+    """The classification-suite collection the parity acceptance names:
+    elementwise (stat scores + binned confmat), cat (exact-mode AUROC list
+    states -> CatBuffer carries), and sketch states, with a REAL compute
+    group (precision/recall share stat states)."""
+    members = {
+        "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()),
+        "prec": MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", **_kw()),
+        "rec": MulticlassRecall(num_classes=NUM_CLASSES, average="macro", **_kw()),
+        "auroc": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=16, **_kw()),
+        "squant": _ScoreQuantile(distributed_available_fn=lambda: False),
+    }
+    if with_exact:
+        members["auroc_exact"] = MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw())
+    return MetricCollection(members)
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.standard_normal((BATCH, NUM_CLASSES)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, NUM_CLASSES, BATCH).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _assert_trees_bitwise(m1: Metric, m2: Metric, context: str) -> None:
+    assert m1._update_count == m2._update_count, context
+    for name in m1._defaults:
+        v1, v2 = getattr(m1, name), getattr(m2, name)
+        if isinstance(v1, list):
+            # the fused CatBuffer folds back as ONE concatenated chunk; the
+            # eager list holds one chunk per update — same rows either way
+            c1 = np.concatenate([np.atleast_1d(np.asarray(x)) for x in v1])
+            c2 = np.concatenate([np.atleast_1d(np.asarray(x)) for x in v2])
+            assert c1.shape == c2.shape and (c1 == c2).all(), f"{context}: state {name}"
+        else:
+            l1, l2 = jax.tree_util.tree_leaves(v1), jax.tree_util.tree_leaves(v2)
+            assert len(l1) == len(l2), f"{context}: state {name}"
+            for a, b in zip(l1, l2):
+                assert (np.asarray(a) == np.asarray(b)).all(), f"{context}: state {name}"
+
+
+def _assert_values_bitwise(v1, v2, context: str) -> None:
+    assert set(v1) == set(v2), context
+    for k in v1:
+        assert (np.asarray(v1[k]) == np.asarray(v2[k])).all(), f"{context}: {k}"
+
+
+def _establish_groups(collection, batches):
+    collection.update(*batches[0])
+    collection.update(*batches[1])
+
+
+# ------------------------------------------------------------ bitwise parity
+
+
+def test_fused_jit_parity_full_suite():
+    """Per-batch fused updates == eager collection updates, bitwise, for
+    elementwise + cat + sketch states and the compute results."""
+    batches = _batches(6)
+    ref, fus = _suite(), _suite()
+    for b in batches:
+        ref.update(*b)
+    _establish_groups(fus, batches)
+    plan = fus.fused(cat_capacity=BATCH * len(batches) + 8, example_batch=batches[0])
+    assert len(plan._infos) < len(fus)  # prec/rec share a leader: dedup preserved
+    for b in batches[2:]:
+        plan.update(*b)
+    plan.fold_back()
+    _assert_values_bitwise(ref.compute(), fus.compute(), "jit compute")
+    for key in ref.keys(keep_base=True):
+        _assert_trees_bitwise(dict.__getitem__(ref, key), dict.__getitem__(fus, key), f"jit {key}")
+
+
+def test_fused_scan_parity_full_suite():
+    """run_scan (zero per-batch Python) == eager collection updates."""
+    batches = _batches(6, seed=1)
+    ref, fus = _suite(), _suite()
+    for b in batches:
+        ref.update(*b)
+    _establish_groups(fus, batches)
+    plan = fus.fused(cat_capacity=BATCH * len(batches) + 8, example_batch=batches[0])
+    plan.run_scan(batches[2:])
+    plan.fold_back()
+    _assert_values_bitwise(ref.compute(), fus.compute(), "scan compute")
+    for key in ref.keys(keep_base=True):
+        _assert_trees_bitwise(dict.__getitem__(ref, key), dict.__getitem__(fus, key), f"scan {key}")
+
+
+def test_fused_sharded_parity():
+    """Fused-sharded == per-member sharded_update on the same mesh, bitwise
+    (elementwise + cat states; the sharded fold mirrors sharded_update)."""
+    mesh = _mesh()
+    batches = _batches(5, seed=2)
+
+    def members():
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()),
+                "auroc": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=16, **_kw()),
+                "auroc_exact": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw()),
+            },
+            compute_groups=False,
+        )
+
+    ref = members()
+    for p, t in batches:
+        for m in ref.values(copy_state=False):
+            sharded_update(m, mesh, p, t)
+    fus = members()
+    plan = fus.fused(mesh=mesh, cat_capacity=BATCH * len(batches) + 8, example_batch=batches[0])
+    for b in batches:
+        plan.update(*b)
+    plan.fold_back()
+    _assert_values_bitwise(ref.compute(), fus.compute(), "sharded compute")
+    for key in ref.keys(keep_base=True):
+        _assert_trees_bitwise(dict.__getitem__(ref, key), dict.__getitem__(fus, key), f"sharded {key}")
+
+
+def test_fused_sharded_sketch_parity():
+    """Sketch 'merge' states under the fused sharded step == sharded_update
+    (incl. the step-one load-not-merge select)."""
+    mesh = _mesh()
+    batches = _batches(4, seed=3)
+    ref = _ScoreQuantile(distributed_available_fn=lambda: False)
+    for p, t in batches:
+        sharded_update(ref, mesh, p, t)
+    fus = _ScoreQuantile(distributed_available_fn=lambda: False)
+    plan = FusedCollectionPlan(fus, mesh=mesh)
+    for b in batches:
+        plan.update(*b)
+    plan.fold_back()
+    _assert_trees_bitwise(ref, fus, "sharded sketch")
+    assert (np.asarray(ref.compute()) == np.asarray(fus.compute())).all()
+
+
+def test_fused_kill_and_resume_parity(tmp_path):
+    """Die mid-drive after a snapshot, resume in fresh objects: the resumed
+    fused run == the never-interrupted unfused run, bitwise (fold-back at
+    snapshot boundaries == never-fused)."""
+    batches = _batches(8, seed=4)
+    ref = _suite()
+    vals_ref = StreamingEvaluator(ref).run(batches)
+
+    cap = BATCH * len(batches) + 8
+    store = CheckpointStore(os.path.join(str(tmp_path), "store"), keep_last=3)
+    victim = _suite()
+    poisoned = batches[:6] + [None]  # detonates inside update at batch 7
+    with pytest.raises(Exception):
+        StreamingEvaluator(
+            victim, store=store, snapshot_every_n=2, fused=True,
+            fused_options={"cat_capacity": cap},
+        ).run(poisoned)
+    assert store.last_step() == 6
+
+    resumed = _suite()
+    vals_res = StreamingEvaluator(
+        resumed,
+        store=CheckpointStore(os.path.join(str(tmp_path), "store"), keep_last=3),
+        fused=True,
+        fused_options={"cat_capacity": cap},
+    ).resume(batches)
+    _assert_values_bitwise(vals_ref, vals_res, "resume compute")
+    for key in ref.keys(keep_base=True):
+        _assert_trees_bitwise(
+            dict.__getitem__(ref, key), dict.__getitem__(resumed, key), f"resume {key}"
+        )
+
+
+def test_fused_mid_stream_seed_and_refold():
+    """Fusing picks up the members' CURRENT state (mid-stream), fold_back is
+    idempotent, and the plan stays drivable after a fold."""
+    batches = _batches(6, seed=5)
+    ref = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    fus = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    for b in batches[:3]:
+        ref.update(*b)
+        fus.update(*b)
+    plan = fus.fused()
+    plan.update(*batches[3])
+    plan.fold_back()
+    plan.fold_back()  # idempotent
+    plan.update(*batches[4])
+    plan.update(*batches[5])
+    plan.fold_back()
+    for b in batches[3:]:
+        ref.update(*b)
+    _assert_values_bitwise(ref.compute(), fus.compute(), "mid-stream")
+    _assert_trees_bitwise(dict.__getitem__(ref, "acc"), dict.__getitem__(fus, "acc"), "mid-stream acc")
+
+
+# ------------------------------------------------------- donation & buffers
+
+
+def test_fused_plan_donates_state_carry():
+    batches = _batches(2, seed=6)
+    col = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    plan = col.fused()  # donate=True default
+    old = plan.state["members"]["acc"]["tp"]
+    plan.update(*batches[0])
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(old)
+    # the live metric's own states were never donated away
+    np.asarray(dict.__getitem__(col, "acc").tp)
+    np.asarray(dict.__getitem__(col, "acc")._defaults["tp"])
+
+
+def test_fused_plan_donate_false_keeps_old_state():
+    batches = _batches(2, seed=6)
+    col = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    plan = col.fused(donate=False)
+    old = plan.state["members"]["acc"]["tp"]
+    plan.update(*batches[0])
+    np.asarray(old)  # still readable
+
+
+def test_fold_back_survives_subsequent_donated_steps():
+    """fold_back installs COPIES: the next donated step must not delete
+    buffers the metrics now hold."""
+    batches = _batches(3, seed=7)
+    col = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    plan = col.fused()
+    plan.update(*batches[0])
+    plan.fold_back()
+    held = dict.__getitem__(col, "acc").tp
+    plan.update(*batches[1])
+    plan.update(*batches[2])
+    np.asarray(held)  # not consumed by donation
+
+
+# ------------------------------------------------------------- eligibility
+
+
+class _KwOnly(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, *, preds=None):
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+class _HostCounters(Metric):
+    _host_counters = ("_seen",)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self._seen = 0
+
+    def update(self, preds, target):
+        self._seen += 1
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+class _Wrapper(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.child = MulticlassAccuracy(num_classes=2, **_kw())
+
+    def update(self, preds, target):
+        self.child.update(preds, target)
+
+    def compute(self):
+        return self.child.compute()
+
+
+def test_fusion_report_and_refusal():
+    report = fusion_report(
+        MetricCollection(
+            {
+                "ok": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()),
+                "kw": _KwOnly(),
+                "hc": _HostCounters(),
+                "wrap": _Wrapper(),
+            },
+            compute_groups=False,
+        )
+    )
+    assert report["ok"] is None
+    assert "kwargs-only" in report["kw"]
+    assert "host-side counters" in report["hc"]
+    assert "child metrics" in report["wrap"]
+    with pytest.raises(ValueError, match="kw: .*kwargs-only"):
+        MetricCollection({"kw": _KwOnly(), "ok": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())}).fused()
+
+
+def test_fusion_report_is_read_only():
+    """fusion_report is a pure query: it never runs the plan build's
+    state-ref propagation or flips the collection's copy flag."""
+    batches = _batches(3, seed=14)
+    col = _suite(with_exact=False)
+    col.update(*batches[0])
+    col.update(*batches[1])
+    list(col.items())  # copy_state=True propagation marks members as copies
+    assert col._state_is_copy
+    report = fusion_report(col)
+    assert set(report) == set(col.keys(keep_base=True)) and all(r is None for r in report.values())
+    assert col._state_is_copy  # untouched by the report
+
+
+def test_fusion_ineligibility_host_state_flag():
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    assert fusion_ineligibility(metric) is None
+    metric._sharded_update_unsupported = "per-update host resampling"
+    assert "host-state update" in fusion_ineligibility(metric)
+
+
+def test_fused_cat_state_requires_capacity():
+    col = MetricCollection({"ex": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw())})
+    with pytest.raises(ValueError, match="cat_capacity"):
+        col.fused()
+
+
+def test_fused_cat_overflow_raises_on_fold_back():
+    batches = _batches(3, seed=8)
+    col = MetricCollection({"ex": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw())})
+    plan = col.fused(cat_capacity=BATCH + 4, example_batch=batches[0])
+    for b in batches:
+        plan.update(*b)
+    with pytest.raises(RuntimeError, match="overflow"):
+        plan.fold_back()
+
+
+# ------------------------------------------------------------ feed & stream
+
+
+def test_device_feed_order_and_values():
+    batches = [(np.full((4,), i, np.float32), np.full((4,), -i, np.float32)) for i in range(7)]
+    out = list(DeviceFeed(batches, depth=2))
+    assert len(out) == 7
+    for i, (a, b) in enumerate(out):
+        assert isinstance(a, jax.Array) and isinstance(b, jax.Array)
+        assert (np.asarray(a) == i).all() and (np.asarray(b) == -i).all()
+
+
+def test_device_feed_depth_one_and_empty():
+    assert list(DeviceFeed([], depth=1)) == []
+    out = list(DeviceFeed([np.arange(3)], depth=1))
+    assert len(out) == 1 and (np.asarray(out[0]) == np.arange(3)).all()
+    with pytest.raises(ValueError, match="depth"):
+        DeviceFeed([], depth=0)
+
+
+def test_run_stream_matches_eager():
+    batches = _batches(5, seed=9)
+    host_batches = [(np.asarray(p), np.asarray(t)) for p, t in batches]
+    ref = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    for b in batches:
+        ref.update(*b)
+    fus = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    plan = fus.fused()
+    plan.run_stream(host_batches)
+    plan.fold_back()
+    _assert_values_bitwise(ref.compute(), fus.compute(), "run_stream")
+
+
+# ----------------------------------------------------- runner / cache / obs
+
+
+def test_streaming_evaluator_fused_matches_unfused():
+    batches = _batches(6, seed=10)
+    ref, fus = _suite(with_exact=False), _suite(with_exact=False)
+    vals_ref = StreamingEvaluator(ref).run(batches)
+    vals_fus = StreamingEvaluator(fus, fused=True).run(batches)
+    _assert_values_bitwise(vals_ref, vals_fus, "runner")
+    for key in ref.keys(keep_base=True):
+        _assert_trees_bitwise(dict.__getitem__(ref, key), dict.__getitem__(fus, key), f"runner {key}")
+
+
+def test_streaming_evaluator_fused_rejects_update_fn():
+    col = _suite(with_exact=False)
+    with pytest.raises(ValueError, match="update_fn"):
+        StreamingEvaluator(col, fused=True, update_fn=lambda m, b: None)
+
+
+def test_fused_sharded_step_rides_cache():
+    """Rebuilding a plan over the same (collection, mesh, axis) serves the
+    compiled step from _SHARDED_FN_CACHE instead of re-tracing."""
+    mesh = _mesh()
+    batches = _batches(2, seed=11)
+    col = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    with obs.tracing():
+        plan1 = col.fused(mesh=mesh)
+        plan1.update(*batches[0])
+        plan1.fold_back()
+        plan2 = col.fused(mesh=mesh)
+        snap = obs.snapshot()["counters"]
+        assert snap.get("fused.cache.hit") == 1
+        assert plan2._step is plan1._step
+    # folding moved state: the cached step still drives the fresh plan
+    plan2.update(*batches[1])
+    plan2.fold_back()
+    assert dict.__getitem__(col, "acc")._update_count == 2
+
+
+def test_fused_local_step_rides_cache():
+    """Local (no-mesh) plans reuse compiled steps too: a rebuilt plan over
+    the same collection — a resumed evaluator, a fresh plan per epoch —
+    must not pay trace+compile again."""
+    batches = _batches(2, seed=15)
+    col = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    with obs.tracing():
+        plan1 = col.fused()
+        plan1.update(*batches[0])
+        plan1.fold_back()
+        plan2 = col.fused()
+        assert obs.snapshot()["counters"].get("fused.cache.hit") == 1
+        assert plan2._step is plan1._step and plan2._scan_step is plan1._scan_step
+    plan2.update(*batches[1])
+    plan2.fold_back()
+    assert dict.__getitem__(col, "acc")._update_count == 2
+
+
+def test_fused_device_telemetry_carry_and_parity():
+    """With device telemetry enabled at build, the fused carry accumulates
+    in-graph health and drains at the members' compute boundary — with
+    bitwise-identical metric values either way."""
+    from torchmetrics_tpu.obs import counters as obs_counters
+    from torchmetrics_tpu.obs import device as obs_device
+
+    batches = _batches(4, seed=12)
+    plain = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    plan = plain.fused()
+    for b in batches:
+        plan.update(*b)
+    plan.fold_back()
+    vals_plain = plain.compute()
+
+    inst = MetricCollection({"acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())})
+    with obs_device.device_telemetry():
+        plan_t = inst.fused()
+    for b in batches:
+        plan_t.update(*b)
+    plan_t.fold_back()
+    assert dict.__getitem__(inst, "acc")._device_telemetry is not None
+    vals_inst = inst.compute()
+    _assert_values_bitwise(vals_plain, vals_inst, "telemetry parity")
+    gauges = obs_counters.snapshot()["gauges"]
+    assert gauges.get("device.MulticlassAccuracy.updates") == len(batches)
+    obs_counters.clear()
+
+
+def test_fused_attribution_instances_under_collection():
+    """The fused plan's cost rows join under the COLLECTION class with the
+    member names as instances (metricscope top attribution)."""
+    from torchmetrics_tpu.obs import attribution
+
+    attribution.clear()
+    batches = _batches(2, seed=13)
+    col = _suite(with_exact=False)
+    with obs.tracing():
+        plan = col.fused()
+        plan.update(*batches[0])
+        plan.fold_back()
+        rows = attribution.registry_rows()
+    assert set(rows["MetricCollection"]["instances"]) >= {"acc", "auroc", "prec", "rec", "squant"}
+    assert "acc" in rows["MulticlassAccuracy"]["instances"]
+    attribution.clear()

@@ -146,6 +146,41 @@ def test_make_jit_update_device_loop():
     assert np.allclose(float(metric.compute()), 1.5)
 
 
+@pytest.mark.parametrize("telemetry", [False, True])
+def test_make_jit_update_donate_semantics_telemetry_invariant(telemetry):
+    """ISSUE 9 satellite: the ``donate`` build flag alone decides buffer
+    semantics — flipping device telemetry never changes what the caller can
+    still read. donate=False: the old state stays readable after a step
+    (the historical contract). donate=True: the handed-out state is consumed
+    by the step (and is a fresh copy, so the metric's _defaults survive)."""
+    from torchmetrics_tpu.obs import device as obs_device
+
+    def build(donate):
+        metric = _SumPairs()
+        if telemetry:
+            with obs_device.device_telemetry():
+                return metric, *make_jit_update(metric, donate=donate)
+        return metric, *make_jit_update(metric, donate=donate)
+
+    # donate=False: old state readable, telemetry on or off
+    metric, step, state0 = build(donate=False)
+    step(state0, jnp.arange(8.0))
+    np.asarray(state0["total"])  # must not raise
+
+    # donate=True: old state consumed, telemetry on or off; the metric's own
+    # default buffers are never donated away
+    metric, step, state0 = build(donate=True)
+    state1 = step(state0, jnp.arange(8.0))
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(state0["total"])
+    np.asarray(metric._defaults["total"])
+    metric.reset()
+    np.asarray(metric.total)
+    # the returned state keeps working (in-place streaming regime)
+    state2 = step(state1, jnp.arange(8.0, 16.0))
+    assert float(state2["total"]) == float(np.arange(16.0).sum())
+
+
 def test_tree_merge_sum_metric():
     m = SumMetric()
     m.update(jnp.asarray(2.0))

@@ -305,3 +305,92 @@ def test_host_clf_curve_float64_keeps_precision():
     assert len(thres) == 3  # all three thresholds distinct in f64
     assert tps.tolist() == [1, 2, 2]
     assert fps.tolist() == [0, 0, 1]
+
+
+def test_binned_curve_state_formulations_bitwise():
+    """ISSUE 9: the bucketize formulation of ``_binned_curve_state`` (affine
+    +3-compare for uniform grids, ``searchsorted`` for other sorted grids)
+    agrees BITWISE with the contraction fallback (traced thresholds) on the
+    same inputs — including values exactly on grid points, outside the grid
+    range, and masked-invalid entries."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        _binned_curve_state,
+        _threshold_bins,
+        _uniform_bin_margin_ok,
+    )
+
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import _bucketize_wanted
+
+    assert _bucketize_wanted()  # tests run on the CPU backend: bucketize on
+    uniform = jnp.linspace(0.0, 1.0, 37, dtype=jnp.float32)
+    irregular = jnp.asarray(np.sort(np.random.RandomState(7).rand(29)).astype(np.float32))
+    assert _uniform_bin_margin_ok(np.asarray(uniform, np.float64))
+    assert not _uniform_bin_margin_ok(np.asarray(irregular, np.float64))
+
+    # thresholds as a jit argument are tracers: _threshold_bins refuses them
+    # and the contraction path runs
+    contraction = jax.jit(_binned_curve_state)
+
+    rng2 = np.random.RandomState(11)
+    n = 513
+    p_bin = rng2.rand(n).astype(np.float32)
+    p_bin[:37] = np.asarray(uniform)          # exactly on every grid point
+    p_bin[37:41] = [-0.25, 1.25, 0.0, 1.0]    # outside / on the range ends
+    p_bin[41:43] = np.nan                     # poisoned inputs: both paths pin NaN below every threshold
+    p_bin[43:45] = [np.inf, -np.inf]          # +/-inf: above/below every threshold on both paths
+    t_bin = rng2.randint(0, 2, n).astype(np.int32)
+    v_bin = rng2.rand(n) > 0.1
+    p_mc = rng2.rand(n, 4).astype(np.float32)
+    t_mc = rng2.randint(0, 2, (n, 4)).astype(np.int32)
+    v_mc = rng2.rand(n, 4) > 0.1
+
+    for preds, target, valid in (
+        (jnp.asarray(p_bin), jnp.asarray(t_bin), jnp.asarray(v_bin)),
+        (jnp.asarray(p_mc), jnp.asarray(t_mc), jnp.asarray(v_mc)),
+    ):
+        for thr in (uniform, irregular):
+            fast = _binned_curve_state(preds, target, valid, thr)
+            slow = contraction(preds, target, valid, thr)
+            assert fast.dtype == slow.dtype == jnp.int32
+            assert (np.asarray(fast) == np.asarray(slow)).all()
+            # sanity: every sample lands somewhere — per-slice totals match N_valid
+            assert int(np.asarray(fast)[0].sum()) == int(np.asarray(valid).sum())
+
+    # the two bucketize kernels agree with each other as well — including on
+    # a slightly-nudged grid, which the margin check may still admit to the
+    # affine path precisely because the 3-compare correction keeps it exact
+    nudged = np.asarray(uniform, np.float64)
+    nudged[5] += 1e-3
+    nudged_j = jnp.asarray(np.sort(nudged).astype(np.float32))
+    probe = jnp.asarray(np.concatenate([p_bin, np.asarray(nudged_j)]))
+    for grid in (uniform, nudged_j):
+        b_fast = _threshold_bins(probe, grid)
+        b_sorted = jnp.where(  # NaN pins to bin 0 (searchsorted alone sorts it past the end)
+            jnp.isnan(probe), 0, jnp.searchsorted(grid, probe, side="right")
+        ).astype(jnp.int32)
+        assert (np.asarray(b_fast) == np.asarray(b_sorted)).all()
+
+
+def test_curve_formulation_env_override(monkeypatch):
+    """``TM_TPU_CURVE_FORMULATION`` forces one formulation regardless of
+    backend — the measurement knob for deciding a specific box's default."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        _bucketize_wanted,
+        _threshold_bins,
+    )
+
+    thr = jnp.linspace(0.0, 1.0, 9)
+    monkeypatch.setenv("TM_TPU_CURVE_FORMULATION", "contraction")
+    assert not _bucketize_wanted()
+    assert _threshold_bins(jnp.asarray([0.5]), thr) is None
+    monkeypatch.setenv("TM_TPU_CURVE_FORMULATION", "bucketize")
+    assert _bucketize_wanted()
+    assert _threshold_bins(jnp.asarray([0.5]), thr) is not None
+    monkeypatch.setenv("TM_TPU_CURVE_FORMULATION", "bucketsize")  # typo: refuse, don't mismeasure
+    with pytest.raises(ValueError, match="TM_TPU_CURVE_FORMULATION"):
+        _bucketize_wanted()

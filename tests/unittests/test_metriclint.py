@@ -53,6 +53,24 @@ def seeded_kernel(preds: "Array", target: "Array"):
     both = jnp.concatenate([preds, target])
     host = np.cumsum(both)
     return bool(jnp.sum(host) == 0)
+
+
+class SeededKwOnlyMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("count", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, *, preds=None):
+        self.count = self.count + 1
+
+    def compute(self):
+        return self.count
+
+
+def seeded_collection():
+    from torchmetrics_tpu import MetricCollection
+
+    return MetricCollection({"kw": SeededKwOnlyMetric()})
 '''
 
 
@@ -100,6 +118,116 @@ def test_seeded_violation_details(seeded_file, tmp_path):
     assert any("np.cumsum" in v.message for v in by_rule["ML004"])
     assert any("set/frozenset" in v.message for v in by_rule["ML005"])
     assert any("sketch" in v.message for v in by_rule["ML006"])
+    assert any("fusion-ineligible" in v.message for v in by_rule["ML007"])
+
+
+_ML007_SNIPPET = '''
+import jax.numpy as jnp
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu import MetricCollection
+
+
+class KwOnlyUpdate(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, *, preds=None):
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+class HostStateUpdate(Metric):
+    _sharded_update_unsupported = "per-update host resampling"
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+class HostCounterUpdate(Metric):
+    _host_counters = ("_seen",)
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self._seen = 0
+
+    def update(self, preds):
+        self._seen += 1
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+class FineMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+def build():
+    return MetricCollection(
+        {"kw": KwOnlyUpdate(), "host": HostStateUpdate(), "hc": HostCounterUpdate(), "ok": FineMetric()}
+    )
+
+
+def build_outside_collection():
+    return KwOnlyUpdate()  # not in a MetricCollection: ML007 stays quiet
+'''
+
+
+def test_ml007_flags_only_ineligible_members_in_collections(tmp_path):
+    path = tmp_path / "ml007_snippet.py"
+    path.write_text(_ML007_SNIPPET)
+    violations = [v for v in lint_paths([str(path)], root=str(tmp_path)) if v.rule == "ML007"]
+    flagged = {v.scope for v in violations}
+    assert flagged == {
+        "MetricCollection[KwOnlyUpdate]",
+        "MetricCollection[HostStateUpdate]",
+        "MetricCollection[HostCounterUpdate]",
+    }
+    # constructing the class OUTSIDE a collection is not flagged
+    assert all("build_outside_collection" not in v.scope for v in violations)
+
+
+def test_ml007_agrees_with_runtime_eligibility(tmp_path):
+    """The linter's static predicate and the fused plane's runtime
+    ``fusion_ineligibility`` must classify the same members the same way —
+    the fused plan's eligibility report and ML007 agree (ISSUE 9)."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.metric import Metric
+    from torchmetrics_tpu.parallel import fusion_ineligibility
+
+    path = tmp_path / "ml007_snippet.py"
+    path.write_text(_ML007_SNIPPET)
+    violations = [v for v in lint_paths([str(path)], root=str(tmp_path)) if v.rule == "ML007"]
+    lint_flagged = {v.scope.split("[")[1].rstrip("]") for v in violations}
+
+    namespace = {}
+    exec(compile(_ML007_SNIPPET, str(path), "exec"), namespace)  # noqa: S102 - test fixture
+    runtime_flagged = {
+        name
+        for name in ("KwOnlyUpdate", "HostStateUpdate", "HostCounterUpdate", "FineMetric")
+        if fusion_ineligibility(namespace[name]()) is not None
+    }
+    assert lint_flagged == runtime_flagged
 
 
 def test_ml003_message_tracks_runtime_reductions():

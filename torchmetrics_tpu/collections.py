@@ -412,6 +412,50 @@ class MetricCollection(dict):
         if self._enable_compute_groups and self._groups_checked:
             self._state_is_copy = False
 
+    def fused(
+        self,
+        *,
+        cat_capacity: Optional[int] = None,
+        example_batch: Optional[Tuple[Any, ...]] = None,
+        donate: bool = True,
+        mesh: Optional[Any] = None,
+        axis_name: str = "data",
+    ) -> "Any":
+        """Compile this collection's whole update into ONE donated step.
+
+        Returns a :class:`~torchmetrics_tpu.parallel.fused.FusedCollectionPlan`
+        whose ``update(*batch)`` costs a single compiled dispatch regardless
+        of how many metrics are attached (compute-group leaders trace once;
+        members keep riding the state-ref propagation), whose ``run_scan``
+        pushes a pre-staged chunk through ``lax.scan`` with zero per-batch
+        Python, and whose ``fold_back()`` puts the totals back into the
+        members so ``compute()``/sync/checkpointing are unchanged::
+
+            suite.update(p, t); suite.update(p, t)   # let groups form
+            plan = suite.fused()
+            for batch in stream:
+                plan.update(*batch)
+            plan.fold_back()
+            values = suite.compute()
+
+        ``cat_capacity``/``example_batch`` are required when any member has
+        list ("cat") states (they become fixed-capacity CatBuffer carries);
+        ``mesh``/``axis_name`` build the sharded variant. Fusion-ineligible
+        members (kwargs-only updates, host-state metrics — metriclint ML007
+        flags them statically) raise with a per-member report; see
+        :func:`~torchmetrics_tpu.parallel.fused.fusion_report`.
+        """
+        from torchmetrics_tpu.parallel.fused import FusedCollectionPlan
+
+        return FusedCollectionPlan(
+            self,
+            cat_capacity=cat_capacity,
+            example_batch=example_batch,
+            donate=donate,
+            mesh=mesh,
+            axis_name=axis_name,
+        )
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         """Deep copy with optional new prefix/postfix (reference ``collections.py:399``)."""
         mc = deepcopy(self)

@@ -28,6 +28,7 @@ Capability parity with reference
 """
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Tuple, Union
 
 import jax
@@ -189,21 +190,139 @@ def _binary_precision_recall_curve_format(
     return preds, target, _adjust_threshold_arg(thresholds)
 
 
+def _uniform_bin_margin_ok(thr: np.ndarray) -> bool:
+    """True when the 3-compare affine bin index of :func:`_threshold_bins` is
+    provably exact for the grid ``thr`` (sorted, float64 here).
+
+    The fast path computes ``k = trunc((p - lo) * scale)`` in the input's
+    float precision and corrects it with three ordered compares, so it is
+    exact iff the true count ``s(p) = #{t: thr[t] <= p}`` always lies in
+    ``[k, k+3]``. With a relative error budget of ``2^-20`` on the affine map
+    (generous for one subtract + one multiply in >=f32), sufficient
+    conditions, checkable per grid point:
+
+    - upper: ``thr[k+3] >= lo + (k+1)(1+2^-20)/scale`` for every k — a value
+      binned at k cannot clear threshold k+3;
+    - lower: ``thr[k-1] <= lo + k(1-2^-20)/scale`` for every k — a value
+      binned at k has already cleared threshold k-1.
+
+    A ``linspace`` grid passes with huge slack; an irregular grid fails and
+    falls back to ``searchsorted``.
+    """
+    len_t = thr.shape[0]
+    if len_t < 2:
+        return False
+    lo, hi = float(thr[0]), float(thr[-1])
+    if not np.isfinite(thr).all() or hi <= lo:
+        return False
+    eps = 2.0**-20
+    scale = (len_t - 1) / (hi - lo)
+    k = np.arange(0, len_t - 3, dtype=np.float64)
+    if len(k) and not np.all(thr[3:] >= lo + (k + 1) * (1 + eps) / scale):
+        return False
+    k = np.arange(1, len_t, dtype=np.float64)
+    return bool(np.all(thr[:-1] <= lo + k * (1 - eps) / scale))
+
+
+def _bucketize_wanted() -> bool:
+    """Whether this backend wants the bucketize formulation (trace-time
+    decision — the choice compiles into the program).
+
+    The histogram's scatter-add executes SERIALLY everywhere (~10M updates/s
+    on TPU, single-threaded on XLA:CPU), while the contraction einsum is MXU
+    work on TPU and scales with cores on CPU. So the O(N) bucketize wins only
+    where the O(N·T) contraction cannot parallelize: CPU with few cores
+    (measured 3.7x on 1 core at T=128) — and loses badly on TPU. Default:
+    bucketize on CPU, contraction elsewhere;
+    ``TM_TPU_CURVE_FORMULATION=bucketize|contraction`` overrides for
+    measurement on a specific box.
+    """
+    forced = os.environ.get("TM_TPU_CURVE_FORMULATION", "").strip().lower()
+    if forced == "bucketize":
+        return True
+    if forced == "contraction":
+        return False
+    if forced:  # a measurement knob that silently ignores typos measures the wrong program
+        raise ValueError(
+            f"TM_TPU_CURVE_FORMULATION={forced!r} not recognized; use 'bucketize' or 'contraction'"
+        )
+    return jax.default_backend() == "cpu"
+
+
+def _threshold_bins(values: Array, thresholds: Array) -> Optional[Array]:
+    """Per-element count of thresholds ``<= value`` (the bucketize kernel).
+
+    Requires the backend to want this formulation (:func:`_bucketize_wanted`)
+    and ``thresholds`` to be CONCRETE (a metric's stored grid, or the
+    constant ``_adjust_threshold_arg`` builds from an int/list) and sorted
+    ascending; returns ``None`` otherwise so the caller falls back to the
+    contraction formulation. Near-uniform grids (``linspace``) take an exact
+    O(1)-per-element path: an affine candidate index plus three ordered
+    compares against the grid (see :func:`_uniform_bin_margin_ok`); other
+    sorted grids pay a ``searchsorted``. Both agree bitwise with the direct
+    ``value >= thr_t`` compares of the contraction path.
+    """
+    if not _bucketize_wanted():
+        return None
+    try:
+        thr_np = np.asarray(thresholds, dtype=np.float64)  # raises on tracers
+    except Exception:
+        return None
+    if thr_np.ndim != 1 or thr_np.size == 0 or np.any(np.diff(thr_np) < 0):
+        return None
+    len_t = thr_np.shape[0]
+    if _uniform_bin_margin_ok(thr_np):
+        lo = thresholds[0].astype(values.dtype)
+        scale = jnp.asarray((len_t - 1) / (thr_np[-1] - thr_np[0]), values.dtype)
+        k = jnp.clip(((values - lo) * scale).astype(jnp.int32), 0, len_t - 1)
+        pad = jnp.full((3,), jnp.inf, thresholds.dtype)
+        thr_pad = jnp.concatenate([thresholds, pad])
+        bins = k
+        for d in range(3):
+            bins = bins + (values >= thr_pad[k + d]).astype(jnp.int32)
+        # +inf clears the inf padding compares too — clamp to the last bin so
+        # it counts at every threshold, exactly like the contraction path
+        bins = jnp.minimum(bins, len_t)
+    else:
+        bins = jnp.searchsorted(thresholds, values, side="right").astype(jnp.int32)
+    # NaN pins to bin 0: the contraction path computes NaN >= thr_t == False
+    # for every t (searchsorted instead sorts NaN past the last threshold,
+    # and NaN->int32 in the affine path is implementation-defined), so the
+    # two formulations stay bitwise-identical even on poisoned inputs
+    return jnp.where(jnp.isnan(values), 0, bins)
+
+
 def _binned_curve_state(preds: Array, target_bin: Array, valid: Array, thresholds: Array) -> Array:
     """Shared binned-confusion kernel: ``(T, ..., 2, 2)`` from flat probs.
 
     The reference materializes the ``(N, ..., T)`` broadcast-compare tensor and
     scatter-adds it into bins (reference ``:211-227``) — O(N·T) HBM traffic
-    plus a scatter, which TPUs execute serially (~10M updates/s). TPU-first
-    reformulation: the per-threshold counts are a *contraction over samples*,
+    plus a scatter, which TPUs execute serially (~10M updates/s). Two
+    formulations, chosen at trace time by :func:`_bucketize_wanted` (CPU
+    backend -> bucketize, else contraction; env-overridable):
+
+    **Bucketize (CPU backend, sorted concrete thresholds).** ``ge[t] =
+    #{pred >= thr_t}`` is a SUFFIX SUM over the per-bin histogram of
+    ``bins = #{thr <= pred}``, so the whole state costs one O(N) bin index
+    (exact affine+3-compare for uniform grids, ``searchsorted`` otherwise),
+    ONE joint ``(bin, slot, target)`` scatter-add histogram, and an O(T)
+    cumulative sum — per-batch work independent of the threshold count
+    (ISSUE 9: 128 thresholds paid a 128x contraction here, and the serial
+    scatter beats it where the einsum cannot parallelize — measured 3.7x on
+    a 1-core CPU for the headline suite's dominant kernel).
+
+    **Contraction (TPU/manycore, or traced/unsorted thresholds).** The
+    per-threshold counts as a batched matmul,
 
         ge[t, c, y] = Σ_n  1[p_nc ≥ thr_t] · 1[y_nc == y] · valid_nc
 
-    i.e. a batched matmul ``einsum('nct,ncy->tcy')`` between the int8
-    threshold-compare tensor and the int8 target masks — MXU work (int8 runs
-    at twice the bf16 rate on v5e). Samples are processed in chunks under
-    ``lax.scan`` so the compare tensor never hits HBM at full size. Counts
-    accumulate exactly (0/1 operands, int32 accumulator).
+    ``einsum('nct,ncy->tcy')`` between the int8 threshold-compare tensor and
+    the int8 target masks — MXU work (int8 runs at twice the bf16 rate on
+    v5e), chunked under ``lax.scan`` so the compare tensor never hits HBM at
+    full size. Counts accumulate exactly (0/1 operands, int32 accumulator).
+
+    Both paths are bitwise-identical (integer counts from the same float
+    compares; pinned by ``test_binned_curve_state_formulations_bitwise``).
 
     ``preds``: (N, ...) probs; ``target_bin``: (N, ...) in {0,1};
     ``valid``: (N, ...) bool. Returns (T, ..., 2, 2) int32 where
@@ -215,8 +334,24 @@ def _binned_curve_state(preds: Array, target_bin: Array, valid: Array, threshold
     n = preds.shape[0] if n_inner == 1 else preds.reshape(-1, n_inner).shape[0]
     p = preds.reshape(n, n_inner)
     y = jnp.clip(target_bin, 0, 1).reshape(n, n_inner)
-
     v = valid.reshape(n, n_inner)
+
+    bins = _threshold_bins(p, thresholds)
+    if bins is not None:
+        # joint histogram over (bin, slot, target): one scatter-add of N·C
+        # elements; invalid entries route out of bounds and drop
+        slot = jnp.arange(n_inner, dtype=jnp.int32)[None, :]
+        n_cells = (len_t + 1) * n_inner * 2
+        flat = (bins * n_inner + slot) * 2 + y
+        flat = jnp.where(v, flat, n_cells)
+        hist = jnp.zeros(n_cells, jnp.int32).at[flat.reshape(-1)].add(1, mode="drop")
+        hist = hist.reshape(len_t + 1, n_inner, 2)
+        total = hist.sum(0)  # (C, 2) per-class target counts
+        # pred >= thr_t  <=>  bin > t: suffix-sum the histogram
+        ge = jnp.cumsum(hist[::-1], 0)[::-1][1:]  # (T, C, 2)
+        state = jnp.stack([total[None] - ge, ge], axis=-1)  # [t, inner, target, pred]
+        return state.reshape((len_t,) + inner + (2, 2)) if inner else state.reshape(len_t, 2, 2)
+
     masks_i = jnp.stack([(1 - y) * v, y * v], axis=-1)  # (N, C, 2) int
     total = masks_i.sum(0).astype(jnp.int32)  # (C, 2) per-class target counts
 

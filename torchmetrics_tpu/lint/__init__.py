@@ -19,6 +19,12 @@ Rules
 - **ML005** no metrics stored in containers ``parallel/sharded.py:
   _walk_metrics`` cannot traverse (``set``/``frozenset``) — such children are
   silently excluded from the deep snapshot/reset/restore.
+- **ML006** no unbounded ``cat``-list states on metrics claiming
+  ``full_state_update = False`` — point at the bounded sketch subsystem.
+- **ML007** no fusion-ineligible metrics (kwargs-only ``update``, host-state
+  metrics) constructed inline in a ``MetricCollection`` — the fused
+  evaluation plane (``MetricCollection.fused()``) will refuse them; the rule
+  and the runtime ``fusion_report`` apply the same predicate.
 
 Suppress a finding with ``# metriclint: disable=ML00x -- reason`` on the
 offending line (or the line above); whole files opt out of one rule with

@@ -22,6 +22,7 @@ RULES: Dict[str, str] = {
     "ML004": "numpy op on a traced value where a jnp equivalent exists",
     "ML005": "Metric stored in a container _walk_metrics cannot traverse",
     "ML006": "unbounded cat-list state on a metric claiming full_state_update=False",
+    "ML007": "fusion-ineligible metric constructed inside a MetricCollection",
 }
 
 
@@ -90,6 +91,9 @@ class ClassInfo:
     host_counters: Set[str]
     host_only: bool  # sets _sharded_update_unsupported (never on the jit path)
     fsu_false: bool = False  # declares a literal `full_state_update = False`
+    #: None = this class defines no update(); else whether its update accepts
+    #: any positional batch argument (the ML007 fusability signal)
+    update_positional: Optional[bool] = None
 
 
 def _base_name(node: ast.expr) -> Optional[str]:
@@ -121,12 +125,24 @@ def _call_arg(call: ast.Call, pos: int, kw: str) -> Optional[ast.expr]:
     return None
 
 
+def _update_accepts_positional(fn: ast.FunctionDef) -> bool:
+    """Whether an ``update`` def can receive a positional batch: any
+    non-self positional-or-keyword/positional-only parameter, or ``*args``."""
+    a = fn.args
+    named = [p for p in list(a.posonlyargs) + list(a.args) if p.arg not in ("self", "cls")]
+    return bool(named) or a.vararg is not None
+
+
 def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
     state_names: Set[str] = set()
     dynamic = False
     host_counters: Set[str] = set()
     host_only = False
     fsu_false = False
+    update_positional: Optional[bool] = None
+    for item in node.body:
+        if isinstance(item, ast.FunctionDef) and item.name == "update":
+            update_positional = _update_accepts_positional(item)
     for stmt in ast.walk(node):
         if isinstance(stmt, ast.Call) and _is_self_call(stmt, "add_state"):
             name_arg = _call_arg(stmt, 0, "name")
@@ -164,6 +180,7 @@ def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
         host_counters=host_counters,
         host_only=host_only,
         fsu_false=fsu_false,
+        update_positional=update_positional,
     )
 
 
@@ -234,6 +251,49 @@ class ClassIndex:
         ``full_state_update = False``. The ``Metric`` base's own default is
         excluded — "claims" means somebody opted the class in explicitly."""
         return any(cur.fsu_false for cur in self._ancestry(info) if cur.name != "Metric")
+
+    def fusion_ineligible(self, name: str) -> Optional[str]:
+        """Why a metric class named ``name`` cannot enter a fused plan
+        (``parallel/fused.py``), or ``None`` when nothing is provable.
+
+        The static mirror of the runtime ``fusion_ineligibility`` predicate:
+        host-state updates (``_sharded_update_unsupported``), host-side
+        counters, and kwargs-only ``update`` signatures. Name collisions and
+        unknown ancestry resolve conservatively to eligible — a ratchet
+        linter prefers missing a finding over inventing one.
+        """
+        infos = self._by_name.get(name, [])
+        if not infos or not self.is_metric_class(name):
+            return None
+        reasons: Set[str] = set()
+        for info in infos:
+            _states, counters, _dynamic, host_only = self.resolved_states(info)
+            if host_only:
+                reasons.add(
+                    "declares _sharded_update_unsupported (host-state update: its update"
+                    " cannot be traced into the fused step)"
+                )
+                continue
+            if counters:
+                reasons.add(
+                    f"declares host-side counters {sorted(counters)} that cannot ride the"
+                    " fused device state carry"
+                )
+                continue
+            # first ancestry entry that defines update() decides the signature
+            positional: Optional[bool] = None
+            for cur in self._ancestry(info):
+                if cur.update_positional is not None:
+                    positional = cur.update_positional
+                    break
+            if positional is False:
+                reasons.add(
+                    "update() accepts no positional batch arguments (kwargs-only"
+                    " signature) — the fused step passes the batch positionally"
+                )
+                continue
+            return None  # at least one definition of the name is eligible
+        return "; ".join(sorted(reasons)) if reasons else None
 
 
 # ------------------------------------------------------------ taint analysis
@@ -597,12 +657,59 @@ def check_ml005(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
                 )
 
 
+def check_ml007(path: str, tree: ast.Module, index: ClassIndex) -> Iterator[Violation]:
+    """Fusion-ineligible metrics constructed inline in a ``MetricCollection``.
+
+    The fused evaluation plane (``parallel/fused.py``,
+    ``MetricCollection.fused()``) refuses members whose ``update`` cannot be
+    traced positionally — kwargs-only signatures and host-state metrics
+    (``_sharded_update_unsupported``, host-side counters). This rule flags
+    the same members at the CONSTRUCTION site, so the linter and the plan's
+    runtime eligibility report agree (pinned by
+    ``test_ml007_agrees_with_runtime_eligibility``). Only inline constructor
+    calls are visible statically; collections built from variables are the
+    runtime report's job.
+    """
+
+    def callee_name(call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        return None
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and callee_name(node) == "MetricCollection"):
+            continue
+        seen: Set[Tuple[str, int, int]] = set()
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if not isinstance(sub, ast.Call):
+                    continue
+                cname = callee_name(sub)
+                if not cname or cname in ("MetricCollection", "Metric"):
+                    continue
+                reason = index.fusion_ineligible(cname)
+                if reason is None:
+                    continue
+                key = (cname, sub.lineno, sub.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Violation(
+                    "ML007", path, sub.lineno, sub.col_offset, f"MetricCollection[{cname}]",
+                    f"{cname} is fusion-ineligible: {reason} — MetricCollection.fused() will"
+                    " refuse this member (see parallel/fused.py fusion_report)",
+                )
+
+
 # ------------------------------------------------------------- file checking
 
 
 def check_file(path: str, tree: ast.Module, index: ClassIndex) -> List[Violation]:
     violations: List[Violation] = []
     checked_methods: Set[int] = set()
+    violations.extend(check_ml007(path, tree, index))
     for info in index.classes_in_file(path):
         if not index.is_metric_class(info.name):
             continue

@@ -50,7 +50,7 @@ import threading
 import time
 import weakref
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 from . import counters as _counters
 from . import trace as _trace
@@ -251,6 +251,18 @@ def note_instance(cls_name: str, member_name: str) -> None:
         if row is None:
             row = _registry[cls_name] = _new_row()
         row["instances"].add(member_name)
+
+
+def note_instances(cls_name: str, member_names: Iterable[str]) -> None:
+    """Batch :func:`note_instance` — the fused evaluation plane files its
+    compile/flops records under the COLLECTION class (the tag its one
+    compiled step carries), and this pins the member names onto that row so
+    ``metricscope top`` still says which metrics the fused cost covers."""
+    with _lock:
+        row = _registry.get(cls_name)
+        if row is None:
+            row = _registry[cls_name] = _new_row()
+        row["instances"].update(member_names)
 
 
 def metric_boundary(metric: Any) -> None:

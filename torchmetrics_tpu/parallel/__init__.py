@@ -33,6 +33,12 @@ from torchmetrics_tpu.parallel.cat_buffer import (
     cat_buffer_merge,
     cat_buffer_values,
 )
+from torchmetrics_tpu.parallel.feed import DeviceFeed
+from torchmetrics_tpu.parallel.fused import (
+    FusedCollectionPlan,
+    fusion_ineligibility,
+    fusion_report,
+)
 from torchmetrics_tpu.parallel.sharded import (
     ShardedMetric,
     deep_reductions,
@@ -48,6 +54,8 @@ from torchmetrics_tpu.parallel.sharded import (
 
 __all__ = [
     "CatBuffer",
+    "DeviceFeed",
+    "FusedCollectionPlan",
     "ShardedMetric",
     "cat_buffer_all_gather",
     "cat_buffer_append",
@@ -57,6 +65,8 @@ __all__ = [
     "deep_reductions",
     "deep_state_tree",
     "fold_jit_state",
+    "fusion_ineligibility",
+    "fusion_report",
     "make_jit_update",
     "make_sharded_update",
     "metric_merge",

@@ -1,0 +1,67 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Async double-buffered host→device batch feed.
+
+A streaming evaluation that calls ``step(state, *batch)`` on host-resident
+batches serializes two things that could overlap: the host→device transfer
+of batch k+1 and the compiled step on batch k. JAX dispatch is asynchronous,
+so overlap needs no threads — it needs the ``device_put`` of the NEXT batch
+to be *issued* before the current batch is consumed. :class:`DeviceFeed`
+does exactly that with a depth-bounded buffer (the classic double-buffer at
+``depth=2``, the default):
+
+::
+
+    plan = suite.fused()
+    for batch in DeviceFeed(batches):      # transfer k+1 overlaps step k
+        plan.update(*batch)
+
+``depth`` bounds device memory: at most ``depth`` staged batches are alive
+at once. Tuples/lists/dicts of arrays transfer as one pytree; numpy inputs
+upload, device-resident arrays pass through (a no-op ``device_put``).
+
+This is the host-side half of the fused evaluation plane's feed path
+(ISSUE 9); :meth:`FusedCollectionPlan.run_stream` wires it in.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Iterable, Iterator, Optional
+
+import jax
+
+__all__ = ["DeviceFeed"]
+
+
+class DeviceFeed:
+    """Iterate ``batches`` with up to ``depth`` device transfers in flight.
+
+    Args:
+        batches: any iterable of batches (pytrees of arrays — tuples of
+            ``(preds, target)`` in the common case).
+        device: target device; ``None`` uses the default device.
+        depth: how many batches to keep staged ahead of the consumer
+            (``2`` = classic double buffering; ``1`` degenerates to eager
+            per-batch transfer).
+    """
+
+    def __init__(self, batches: Iterable[Any], device: Optional[Any] = None, depth: int = 2) -> None:
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._batches = batches
+        self._device = device
+        self._depth = depth
+
+    def _put(self, batch: Any) -> Any:
+        # device_put on a pytree dispatches every leaf's transfer
+        # asynchronously and returns immediately
+        return jax.device_put(batch, self._device)
+
+    def __iter__(self) -> Iterator[Any]:
+        staged: deque = deque()
+        for batch in self._batches:
+            staged.append(self._put(batch))
+            if len(staged) >= self._depth:
+                yield staged.popleft()
+        while staged:
+            yield staged.popleft()

@@ -1,0 +1,632 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""One-dispatch fused evaluation plane: a whole ``MetricCollection`` as ONE
+compiled, donated, scan-able step.
+
+The unfused streaming loop pays, per batch and per metric, a Python
+``update()`` dispatch, a transactional state snapshot, and an obs-flag check
+— PR 8's cost ledger (``metricscope top --by host_self_ms``) shows that host
+self-time dominating the compiled device work for multi-metric collections.
+:class:`FusedCollectionPlan` removes it:
+
+- every member's registered state is flattened (via the same ``add_state``
+  registry ``make_jit_update`` consumes) into ONE state pytree
+  ``{"members": {key: {state...}}, "_update_count": i32}``, compute-group
+  dedup preserved — only group LEADERS are traced, so shared states compile
+  once and members keep riding the collection's state-ref propagation;
+- the entire collection update compiles into a single jitted step with
+  ``donate_argnums=0`` on the state carry: XLA updates the state in place,
+  so a streaming loop allocates nothing per batch;
+- :meth:`FusedCollectionPlan.run_scan` pushes a whole pre-staged chunk of
+  batches through the step under ``lax.scan`` — zero per-batch Python;
+  :meth:`FusedCollectionPlan.run_stream` adds the async double-buffered
+  host→device feed (:mod:`torchmetrics_tpu.parallel.feed`) so staging batch
+  k+1 overlaps the compiled step on batch k;
+- :meth:`FusedCollectionPlan.fold_back` installs the carried totals back
+  into the member metrics (CatBuffers become list states, the update count
+  restores, group members resync), so ``compute()``/``sync``/checkpointing
+  are completely unchanged — fold-back happens at snapshot/compute
+  boundaries, never per batch.
+
+**Parity contract.** The local (unsharded) step TRACES each leader's own
+``update`` against the carried state — the computation is literally the
+eager one, so fused == unfused is bitwise for every state kind (elementwise,
+cat/CatBuffer, sketch "merge"); pinned by
+``tests/unittests/bases/test_fused.py`` under plain jit, ``lax.scan``, and
+kill-and-resume. The sharded step mirrors ``sharded_update`` exactly
+(per-device fresh update, ``mesh_reduce_tree``, count-weighted fold), so
+fused-sharded == unfused-sharded bitwise on the same mesh.
+
+**Eligibility.** Fusion requires a traceable positional update: metrics with
+kwargs-only update signatures, host-state updates
+(``_sharded_update_unsupported``), host-side counters, or wrapper children
+are refused with a per-member report (:func:`fusion_report`) — metriclint
+rule ML007 flags the same constructions statically.
+
+With device telemetry enabled at build (:mod:`torchmetrics_tpu.obs.device`)
+the fused state additionally carries ONE ``TelemetryState`` for the whole
+collection (members see the same batch, so per-member carries would be
+copies); fold-back accumulates it into every leader's pending slot. Cold
+builds ride the AOT compile capture (``obs/xla.py``), recorded under the
+collection class with per-member ``instances`` so ``metricscope top`` still
+attributes the fused step's flops/compile cost.
+"""
+from __future__ import annotations
+
+import inspect
+import weakref
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu.obs import attribution as _obs_attr
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.obs import device as _obs_device
+from torchmetrics_tpu.obs import live as _obs_live
+from torchmetrics_tpu.obs import trace as _obs_trace
+from torchmetrics_tpu.obs import xla as _obs_xla
+from torchmetrics_tpu.parallel.cat_buffer import (
+    cat_buffer_append,
+    cat_buffer_init,
+    cat_buffer_values,
+    infer_cat_layout,
+)
+from torchmetrics_tpu.parallel.sharded import (
+    _SHARDED_FN_CACHE,
+    _batch_update_state,
+    _fingerprint_digest,
+    _update_arity,
+    _walk_fingerprint,
+    _walk_metrics,
+    mesh_reduce_tree,
+    shard_map,
+    tree_merge,
+)
+
+__all__ = ["FusedCollectionPlan", "fusion_ineligibility", "fusion_report"]
+
+_POSITIONAL_KINDS = (
+    inspect.Parameter.POSITIONAL_ONLY,
+    inspect.Parameter.POSITIONAL_OR_KEYWORD,
+    inspect.Parameter.VAR_POSITIONAL,
+)
+
+
+# ---------------------------------------------------------------- eligibility
+
+
+def fusion_ineligibility(metric: Any) -> Optional[str]:
+    """Why ``metric`` cannot enter a fused plan, or ``None`` when it can.
+
+    The SAME predicate metriclint's ML007 applies statically: kwargs-only
+    update signatures and host-state metrics are fusion-ineligible; the
+    runtime additionally refuses wrapper children and host-side counters
+    (things the AST cannot always prove).
+    """
+    reason = getattr(metric, "_sharded_update_unsupported", None)
+    if reason:
+        return f"host-state update ({reason})"
+    counters = getattr(metric, "_host_counters", ())
+    if counters:
+        return f"host-side counters {sorted(counters)} cannot ride the device state carry"
+    if not getattr(metric, "_defaults", None):
+        return "declares no registered states"
+    if len(_walk_metrics(metric)) > 1:
+        return "wraps child metrics; the fused state pytree covers only the root registry"
+    params = [
+        p for name, p in inspect.signature(type(metric).update).parameters.items() if name != "self"
+    ]
+    if not any(p.kind in _POSITIONAL_KINDS for p in params):
+        return "update() accepts no positional batch arguments (kwargs-only signature)"
+    return None
+
+
+def fusion_report(target: Any) -> Dict[str, Optional[str]]:
+    """Per-member eligibility report for a Metric or MetricCollection:
+    ``{member: None}`` when fusable, ``{member: reason}`` otherwise. The
+    plan's build raises with exactly these reasons; ML007 flags the same
+    members statically. Read-only: unlike the plan build, asking for a
+    report never touches the collection's state-ref propagation."""
+    members, _ = _resolve_members(target, propagate_state=False)
+    return {key: fusion_ineligibility(m) for key, m in members.items()}
+
+
+def _resolve_members(target: Any, propagate_state: bool = True) -> Tuple[Dict[str, Any], List[List[str]]]:
+    """``(members, groups)``: base-keyed member dict plus compute groups
+    (leader first). A bare Metric is a one-member collectionette. With
+    ``propagate_state`` (the plan build) a copy-state collection first
+    re-propagates leader state into members — the same entry protocol as
+    ``MetricCollection.update``; eligibility queries skip it."""
+    from torchmetrics_tpu.collections import MetricCollection
+    from torchmetrics_tpu.metric import Metric
+
+    if isinstance(target, MetricCollection):
+        if propagate_state and target._state_is_copy:
+            # mirror MetricCollection.update's entry: members must hold real
+            # (non-copy) state before we snapshot it into the carry
+            target._compute_groups_create_state_ref(copy=False)
+            target._state_is_copy = False
+        keys = sorted(dict.keys(target))
+        members = {k: dict.__getitem__(target, k) for k in keys}
+        if target._enable_compute_groups and target._groups_checked:
+            groups = [list(cg) for cg in target._groups.values()]
+        else:
+            # groups not (yet) established: every member leads itself. Run two
+            # eager updates (or pass explicit compute_groups) before fusing to
+            # let the dedup discovery fire — the plan freezes the assignment.
+            groups = [[k] for k in keys]
+        return members, groups
+    if isinstance(target, Metric):
+        name = type(target).__name__
+        return {name: target}, [[name]]
+    raise TypeError(f"cannot fuse a {type(target).__name__}; expected Metric or MetricCollection")
+
+
+# --------------------------------------------------------------------- helpers
+
+
+def _copy_tree(tree: Any) -> Any:
+    """Deep device copy of a state pytree: decouples the live metric (or a
+    fold-back target) from buffers the donated step will consume."""
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), tree)
+
+
+def _concat_rows(appended: Sequence[Any]) -> Any:
+    return jnp.concatenate([jnp.atleast_1d(x) for x in appended])
+
+
+class _MemberInfo:
+    """Static per-leader build record (not a pytree)."""
+
+    __slots__ = ("key", "metric", "reductions", "list_keys", "layout")
+
+    def __init__(self, key: str, metric: Any, cat_capacity: Optional[int], example_batch) -> None:
+        self.key = key
+        self.metric = metric
+        self.reductions = dict(metric._reductions)
+        self.list_keys = [k for k, v in metric._defaults.items() if isinstance(v, list)]
+        if self.list_keys and (cat_capacity is None or example_batch is None):
+            raise ValueError(
+                f"member {key!r} ({type(metric).__name__}) has list ('cat') states"
+                f" {self.list_keys}; the fused plan needs cat_capacity (max total rows)"
+                " and an example_batch to give them fixed-capacity CatBuffer carries"
+            )
+        self.layout = infer_cat_layout(metric, tuple(example_batch)) if self.list_keys else {}
+
+
+def _traced_member_update(info: _MemberInfo, mstate: Dict[str, Any], batch: Tuple[Any, ...]) -> Dict[str, Any]:
+    """One leader's update traced AGAINST the carried state.
+
+    Installing the carry and running the metric's own (wrapped) ``update``
+    makes the traced program literally the eager computation — the basis of
+    the fused==unfused bitwise guarantee. List ("cat") states are installed
+    empty; the freshly appended rows append into the CatBuffer carry. The
+    host-side metric object is snapshot/restored around the trace so no
+    tracer leaks out (same discipline as ``_batch_update_state``).
+    """
+    metric = info.metric
+    saved = metric._copy_state_dict()
+    saved_count, saved_computed = metric._update_count, metric._computed
+    saved_telemetry = getattr(metric, "_device_telemetry", None)
+    try:
+        install = {
+            k: v for k, v in mstate.items() if k not in info.list_keys and k != "_update_count"
+        }
+        for k in info.list_keys:
+            install[k] = []
+        metric._install_state_tree(install)
+        metric._computed = None
+        metric.update(*batch)
+        tree = metric.state_tree()
+    finally:
+        metric._install_state_tree(saved)  # self-snapshot: trusted
+        metric._update_count = saved_count
+        metric._computed = saved_computed
+        metric._device_telemetry = saved_telemetry
+    out = {k: v for k, v in tree.items() if k not in info.list_keys}
+    for k in info.list_keys:
+        appended = tree[k]
+        out[k] = mstate[k] if not appended else cat_buffer_append(mstate[k], _concat_rows(appended))
+    # the member's running update count rides ITS slice of the carry (seeded
+    # from the live metric at build), so the traced program never bakes in
+    # prior progress — a rebuilt plan over a resumed metric reuses the cache
+    out["_update_count"] = mstate["_update_count"] + 1
+    return out
+
+
+# ------------------------------------------------------------------- the plan
+
+
+class FusedCollectionPlan:
+    """Compile a whole collection's update into one donated step.
+
+    ::
+
+        suite = MetricCollection({"acc": ..., "f1": ..., "auroc": ...})
+        suite.update(p0, t0); suite.update(p1, t1)   # let compute groups form
+        plan = suite.fused()                          # ONE compiled step
+        for preds, target in stream:
+            plan.update(preds, target)               # one dispatch, N metrics
+        plan.run_scan(chunk)                          # or: zero per-batch Python
+        plan.fold_back()                              # states back in the metrics
+        suite.compute()                               # unchanged from here on
+
+    The carry is seeded from the members' CURRENT states (fusing mid-stream
+    or after a checkpoint restore just works) and donated on every step —
+    hold no references to ``plan.state`` across updates.
+
+    Args:
+        target: a ``MetricCollection`` (or bare ``Metric``).
+        cat_capacity: max TOTAL rows per list ("cat") state; required (with
+            ``example_batch``) when any member has list states.
+        example_batch: example positional batch, used only under
+            ``jax.eval_shape`` to size CatBuffer carries.
+        donate: donate the state carry (default True — the fused plane's
+            raison d'être); pass False to keep old states readable.
+        mesh/axis_name: build the SHARDED variant instead — the per-batch
+            step runs every leader's update under ``shard_map`` over the
+            mesh axis and mesh-reduces, exactly like ``sharded_update``.
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        *,
+        cat_capacity: Optional[int] = None,
+        example_batch: Optional[Tuple[Any, ...]] = None,
+        donate: bool = True,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "data",
+    ) -> None:
+        from torchmetrics_tpu.collections import MetricCollection
+
+        members, groups = _resolve_members(target)
+        report = {k: fusion_ineligibility(m) for k, m in members.items()}
+        bad = {k: r for k, r in report.items() if r}
+        if bad:
+            detail = "; ".join(f"{k}: {r}" for k, r in sorted(bad.items()))
+            raise ValueError(f"cannot fuse {type(target).__name__}: {detail}")
+        self.members = members
+        self.groups = groups
+        self._collection = target if isinstance(target, MetricCollection) else None
+        self._target_cls = type(target).__name__
+        self._donate = bool(donate)
+        self._mesh = mesh
+        self._axis = axis_name
+        self._cat_capacity = cat_capacity
+        self._telemetry_on, self._histogram = _obs_device.config_token()
+        self._infos = [
+            _MemberInfo(cg[0], members[cg[0]], cat_capacity, example_batch) for cg in groups
+        ]
+        if mesh is not None:
+            # the sharded carry folds fresh events with tree_merge: a None or
+            # custom-callable reduction on an ARRAY state stacks (shape grows
+            # per step), which cannot live in a fixed-shape compiled carry
+            for info in self._infos:
+                for name, red in info.reductions.items():
+                    if name not in info.list_keys and not isinstance(red, str):
+                        raise ValueError(
+                            f"cannot fuse {info.key!r} ({type(info.metric).__name__}) over a mesh:"
+                            f" array state {name!r} declares dist_reduce_fx={red!r}, whose stacking"
+                            " fold grows the state per step — fixed-shape carries need a named"
+                            " reduction (sum/mean/max/min/merge)"
+                        )
+        self._arity = (
+            len(example_batch)
+            if example_batch is not None
+            else max(_update_arity(info.metric) for info in self._infos)
+        )
+        if _obs_trace.ENABLED:
+            with _obs_trace.span(
+                "fused.build",
+                metric=self._target_cls,
+                members=len(members),
+                leaders=len(self._infos),
+                sharded=mesh is not None,
+            ):
+                self._build_steps()
+        else:
+            self._build_steps()
+        self._state = self._initial_state()
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
+            self._note_attribution()
+
+    # ------------------------------------------------------------------ build
+    def _fingerprint(self) -> str:
+        """Build identity: group structure, per-leader walk fingerprints and
+        init counts (both appear in the traced program), cat config, donation
+        and the telemetry token — the key fused compile records and the
+        sharded-step cache file under."""
+        return _fingerprint_digest(
+            "fused",
+            self._target_cls,
+            tuple(
+                (info.key, type(info.metric).__name__, _walk_fingerprint(info.metric),
+                 tuple(info.list_keys))
+                for info in self._infos
+            ),
+            tuple(tuple(cg) for cg in self.groups),
+            self._cat_capacity,
+            self._donate,
+            self._axis if self._mesh is not None else None,
+            _obs_device.config_token(),
+        )
+
+    def _build_steps(self) -> None:
+        raw = self._build_sharded_raw_step() if self._mesh is not None else self._build_local_raw_step()
+        self._raw_step = raw
+        jit_kwargs = {"donate_argnums": 0} if self._donate else {}
+        key = self._fingerprint()
+
+        # fused steps (local AND sharded) ride _SHARDED_FN_CACHE: rebuilding
+        # a plan over the same target — a resumed evaluator, a fresh plan per
+        # epoch — reuses the compiled steps instead of paying trace+compile
+        # again (the carry-riding update counts exist precisely so rebuilt
+        # programs are cache-identical). The "fused" marker keeps the key
+        # space disjoint from sharded_update's (id, id, axis, ...) keys.
+        cache_key = (
+            "fused", id(self._ref_target()),
+            id(self._mesh) if self._mesh is not None else None,
+            self._axis, key,
+        )
+        entry = _SHARDED_FN_CACHE.get(cache_key)
+        if (
+            entry is not None
+            and entry[0]() is self._ref_target()
+            and (self._mesh is None or entry[1]() is self._mesh)
+        ):
+            if _obs_trace.ENABLED:
+                _obs_counters.inc("fused.cache.hit")
+            self._step, self._scan_step = entry[2]
+            return
+        if _obs_trace.ENABLED:
+            _obs_counters.inc("fused.cache.miss")
+
+        def step_fn(state, *batch):
+            return raw(state, batch)
+
+        def chunk_fn(state, stacked):
+            def body(s, b):
+                return raw(s, b), None
+
+            return jax.lax.scan(body, state, stacked)[0]
+
+        self._step = _obs_xla.instrument_jit(
+            jax.jit(step_fn, **jit_kwargs),
+            key=key, metric=self._target_cls, kind="fused", span_prefix="fused.update",
+        )
+        self._scan_step = _obs_xla.instrument_jit(
+            jax.jit(chunk_fn, **jit_kwargs),
+            key=f"{key}:scan", metric=self._target_cls, kind="fused_scan", span_prefix="fused.scan",
+        )
+        def _dead(k: Tuple) -> bool:
+            # fresh-plan-per-collection is advertised usage: entries whose
+            # target (or mesh) was garbage-collected would otherwise pin the
+            # member metrics + compiled steps via the closure forever
+            e = _SHARDED_FN_CACHE[k]
+            return e[0]() is None or (e[1] is not None and e[1]() is None)
+
+        stale = [
+            k for k in _SHARDED_FN_CACHE
+            if isinstance(k, tuple) and k[:1] == ("fused",) and k != cache_key
+            and (k[1:4] == cache_key[1:4] or _dead(k))
+        ]
+        for old in stale:
+            del _SHARDED_FN_CACHE[old]
+        if stale and _obs_trace.ENABLED:
+            _obs_counters.inc("fused.cache.evict", len(stale))
+        _SHARDED_FN_CACHE[cache_key] = (
+            weakref.ref(self._ref_target()),
+            weakref.ref(self._mesh) if self._mesh is not None else None,
+            (self._step, self._scan_step),
+        )
+
+    def _ref_target(self) -> Any:
+        return self._collection if self._collection is not None else self._infos[0].metric
+
+    def _build_local_raw_step(self):
+        infos, telemetry_on = self._infos, self._telemetry_on
+
+        def raw_step(state, batch):
+            members = state["members"]
+            out_members = {info.key: _traced_member_update(info, members[info.key], batch) for info in infos}
+            out = {"members": out_members, "_update_count": state["_update_count"] + 1}
+            if telemetry_on:
+                out["_telemetry"] = _obs_device.telemetry_update(state["_telemetry"], batch)
+            return out
+
+        return raw_step
+
+    def _build_sharded_raw_step(self):
+        infos, axis, mesh = self._infos, self._axis, self._mesh
+        telemetry_on, histogram = self._telemetry_on, self._histogram
+
+        def per_device(*batch):
+            out = {}
+            for info in infos:
+                partial = _batch_update_state(info.metric, batch, {})
+                out[info.key] = mesh_reduce_tree(info.reductions, partial, axis)
+            if telemetry_on:
+                fresh = _obs_device.telemetry_update(
+                    _obs_device.telemetry_init(max(1, len(batch)), histogram), batch
+                )
+                out["_telemetry"] = _obs_device.telemetry_mesh_reduce(fresh, axis)
+            return out
+
+        def raw_step(state, batch):
+            # batch shapes are static under trace, so the specs (and the
+            # shard_map they parameterize) resolve at trace time
+            specs = tuple(P(axis) if getattr(jnp.asarray(a), "ndim", 0) >= 1 else P() for a in batch)
+            fresh = shard_map(per_device, mesh=mesh, in_specs=specs, out_specs=P(), check_rep=False)(*batch)
+            out_members = {}
+            for info in infos:
+                carry, f = state["members"][info.key], fresh[info.key]
+                prev = carry["_update_count"]
+                arr = {k: v for k, v in f.items() if k not in info.list_keys}
+                merged = tree_merge(
+                    {k: info.reductions[k] for k in arr},
+                    {k: carry[k] for k in arr},
+                    arr,
+                    weight_a=prev,
+                    weight_b=1,
+                )
+                # sharded_update LOADS the first-ever event's merged state
+                # instead of folding it into the defaults — select the same
+                # behavior so step one stays bitwise (sketch merges against
+                # an empty default are not identity). prev rides the carry,
+                # so the program is independent of prior progress.
+                merged = {
+                    k: jax.tree_util.tree_map(
+                        lambda mv, fv: jnp.where(prev == 0, fv, mv), merged[k], arr[k]
+                    )
+                    for k in merged
+                }
+                for k in info.list_keys:
+                    merged[k] = cat_buffer_append(carry[k], _concat_rows(f[k]))
+                merged["_update_count"] = prev + 1
+                out_members[info.key] = merged
+            out = {"members": out_members, "_update_count": state["_update_count"] + 1}
+            if telemetry_on:
+                out["_telemetry"] = _obs_device.telemetry_merge(state["_telemetry"], fresh["_telemetry"])
+            return out
+
+        return raw_step
+
+    def _initial_state(self) -> Dict[str, Any]:
+        members: Dict[str, Any] = {}
+        for info in self._infos:
+            metric = info.metric
+            slice_: Dict[str, Any] = {}
+            for name in metric._defaults:
+                value = getattr(metric, name)
+                if name in info.list_keys:
+                    elem, dtype = info.layout[name]
+                    buf = cat_buffer_init(self._cat_capacity, elem, dtype)
+                    if value:  # fusing mid-stream: existing rows seed the buffer
+                        buf = cat_buffer_append(buf, _concat_rows(value))
+                    slice_[name] = buf
+                else:
+                    # copies decouple the carry from the live metric state:
+                    # the first donated step must not delete buffers the
+                    # metric (or a checkpoint in flight) still references
+                    slice_[name] = _copy_tree(value)
+            slice_["_update_count"] = jnp.asarray(metric._update_count, jnp.int32)
+            members[info.key] = slice_
+        state: Dict[str, Any] = {"members": members, "_update_count": jnp.asarray(0, jnp.int32)}
+        if self._telemetry_on:
+            state["_telemetry"] = _obs_device.telemetry_init(self._arity, self._histogram)
+        return state
+
+    def _note_attribution(self) -> None:
+        """Record the fused plan's join keys in the cost-attribution registry:
+        member names under the COLLECTION row (where the fused XLA records
+        land) and under each member's own class row."""
+        _obs_attr.note_instances(self._target_cls, list(self.members))
+        for key, metric in self.members.items():
+            _obs_attr.note_instance(type(metric).__name__, key)
+
+    # ------------------------------------------------------------------ drive
+    @property
+    def state(self) -> Dict[str, Any]:
+        """The current state carry. With ``donate=True`` (the default) the
+        next ``update``/``run_scan`` consumes these buffers — read, don't
+        hold."""
+        return self._state
+
+    @property
+    def updates_applied(self) -> int:
+        """Fused steps applied since the plan was built (host sync)."""
+        return int(self._state["_update_count"])
+
+    def update(self, *batch: Any) -> None:
+        """Apply one batch: ONE compiled call for the whole collection."""
+        self._state = self._step(self._state, *batch)
+
+    def run_scan(self, batches: Any) -> None:
+        """Scan a pre-staged chunk of batches through the step — zero
+        per-batch Python. ``batches`` is either a sequence of positional
+        batch tuples (staged/stacked here, one host→device transfer) or an
+        already-stacked tuple of arrays whose leading axis is the scan axis.
+        """
+        self._state = self._scan_step(self._state, self.stage(batches))
+
+    def run_stream(self, batches: Iterable[Any], prefetch: int = 2) -> None:
+        """Drive an iterable of batches through the double-buffered device
+        feed: ``device_put`` of batch k+1 is dispatched while the compiled
+        step runs on batch k (see :mod:`torchmetrics_tpu.parallel.feed`)."""
+        from torchmetrics_tpu.parallel.feed import DeviceFeed
+
+        for batch in DeviceFeed(batches, depth=prefetch):
+            if isinstance(batch, tuple):
+                self.update(*batch)
+            else:
+                self.update(batch)
+
+    @staticmethod
+    def stage(batches: Any) -> Tuple[Any, ...]:
+        """Stack a sequence of batch tuples into scan-ready arrays."""
+        if isinstance(batches, tuple):
+            return tuple(jnp.asarray(b) for b in batches)
+        seq = list(batches)
+        if not seq:
+            raise ValueError("run_scan needs at least one batch")
+        if not isinstance(seq[0], tuple):
+            return (jnp.stack([jnp.asarray(b) for b in seq]),)
+        arity = len(seq[0])
+        return tuple(jnp.stack([jnp.asarray(b[i]) for b in seq]) for i in range(arity))
+
+    # -------------------------------------------------------------- fold-back
+    def fold_back(self) -> None:
+        """Install the carried totals back into the member metrics.
+
+        Call at snapshot/compute boundaries (the :class:`StreamingEvaluator`
+        fused drive does) — never per batch. Leaders get their exact state
+        tree (CatBuffers fold to list states, raising on overflow; the update
+        count restores as ``init + fused steps``); compute-group members
+        resync counts and ride the collection's ordinary state-ref
+        propagation at the next ``compute()``. Idempotent: folding twice
+        installs the same totals. The carry stays valid — keep updating and
+        fold again at the next boundary. Installed values are device COPIES,
+        so the next donated step cannot delete state the metrics now hold.
+        """
+        state = self._state
+        count = int(state["_update_count"])  # host sync: the fold IS a host boundary
+        for info in self._infos:
+            metric = info.metric
+            mstate = _copy_tree(state["members"][info.key])
+            tree: Dict[str, Any] = {}
+            for name in metric._defaults:
+                if name in info.list_keys:
+                    tree[name] = [cat_buffer_values(mstate[name])]  # raises on overflow
+                else:
+                    tree[name] = mstate[name]
+            tree["_update_count"] = int(mstate["_update_count"])
+            metric.load_state_tree(tree)
+            metric._computed = None
+        telemetry = state.get("_telemetry")
+        if telemetry is not None and count > 0:
+            # one carry for the whole collection (members saw the same
+            # batches): every leader's pending slot accumulates it, exactly
+            # what per-member make_jit_update carries would have measured
+            t_copy = _copy_tree(telemetry)
+            for info in self._infos:
+                _obs_device.accumulate(info.metric, t_copy, self._histogram)
+            fresh = dict(state)
+            fresh["_telemetry"] = _obs_device.telemetry_init(self._arity, self._histogram)
+            self._state = fresh
+        for cg in self.groups:
+            leader = self.members[cg[0]]
+            for key in cg[1:]:
+                member = self.members[key]
+                member._update_count = leader._update_count
+                member._computed = None
+        if self._collection is not None:
+            # members hold (or will lazily receive) leader state — the same
+            # post-update invariant MetricCollection.update leaves behind
+            self._collection._state_is_copy = False
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
+            self._note_attribution()
+            for info in self._infos:
+                _obs_attr.metric_boundary(info.metric)

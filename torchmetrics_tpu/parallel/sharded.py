@@ -186,6 +186,7 @@ def make_jit_update(
     metric: "Any",
     cat_capacity: Optional[int] = None,
     example_batch: Optional[Tuple[Any, ...]] = None,
+    donate: bool = False,
 ) -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
     """Build ``(step, init_state)`` where ``step(state, *batch) -> state`` is jitted.
 
@@ -216,11 +217,22 @@ def make_jit_update(
     ``compute()`` drains it into ``device.<Metric>.*`` gauges. Disabled
     (the default) the traced program is byte-identical to this docstring's
     plain contract — zero extra HLO ops.
+
+    ``donate=True`` donates the state carry (``donate_argnums=0``): XLA may
+    reuse the input state's buffers for the output, so a streaming loop
+    updates in place instead of allocating a fresh state per batch — the
+    regime the fused collection plane (``parallel/fused.py``) runs in. The
+    caller's OLD state reference is consumed (reading it afterwards raises);
+    that is a property of the ``donate`` flag ALONE — enabling/disabling
+    device telemetry never changes the caller-visible buffer semantics
+    (pinned by ``test_make_jit_update_donate_semantics_telemetry_invariant``).
+    Default off: the lone-metric path keeps the append-only, caller-holds-
+    the-state contract unchanged.
     """
     if _obs_trace.ENABLED:
         with _obs_trace.span("parallel.jit_build", metric=type(metric).__name__):
-            return _make_jit_update(metric, cat_capacity, example_batch)
-    return _make_jit_update(metric, cat_capacity, example_batch)
+            return _make_jit_update(metric, cat_capacity, example_batch, donate)
+    return _make_jit_update(metric, cat_capacity, example_batch, donate)
 
 
 def _fingerprint_digest(*parts: Any) -> str:
@@ -247,8 +259,19 @@ def _make_jit_update(
     metric: "Any",
     cat_capacity: Optional[int] = None,
     example_batch: Optional[Tuple[Any, ...]] = None,
+    donate: bool = False,
 ) -> Tuple[Callable[..., Dict[str, Any]], Dict[str, Any]]:
     base_step, init_state = _build_update_step(metric, cat_capacity, example_batch)
+    # donation is the CALLER's choice, applied identically whether telemetry
+    # is on or off — an observability flag must never change caller-visible
+    # buffer semantics (with donate=True the telemetry carry is donated too:
+    # it is part of the state the caller handed over)
+    jit_kwargs = {"donate_argnums": 0} if donate else {}
+    if donate:
+        # the raw init state aliases the metric's _defaults arrays; a donated
+        # first step consuming THOSE buffers would break every later reset().
+        # Fresh copies make the handed-out state safely consumable.
+        init_state = jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), init_state)
     telemetry_on, histogram = _obs_device.config_token()
     if telemetry_on:
         # the in-graph telemetry carry (obs/device.py): decided at BUILD time
@@ -265,15 +288,16 @@ def _make_jit_update(
             out["_telemetry"] = _obs_device.telemetry_update(telemetry, batch)
             return out
 
-        # deliberately NOT donated here: an observability flag must never
-        # change buffer semantics the caller sees (donation would delete
-        # state a caller still holds). Callers that want donation wrap the
-        # step in their own ``jax.jit(..., donate_argnums=0)`` — the
-        # telemetry carry rides whatever aliasing the outer jit declares.
-        jitted = jax.jit(step)
+        # NOT donated by default: an observability flag must never change
+        # buffer semantics the caller sees (donation would delete state a
+        # caller still holds). With ``donate=True`` the caller opted in and
+        # the telemetry carry rides the same aliasing.
+        jitted = jax.jit(step, **jit_kwargs)
     else:
-        jitted = jax.jit(base_step)
-    key = _fingerprint_digest("jit_update", type(metric).__name__, _walk_fingerprint(metric), telemetry_on)
+        jitted = jax.jit(base_step, **jit_kwargs)
+    key = _fingerprint_digest(
+        "jit_update", type(metric).__name__, _walk_fingerprint(metric), telemetry_on, donate
+    )
     return (
         _obs_xla.instrument_jit(
             jitted, key=key, metric=type(metric).__name__, kind="jit_update", span_prefix="parallel.jit_update"

@@ -82,6 +82,21 @@ class StreamingEvaluator:
         on_stall: ``"raise"`` surfaces :class:`StallError` immediately;
             ``"snapshot_then_raise"`` first persists the last-good state
             (pre-stall cursor) to ``store``.
+        fused: drive the one-dispatch fused evaluation plane
+            (``parallel/fused.py``): a ``FusedCollectionPlan`` is built from
+            the metric at the first batch (after any resume restore, so the
+            carry picks up the restored states) and every batch costs ONE
+            compiled call regardless of collection size. Fold-back into the
+            member metrics happens only at snapshot/compute boundaries —
+            never per batch — so the exactly-once cursor, the snapshot
+            payloads and the final ``compute()`` are byte-for-byte the
+            unfused protocol. Mutually exclusive with ``update_fn``. Note
+            ``on_stall="snapshot_then_raise"`` captures a payload per batch
+            and therefore folds back per batch — correct, but it forfeits
+            the fused plane's per-batch savings.
+        fused_options: kwargs for the plan build (``cat_capacity``,
+            ``example_batch``, ``donate``, ``mesh``, ``axis_name``);
+            ``example_batch`` defaults to the first batch.
 
     One evaluator instance drives one pass: :meth:`run` starts from batch 0
     (and demands a fresh store), :meth:`resume` restores the newest valid
@@ -98,6 +113,8 @@ class StreamingEvaluator:
         update_fn: Optional[Callable[[Any, Any], None]] = None,
         watchdog_timeout_s: Optional[float] = None,
         on_stall: str = "raise",
+        fused: bool = False,
+        fused_options: Optional[Dict[str, Any]] = None,
     ) -> None:
         if snapshot_every_n is not None and snapshot_every_n < 1:
             raise ValueError(f"snapshot_every_n must be >= 1, got {snapshot_every_n}")
@@ -109,11 +126,17 @@ class StreamingEvaluator:
             raise ValueError(f"on_stall must be one of {_ON_STALL}, got {on_stall!r}")
         if store is not None and not isinstance(store, CheckpointStore):
             raise ValueError(f"store must be a CheckpointStore, got {type(store).__name__}")
+        if fused and update_fn is not None:
+            raise ValueError("fused=True drives the FusedCollectionPlan itself; it cannot combine with update_fn")
         self.metric = metric
         self.store = store
         self.snapshot_every_n = snapshot_every_n
         self.snapshot_every_s = snapshot_every_s
         self.update_fn = update_fn or _default_update
+        self.fused = bool(fused)
+        self.fused_options = dict(fused_options or {})
+        #: the live FusedCollectionPlan while a fused drive is in flight
+        self._fused_plan: Optional[Any] = None
         self.watchdog_timeout_s = watchdog_timeout_s
         self.on_stall = on_stall
         #: number of batches fully applied to the metric state
@@ -205,6 +228,11 @@ class StreamingEvaluator:
             raise
 
     def _payload(self) -> Dict[str, Any]:
+        if self._fused_plan is not None:
+            # a payload is a host boundary: the carried fused totals fold
+            # back into the member metrics first, so every snapshot (periodic,
+            # stall capture, final) serializes exactly the applied batches
+            self._fused_plan.fold_back()
         return {
             "payload_version": RUNNER_PAYLOAD_VERSION,
             "cursor": self.cursor,
@@ -290,18 +318,20 @@ class StreamingEvaluator:
             self.snapshot()
 
     # -------------------------------------------------------------- watchdog
-    def _bounded(self, fn: Callable[[], Any], what: str) -> Any:
-        """Run ``fn`` under the watchdog deadline (same daemon-thread trade as
-        ``Metric._sync_dist_bounded``: a timed-out step cannot be cancelled
-        and its state is poisoned — the caller must treat a StallError as
-        fatal for this process and resume in a fresh one)."""
+    def _bounded(self, fn: Callable[..., Any], what: str, *args: Any) -> Any:
+        """Run ``fn(*args)`` under the watchdog deadline (same daemon-thread
+        trade as ``Metric._sync_dist_bounded``: a timed-out step cannot be
+        cancelled and its state is poisoned — the caller must treat a
+        StallError as fatal for this process and resume in a fresh one).
+        Taking ``*args`` lets the drive loop pass the batch to one hoisted
+        per-drive callable instead of allocating a closure per batch."""
         if not self.watchdog_timeout_s:
-            return fn()
+            return fn(*args)
         box: Dict[str, Any] = {}
 
         def _worker() -> None:
             try:
-                box["value"] = fn()
+                box["value"] = fn(*args)
             except BaseException as err:
                 box["err"] = err
 
@@ -460,9 +490,40 @@ class StreamingEvaluator:
                 _obs_live.unregister_probe(probe_name)
         return self._drive_impl(batches, skip)
 
+    def _make_apply(self) -> Callable[[Any], None]:
+        """The per-batch step, hoisted to ONE per-drive callable: the loop
+        used to allocate a fresh lambda (re-reading ``self.update_fn`` and
+        ``self.metric``) for every batch — per-batch host cost the fused
+        plane exists to eliminate. Fused drives build the plan lazily at the
+        first batch, so ``resume()`` restores state first and the plan's
+        carry seeds from the restored members."""
+        if not self.fused:
+            update_fn, metric = self.update_fn, self.metric
+            return lambda batch: update_fn(metric, batch)
+
+        def apply_fused(batch: Any) -> None:
+            plan = self._fused_plan
+            if plan is None:
+                plan = self._build_fused_plan(batch)
+            if isinstance(batch, tuple):
+                plan.update(*batch)
+            else:
+                plan.update(batch)
+
+        return apply_fused
+
+    def _build_fused_plan(self, batch: Any) -> Any:
+        from torchmetrics_tpu.parallel.fused import FusedCollectionPlan
+
+        options = dict(self.fused_options)
+        options.setdefault("example_batch", batch if isinstance(batch, tuple) else (batch,))
+        self._fused_plan = FusedCollectionPlan(self.metric, **options)
+        return self._fused_plan
+
     def _drive_impl(self, batches: Iterable[Any], skip: int) -> Any:
         self.cursor = skip
         self._last_snapshot_t = time.monotonic()
+        self._fused_plan = None  # one plan per drive, built at the first batch
         snapshotting_stalls = self.on_stall == "snapshot_then_raise" and self.watchdog_timeout_s
         stream = iter(batches)
         skipped = 0
@@ -476,19 +537,26 @@ class StreamingEvaluator:
                     " interrupted run consumed"
                 ) from None
             skipped += 1
+        apply_batch = self._make_apply()
         for batch in stream:
             if snapshotting_stalls:
                 # the stall snapshot must pre-date the (possibly half-applied)
                 # stalled update; capture costs one host round-trip per batch
-                # and is only paid when the policy asks for it
+                # (plus a fused fold-back) and is only paid when the policy
+                # asks for it
                 self._last_good_payload = self._payload()
-            self._bounded(lambda: self.update_fn(self.metric, batch), "update")
+            self._bounded(apply_batch, "update", batch)
             self.cursor += 1
             if _obs_live.ENABLED or _obs_trace.ENABLED:
                 self._record_progress(batch)
             if faults._ACTIVE:  # preemption drill: die after batch k, before its snapshot
                 faults.fire("runner.preempt")
             self._maybe_snapshot()
+        if self._fused_plan is not None:
+            # the drive is over: fold the carried totals into the members so
+            # the final snapshot AND compute() see them (non-writer ranks
+            # never reach _payload, so this fold cannot ride it)
+            self._fused_plan.fold_back()
         # final snapshot so a completed pass is restorable/auditable ...
         self.snapshot()
         if snapshotting_stalls:
