@@ -324,6 +324,7 @@ def main() -> None:
             bench_live_publish,
             bench_retrieval_ndcg,
             bench_sketch_quantile,
+            bench_sliced_fanout,
             bench_ssim,
             bench_wer,
         )
@@ -333,6 +334,10 @@ def main() -> None:
             # runs FIRST so `metricscope bench diff` always has the
             # fused-vs-unfused pair even in a degraded session
             ("fused_suite_throughput", bench_fused_suite, (n_batches,), 120),
+            # the sliced fan-out plane (ISSUE 10): 1024 cohort cells in one
+            # dispatch vs the naive 1024-member loop — runs second so the
+            # acceptance ratio lands even in a degraded session
+            ("sliced_fanout_throughput", bench_sliced_fanout, (), 120),
             ("wer", bench_wer, (max(512, n_batches * 256),), 45),
             # bounded-memory sketch throughput + peak-state-bytes vs the
             # equivalent cat-state metric (ISSUE 4): cheap, runs early

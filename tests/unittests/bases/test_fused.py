@@ -417,6 +417,62 @@ def test_device_feed_depth_one_and_empty():
         DeviceFeed([], depth=0)
 
 
+def test_device_feed_staging_fault_propagates_to_consumer():
+    """Satellite (ISSUE 10): a producer exception during async staging must
+    surface on the consumer's next get() — before this contract the drive
+    loop blocked on a queue that would never fill until the watchdog fired."""
+    import time
+
+    from torchmetrics_tpu.robustness import faults
+
+    batches = [(np.full((4,), i, np.float32),) for i in range(6)]
+    with faults.inject(faults.Fault(kind="fail", point="feed.stage", after=2)):
+        consumed = []
+        t0 = time.monotonic()
+        with pytest.raises(faults.FaultInjected):
+            for batch in DeviceFeed(batches, depth=2):
+                consumed.append(batch)
+        assert len(consumed) == 2  # the batches staged before the fault
+        assert time.monotonic() - t0 < 5.0  # prompt, not a watchdog-scale stall
+
+
+def test_device_feed_producer_iterable_exception_propagates():
+    def gen():
+        yield (np.arange(3, dtype=np.float32),)
+        raise RuntimeError("decode exploded")
+
+    feed = iter(DeviceFeed(gen(), depth=2))
+    next(feed)
+    with pytest.raises(RuntimeError, match="decode exploded"):
+        next(feed)
+
+
+def test_device_feed_honors_consumer_thread_default_device():
+    """Review fix: a `with jax.default_device(...)` scope is thread-local —
+    the staging thread must land batches where the CONSUMER's context says,
+    not on the global default."""
+    target_dev = jax.devices()[3]
+    with jax.default_device(target_dev):
+        out = list(DeviceFeed([(np.arange(4, dtype=np.float32),)]))
+    assert list(out[0][0].devices()) == [target_dev]
+
+
+def test_device_feed_early_abandon_stops_producer():
+    import threading
+    import time
+
+    batches = [(np.full((4,), i, np.float32),) for i in range(50)]
+    for i, _batch in enumerate(DeviceFeed(batches, depth=1)):
+        if i == 1:
+            break
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if not any(t.name == "tm-tpu-device-feed" and t.is_alive() for t in threading.enumerate()):
+            return
+        time.sleep(0.05)
+    raise AssertionError("staging thread still alive after the consumer abandoned iteration")
+
+
 def test_run_stream_matches_eager():
     batches = _batches(5, seed=9)
     host_batches = [(np.asarray(p), np.asarray(t)) for p, t in batches]

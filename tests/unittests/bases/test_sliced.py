@@ -1,0 +1,531 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tests for the sliced evaluation plane (ISSUE 10).
+
+The contract under test: fanning a metric out over N cohort cells inside ONE
+compiled dispatch changes NOTHING observable — every resident cell's state is
+bitwise-identical to an independent per-cohort metric fed exactly that
+cohort's rows, for elementwise, cat and sketch states, under plain jit,
+``lax.scan``, the sharded mesh, and kill-and-resume through
+``CheckpointStore``. Overflow spills rows (latched counter), never corrupts
+resident cells.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import Metric, MetricCollection, obs
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC
+from torchmetrics_tpu.parallel import (
+    SlicedPlan,
+    slice_key_reason,
+    slice_table_size_reason,
+    sliced_ineligibility,
+)
+from torchmetrics_tpu.robustness import CheckpointStore
+from torchmetrics_tpu.sketch.histogram import hist_init, hist_update
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+
+NUM_CLASSES = 5
+BATCH = 48
+NUM_CELLS = 32
+NUM_COHORTS = 7
+NUM_DEVICES = 8
+
+
+def _kw(**extra):
+    return dict(validate_args=False, distributed_available_fn=lambda: False, **extra)
+
+
+class _ScoreHistogram(Metric):
+    """Sketch ('merge') coverage with an ADD-style sketch: histogram counts
+    are exact under any merge order, so per-cohort slicing is bitwise."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("hist", hist_init(bins=8, lo=0.0, hi=1.0), dist_reduce_fx="merge")
+
+    def update(self, preds, target):
+        self.hist = hist_update(self.hist, jax.nn.softmax(preds, -1).max(-1))
+
+    def compute(self):
+        return self.hist.counts.astype(jnp.float32) / jnp.maximum(self.hist.count, 1)
+
+
+def _suite(with_exact: bool = True) -> MetricCollection:
+    members = {
+        "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()),
+        "hist": _ScoreHistogram(distributed_available_fn=lambda: False),
+    }
+    if with_exact:
+        members["auroc_exact"] = MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw())
+    return MetricCollection(members, compute_groups=False)
+
+
+def _batches(n, seed=0, cohorts=NUM_COHORTS):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.integers(0, cohorts, BATCH).astype(np.int32)),
+            jnp.asarray(rng.standard_normal((BATCH, NUM_CLASSES)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, NUM_CLASSES, BATCH).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _independent_refs(batches, factory):
+    """One independent metric per cohort, fed exactly that cohort's rows —
+    the ground truth sliced(k=N) must match bitwise."""
+    refs = {}
+    for keys, preds, target in batches:
+        keys_np = np.asarray(keys)
+        for k in np.unique(keys_np):
+            m = refs.setdefault(int(k), factory())
+            sel = keys_np == k
+            m.update(preds[jnp.asarray(sel)], target[jnp.asarray(sel)])
+    return refs
+
+
+def _assert_trees_bitwise(m1, m2, context):
+    assert m1._update_count == m2._update_count, context
+    for name in m1._defaults:
+        v1, v2 = getattr(m1, name), getattr(m2, name)
+        if isinstance(v1, list):
+            c1 = np.concatenate([np.atleast_1d(np.asarray(x)) for x in v1]) if v1 else np.zeros((0,))
+            c2 = np.concatenate([np.atleast_1d(np.asarray(x)) for x in v2]) if v2 else np.zeros((0,))
+            assert c1.shape == c2.shape and (c1 == c2).all(), f"{context}: state {name}"
+        else:
+            for a, b in zip(jax.tree_util.tree_leaves(v1), jax.tree_util.tree_leaves(v2)):
+                assert (np.asarray(a) == np.asarray(b)).all(), f"{context}: state {name}"
+
+
+def _assert_exported_matches_refs(plan, refs, context, member_keys=None):
+    for k, ref in refs.items():
+        exported = plan.export_cell(k)
+        if member_keys is None:
+            _assert_trees_bitwise(ref, exported, f"{context} cohort {k}")
+        else:
+            for key in member_keys:
+                _assert_trees_bitwise(
+                    dict.__getitem__(ref, key), dict.__getitem__(exported, key),
+                    f"{context} cohort {k} member {key}",
+                )
+
+
+# ------------------------------------------------------------ bitwise parity
+
+
+def test_sliced_k1_equals_plain_metric():
+    """sliced(k=1): one cohort's cell == the plain eager metric, bitwise."""
+    batches = _batches(4, seed=0, cohorts=1)
+    plain = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=1)
+    for keys, preds, target in batches:
+        plain.update(preds, target)
+        plan.update(keys, preds, target)
+    assert plan.occupancy == 1.0 and plan.spills == 0
+    exported = plan.export_cell(0)
+    _assert_trees_bitwise(plain, exported, "k=1")
+    assert np.asarray(plain.compute()) == np.asarray(exported.compute())
+
+
+def test_sliced_jit_parity_full_suite():
+    """sliced(k=N) == N independent metrics, bitwise, for elementwise + cat
+    + sketch states (a whole collection per cell)."""
+    batches = _batches(4, seed=1)
+    plan = SlicedPlan(
+        _suite(), num_cells=NUM_CELLS, cat_capacity=BATCH * 4 + 8,
+        example_batch=(batches[0][1], batches[0][2]),
+    )
+    for keys, preds, target in batches:
+        plan.update(keys, preds, target)
+    refs = _independent_refs(batches, _suite)
+    assert plan.spills == 0
+    assert set(plan.occupied_cells()) == {(k,) for k in refs}
+    _assert_exported_matches_refs(plan, refs, "jit", member_keys=["acc", "hist", "auroc_exact"])
+    for k, ref in refs.items():
+        r1, r2 = ref.compute(), plan.export_cell(k).compute()
+        assert set(r1) == set(r2)
+        for key in r1:
+            assert (np.asarray(r1[key]) == np.asarray(r2[key])).all(), (k, key)
+
+
+def test_sliced_scan_parity():
+    """run_scan (zero per-batch Python) == per-batch update == independents."""
+    batches = _batches(5, seed=2)
+    p_scan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=NUM_CELLS)
+    p_scan.run_scan([b[0] for b in batches], [(b[1], b[2]) for b in batches])
+    refs = _independent_refs(batches, lambda: MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()))
+    _assert_exported_matches_refs(p_scan, refs, "scan")
+    assert p_scan.updates_applied == len(batches)
+
+
+@pytest.mark.skipif(len(jax.devices()) < NUM_DEVICES, reason="needs the 8-device CPU mesh")
+def test_sliced_sharded_parity_full_suite():
+    """The sharded variant (rows sharded over the mesh, replicated table) ==
+    the local plan == N independent metrics, bitwise, incl cat + sketch."""
+    mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("data",))
+    batches = _batches(3, seed=3)
+    cap = BATCH * 3 + 8
+    example = (batches[0][1], batches[0][2])
+    p_mesh = SlicedPlan(_suite(), num_cells=NUM_CELLS, mesh=mesh, cat_capacity=cap, example_batch=example)
+    p_local = SlicedPlan(_suite(), num_cells=NUM_CELLS, cat_capacity=cap, example_batch=example)
+    for keys, preds, target in batches:
+        p_mesh.update(keys, preds, target)
+        p_local.update(keys, preds, target)
+    refs = _independent_refs(batches, _suite)
+    members = ["acc", "hist", "auroc_exact"]
+    _assert_exported_matches_refs(p_mesh, refs, "mesh-vs-independent", member_keys=members)
+    for k in refs:
+        e1, e2 = p_mesh.export_cell(k), p_local.export_cell(k)
+        for key in members:
+            _assert_trees_bitwise(
+                dict.__getitem__(e1, key), dict.__getitem__(e2, key), f"mesh-vs-local {k} {key}"
+            )
+
+
+def test_sliced_kill_and_resume_parity(tmp_path):
+    """Checkpoint mid-stream through CheckpointStore, die, rebuild a fresh
+    plan in a new object graph, restore, finish: == the uninterrupted run,
+    bitwise — cells, table and spill counter included."""
+    batches = _batches(6, seed=4)
+    cap = BATCH * 6 + 8
+    example = (batches[0][1], batches[0][2])
+
+    def build():
+        return SlicedPlan(_suite(), num_cells=NUM_CELLS, cat_capacity=cap, example_batch=example)
+
+    uninterrupted = build()
+    for keys, preds, target in batches:
+        uninterrupted.update(keys, preds, target)
+
+    store = CheckpointStore(os.path.join(str(tmp_path), "store"), keep_last=2)
+    victim = build()
+    for keys, preds, target in batches[:4]:
+        victim.update(keys, preds, target)
+    store.save(victim.save_checkpoint(), step=4)
+    del victim  # the "kill"
+
+    resumed = build()
+    step, payload = CheckpointStore(os.path.join(str(tmp_path), "store"), keep_last=2).latest()
+    assert step == 4
+    resumed.load_checkpoint(payload)
+    for keys, preds, target in batches[4:]:
+        resumed.update(keys, preds, target)
+
+    assert resumed.updates_applied == uninterrupted.updates_applied
+    assert resumed.occupied_cells() == uninterrupted.occupied_cells()
+    for k in {key[0] for key in uninterrupted.occupied_cells()}:
+        for key in ("acc", "hist", "auroc_exact"):
+            _assert_trees_bitwise(
+                dict.__getitem__(uninterrupted.export_cell(k), key),
+                dict.__getitem__(resumed.export_cell(k), key),
+                f"resume {k} {key}",
+            )
+
+
+def test_sliced_compute_all_matches_export():
+    batches = _batches(3, seed=5)
+    plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=NUM_CELLS)
+    for b in batches:
+        plan.update(*b)
+    values = plan.compute_all()["MulticlassAccuracy"]
+    for key, cell in plan.occupied_cells().items():
+        assert np.asarray(values[cell]) == np.asarray(plan.export_cell(key[0]).compute())
+
+
+def test_sliced_compute_all_group_members_use_own_compute():
+    """Review fix: compute-group members share the leader's STATE but each
+    vmaps its OWN compute — precision and recall must differ per cell."""
+    from torchmetrics_tpu.classification import MulticlassPrecision, MulticlassRecall
+
+    batches = _batches(3, seed=13)
+    col = MetricCollection(
+        {
+            "prec": MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", **_kw()),
+            "rec": MulticlassRecall(num_classes=NUM_CLASSES, average="macro", **_kw()),
+        }
+    )
+    col.update(batches[0][1], batches[0][2])
+    col.update(batches[1][1], batches[1][2])
+    col.reset()
+    plan = col.sliced(num_cells=NUM_CELLS)
+    assert len(plan._infos) == 1  # prec/rec share one leader
+    for b in batches:
+        plan.update(*b)
+    values = plan.compute_all()
+    refs = _independent_refs(
+        batches,
+        lambda: MetricCollection(
+            {
+                "prec": MulticlassPrecision(num_classes=NUM_CLASSES, average="macro", **_kw()),
+                "rec": MulticlassRecall(num_classes=NUM_CLASSES, average="macro", **_kw()),
+            },
+            compute_groups=False,
+        ),
+    )
+    for k, ref in refs.items():
+        cell = plan.lookup(k)
+        want = ref.compute()
+        assert np.asarray(values["prec"][cell]) == np.asarray(want["prec"]), k
+        assert np.asarray(values["rec"][cell]) == np.asarray(want["rec"]), k
+
+
+def test_sliced_results_and_tuple_keys():
+    """Multi-component cohort keys (country, model-version) hash as one
+    cohort; results() keys by the full tuple."""
+    rng = np.random.default_rng(6)
+    plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(
+        num_cells=NUM_CELLS, key_width=2
+    )
+    k1 = jnp.asarray(rng.integers(0, 3, BATCH).astype(np.int32))
+    k2 = jnp.asarray(rng.integers(0, 2, BATCH).astype(np.int32))
+    preds = jnp.asarray(rng.standard_normal((BATCH, NUM_CLASSES)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, BATCH).astype(np.int32))
+    plan.update((k1, k2), preds, target)
+    res = plan.results()
+    seen = {(int(a), int(b)) for a, b in zip(np.asarray(k1), np.asarray(k2))}
+    assert set(res) == seen
+    for (a, b), value in res.items():
+        sel = jnp.asarray((np.asarray(k1) == a) & (np.asarray(k2) == b))
+        ref = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+        ref.update(preds[sel], target[sel])
+        assert np.asarray(value) == np.asarray(ref.compute())
+
+
+# -------------------------------------------------------- overflow and spill
+
+
+def test_sliced_overflow_spills_and_preserves_residents():
+    """More cohorts than cells: overflow rows DROP and latch the spill
+    counter; resident cells stay exact (never corrupted)."""
+    batches = _batches(2, seed=7, cohorts=12)
+    plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=4)
+    for b in batches:
+        plan.update(*b)
+    assert plan.occupancy == 1.0
+    assert plan.spills > 0
+    refs = _independent_refs(batches, lambda: MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()))
+    resident = plan.occupied_cells()
+    assert len(resident) == 4
+    for (k,) in resident:
+        _assert_trees_bitwise(refs[k], plan.export_cell(k), f"resident {k}")
+    with pytest.raises(KeyError, match="spilled or never seen"):
+        spilled = sorted(set(refs) - {k for (k,) in resident})[0]
+        plan.export_cell(spilled)
+
+
+def test_sliced_cat_per_cell_overflow_raises_on_export():
+    batches = _batches(3, seed=8, cohorts=2)
+    plan = SlicedPlan(
+        MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw()),
+        num_cells=4, cat_capacity=8, example_batch=(batches[0][1], batches[0][2]),
+    )
+    for b in batches:
+        plan.update(*b)
+    with pytest.raises(RuntimeError, match="overflow"):
+        plan.export_cell(0)
+
+
+# ------------------------------------------------------------- eligibility
+
+
+class _MeanState(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("avg", jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, preds, target):
+        self.avg = preds.mean()
+
+    def compute(self):
+        return self.avg
+
+
+def test_sliced_eligibility_refusals():
+    assert "mean" in sliced_ineligibility(_MeanState())
+    with pytest.raises(ValueError, match="mean"):
+        _MeanState().sliced(num_cells=8)
+    assert sliced_ineligibility(MulticlassAccuracy(num_classes=3, **_kw())) is None
+
+
+def test_sliced_table_sizing_and_key_predicates():
+    with pytest.raises(ValueError, match="static positive python int"):
+        SlicedPlan(MulticlassAccuracy(num_classes=3, **_kw()), num_cells=8.0)
+    with pytest.raises(ValueError, match="at least|>= 1"):
+        SlicedPlan(MulticlassAccuracy(num_classes=3, **_kw()), num_cells=0)
+    with pytest.raises(ValueError, match="integer"):
+        SlicedPlan(
+            MulticlassAccuracy(num_classes=3, **_kw()),
+            num_cells=8, example_keys=jnp.asarray([1.5, 2.5]),
+        )
+    plan = MulticlassAccuracy(num_classes=3, **_kw()).sliced(num_cells=8)
+    with pytest.raises(ValueError, match="integer"):
+        plan.update(jnp.asarray([0.5]), jnp.zeros((1, 3)), jnp.zeros((1,), jnp.int32))
+    assert slice_table_size_reason(16) is None
+    assert slice_key_reason(jnp.int32) is None
+
+
+def test_sliced_refuses_truncating_64bit_keys():
+    """Review fix: int64 cohort ids past int32 would silently ALIAS cohorts
+    mod 2^32 — refused with the split-into-components pointer; in-range
+    64-bit host inputs (numpy's default int dtype) still work."""
+    plan = MulticlassAccuracy(num_classes=3, **_kw()).sliced(num_cells=8)
+    preds = jnp.zeros((2, 3), jnp.float32)
+    target = jnp.zeros((2,), jnp.int32)
+    with pytest.raises(ValueError, match="alias"):
+        plan.update(np.array([1, 2**32 + 1], dtype=np.int64), preds, target)
+    assert plan.updates_applied == 0
+    # numpy default int64 with in-range values is fine (bounds-checked, cast)
+    plan.update(np.array([1, 2]), preds, target)
+    assert set(plan.occupied_cells()) == {(1,), (2,)}
+
+
+def test_sliced_run_scan_validates_key_width():
+    """Review fix: a stacked scan key array gets the SAME key_width
+    validation update() enforces — width-1 keys into a key_width=2 plan
+    raise instead of silently broadcasting into both key columns."""
+    plan = MulticlassAccuracy(num_classes=3, **_kw()).sliced(num_cells=8, key_width=2)
+    batches = [(jnp.zeros((4, 3), jnp.float32), jnp.zeros((4,), jnp.int32))]
+    with pytest.raises(ValueError, match="key_width|component"):
+        plan.run_scan(np.full((1, 4), 5, np.int32), batches)
+    assert plan.updates_applied == 0
+
+
+def test_sliced_refuses_dirty_template():
+    metric = MulticlassAccuracy(num_classes=3, **_kw())
+    metric.update(jnp.zeros((2, 3)), jnp.zeros((2,), jnp.int32))
+    with pytest.raises(ValueError, match="pristine"):
+        metric.sliced(num_cells=8)
+    metric.reset()
+    metric.sliced(num_cells=8)  # clean again: fine
+
+
+def test_sliced_example_keys_infers_width():
+    plan = MulticlassAccuracy(num_classes=3, **_kw()).sliced(
+        num_cells=8, example_keys=(jnp.asarray([1, 2]), jnp.asarray([3, 4]))
+    )
+    assert plan.key_width == 2
+    # an EXPLICIT key_width disagreeing with example_keys raises at build
+    # instead of being silently overwritten (review fix)
+    with pytest.raises(ValueError, match="disagrees"):
+        MulticlassAccuracy(num_classes=3, **_kw()).sliced(
+            num_cells=8, key_width=2, example_keys=jnp.asarray([1, 2])
+        )
+
+
+# ---------------------------------------------------- durability negatives
+
+
+def test_sliced_checkpoint_refuses_mismatches(tmp_path):
+    batches = _batches(2, seed=9)
+    plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=NUM_CELLS)
+    for b in batches:
+        plan.update(*b)
+    payload = plan.save_checkpoint()
+
+    other_geometry = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=8)
+    with pytest.raises(StateRestoreError, match="fingerprint"):
+        other_geometry.load_checkpoint(payload)
+
+    other_metric = MulticlassAccuracy(num_classes=NUM_CLASSES + 1, **_kw()).sliced(num_cells=NUM_CELLS)
+    with pytest.raises(StateRestoreError, match="fingerprint"):
+        other_metric.load_checkpoint(payload)
+
+    same = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=NUM_CELLS)
+    bad_version = dict(payload, sliced_format=99)
+    with pytest.raises(StateRestoreError, match="format"):
+        same.load_checkpoint(bad_version)
+
+    corrupt = dict(payload)
+    corrupt["members"] = {
+        k: dict(v) for k, v in payload["members"].items()
+    }
+    member = next(iter(corrupt["members"]))
+    state = next(n for n in corrupt["members"][member] if n != "_update_count")
+    corrupt["members"][member][state] = np.zeros((3, 3), np.float64)
+    before = same.save_checkpoint()
+    with pytest.raises(StateRestoreError, match="shape|leaf"):
+        same.load_checkpoint(corrupt)
+    # validate-all-then-apply: the failed restore touched nothing
+    after = same.save_checkpoint()
+    assert before["update_count"] == after["update_count"]
+    np.testing.assert_array_equal(before["table"]["occupied"], after["table"]["occupied"])
+
+
+# ------------------------------------------------------------- cache & obs
+
+
+def test_sliced_step_rides_cache():
+    batches = _batches(2, seed=10)
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    with obs.tracing():
+        plan1 = metric.sliced(num_cells=8)
+        plan1.update(*batches[0])
+        plan2 = metric.sliced(num_cells=8)
+        assert obs.snapshot()["counters"].get("sliced.cache.hit") == 1
+        assert plan2._step is plan1._step and plan2._scan_step is plan1._scan_step
+
+
+def test_sliced_gauges_and_attribution_row():
+    """slice.table.* gauges + the per-table state_bytes attribution row in
+    the cost ledger — and nothing published when obs is off."""
+    from torchmetrics_tpu.obs import attribution
+    from torchmetrics_tpu.obs import counters as obs_counters
+
+    batches = _batches(2, seed=11)
+    plan_off = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=8)
+    plan_off.update(*batches[0])
+    plan_off.publish_gauges()  # disabled path: one flag check, no gauges
+    assert "slice.table.occupancy" not in obs_counters.snapshot()["gauges"]
+
+    attribution.clear()
+    with obs.tracing():
+        plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=8)
+        for b in batches:
+            plan.update(*b)
+        res = plan.results()
+        snap = obs_counters.snapshot()["gauges"]
+        assert 0.0 < snap["slice.table.occupancy"] <= 1.0
+        assert snap["slice.table.cells"] == 8
+        assert snap["slice.table.spills"] == plan.spills
+        assert snap["metric.SlicedPlan.state_bytes"] == sum(plan.state_byte_sizes().values())
+        rows = attribution.registry_rows()
+        assert "MulticlassAccuracy.tp" in rows["SlicedPlan"]["state_bytes"]
+        assert "table" in rows["SlicedPlan"]["state_bytes"]
+        # the carry's leaves join the DEDUP total (what watch prefers): a
+        # later metric_boundary must count the plan's footprint (review fix)
+        attribution.metric_boundary(MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()))
+        total = obs_counters.snapshot()["gauges"]["metric.state_bytes_total"]
+        assert total >= sum(plan.state_byte_sizes().values())
+        ledger = attribution.build_ledger([], {}, snap, registry=rows)
+        row = next(r for r in ledger["metrics"] if r["metric"] == "SlicedPlan")
+        assert row["state_bytes"] == sum(plan.state_byte_sizes().values())
+    assert res  # the per-cohort values came through
+    obs_counters.clear()
+    attribution.clear()
+
+
+def test_sliced_live_probe_and_watch_occupancy_column():
+    """The live probe feeds the watch dashboard's occupancy column."""
+    from torchmetrics_tpu.obs import live
+
+    batches = _batches(1, seed=12)
+    plan = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()).sliced(num_cells=8)
+    plan.update(*batches[0])
+    probe = plan.live_probe()
+    assert 0.0 < probe["slice.table.occupancy"] <= 1.0
+    status = {
+        "rank": 0, "epoch_ns": 1, "counters": {}, "health": {"state": "ok"},
+        "gauges": {"slice.table.occupancy": probe["slice.table.occupancy"]},
+    }
+    frame = live.format_watch_table([status])
+    assert "occup" in frame
+    assert f"{100.0 * probe['slice.table.occupancy']:.0f}%" in frame

@@ -1,0 +1,402 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Tests for the windowed evaluation plane (ISSUE 10).
+
+The contract under test: a query over k closed windows equals recomputing
+the metric from scratch over exactly those windows' batches — bitwise for
+exact-merge state kinds (integer elementwise, cat, add-style sketches) —
+the ring expires windows past ``slots``, a tumbling every_n=1 ring matches
+the ``Running`` wrapper it supersedes, and kill-and-resume through the
+``StreamingEvaluator`` snapshot payload restores the closed windows with
+the open state.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric, MetricCollection
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC
+from torchmetrics_tpu.parallel import WindowRing
+from torchmetrics_tpu.robustness import CheckpointStore, StreamingEvaluator
+from torchmetrics_tpu.sketch.histogram import hist_init, hist_update
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+from torchmetrics_tpu.wrappers.running import Running
+
+NUM_CLASSES = 5
+BATCH = 24
+
+
+def _kw(**extra):
+    return dict(validate_args=False, distributed_available_fn=lambda: False, **extra)
+
+
+class _ScoreHistogram(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("hist", hist_init(bins=8, lo=0.0, hi=1.0), dist_reduce_fx="merge")
+
+    def update(self, preds, target):
+        self.hist = hist_update(self.hist, jax.nn.softmax(preds, -1).max(-1))
+
+    def compute(self):
+        return self.hist.counts
+
+
+def _batches(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.standard_normal((BATCH, NUM_CLASSES)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, NUM_CLASSES, BATCH).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _suite():
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()),
+            "auroc_exact": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=None, **_kw()),
+            "hist": _ScoreHistogram(distributed_available_fn=lambda: False),
+        },
+        compute_groups=False,
+    )
+
+
+# --------------------------------------------------------------- query fold
+
+
+def test_windowed_query_equals_recompute_from_scratch():
+    """query(last=k) == a fresh metric over exactly those windows' batches,
+    for every k — bitwise (integer confusion counts)."""
+    batches = _batches(10, seed=0)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=5, every_n=2)
+    for i, b in enumerate(batches):
+        acc.update(*b)
+        ring.observe(i + 1)
+    assert len(ring) == 5 and ring.open_batches == 0
+    for k in (1, 2, 5):
+        ref = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+        for b in batches[len(batches) - 2 * k:]:
+            ref.update(*b)
+        assert np.asarray(ring.query(last=k)) == np.asarray(ref.compute()), k
+
+
+def test_windowed_collection_with_cat_and_sketch_states():
+    """The fold supports the whole _REDUCTION_MAP contract: elementwise sums,
+    cat list concatenation, sketch merge — one collection, all three."""
+    batches = _batches(6, seed=1)
+    col = _suite()
+    ring = WindowRing(col, slots=3, every_n=2)
+    for i, b in enumerate(batches):
+        col.update(*b)
+        ring.observe(i + 1)
+    ref = _suite()
+    for b in batches[2:]:
+        ref.update(*b)
+    got, want = ring.query(last=2, include_open=False), None
+    ref2 = _suite()
+    for b in batches[2:6]:
+        ref2.update(*b)
+    want = ref2.compute()
+    for key in want:
+        assert (np.asarray(got[key]) == np.asarray(want[key])).all(), key
+    # the full ring (cat + sketch states) round-trips the checkpoint-format
+    # payload: a restored ring answers the same query bitwise
+    restored = WindowRing(_suite(), slots=3, every_n=2)
+    restored.restore(ring.payload())
+    got2 = restored.query(last=2)
+    for key in want:
+        assert (np.asarray(got2[key]) == np.asarray(want[key])).all(), key
+
+
+def test_windowed_include_open_and_expiry():
+    batches = _batches(7, seed=2)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=2, every_n=2)
+    for i, b in enumerate(batches):
+        acc.update(*b)
+        ring.observe(i + 1)
+    # windows: [0-1],[2-3],[4-5] closed; slots=2 keeps [2-3],[4-5]; open=[6]
+    assert len(ring) == 2 and ring.open_batches == 1
+    ref = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    for b in batches[2:]:
+        ref.update(*b)
+    assert np.asarray(ring.query(include_open=True)) == np.asarray(ref.compute())
+    with pytest.raises(ValueError, match="no closed windows"):
+        WindowRing(MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()), slots=2).query()
+
+
+def test_windowed_query_leaves_target_untouched():
+    batches = _batches(3, seed=3)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=2, every_n=1)
+    for i, b in enumerate(batches):
+        acc.update(*b)
+        ring.observe(i + 1)
+    before = {k: np.asarray(v) for k, v in acc.state_tree().items()}
+    ring.query(last=2)
+    after = {k: np.asarray(v) for k, v in acc.state_tree().items()}
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+
+
+class _MeanState(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("avg", jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, values):
+        self.avg = values.mean()
+
+    def compute(self):
+        return self.avg
+
+
+def test_windowed_empty_window_does_not_dilute_mean_states():
+    """Review fix: an EMPTY closed window (zero traffic — real serving
+    information) folds with its TRUE weight 0, so 'mean' states keep the
+    recompute parity instead of averaging in default state."""
+    metric = _MeanState(distributed_available_fn=lambda: False)
+    ring = WindowRing(metric, slots=3, every_n=1)
+    metric.update(jnp.asarray([2.0, 4.0]))
+    ring.observe(1)          # window 1: mean 3.0
+    ring.rotate(2)           # window 2: EMPTY (no traffic)
+    assert len(ring) == 2
+    assert np.asarray(ring.query(last=2)) == np.asarray(3.0)
+    # the all-empty fold stays finite (defaults, not NaN)
+    ring.rotate(3)
+    assert np.isfinite(np.asarray(ring.query(last=2)))
+
+
+def test_runner_rejected_checkpoint_leaves_ring_untouched(tmp_path):
+    """Review fix: a snapshot whose window payload validates but whose
+    metric checkpoint is REJECTED must not half-apply — the live ring keeps
+    its prior windows (validate-ALL-then-apply across both restores)."""
+    batches = _batches(4, seed=10)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=2, every_n=1)
+    acc.update(*batches[0])
+    ring.observe(1)
+    good_window = ring.payload()
+    prior_len = len(ring)
+
+    ev = StreamingEvaluator(acc, window_ring=ring)
+    bad_payload = {
+        "payload_version": 1,
+        "cursor": 3,
+        "kind": "metric",
+        "checkpoint": {"not": "a checkpoint"},
+        "window": good_window,
+    }
+    with pytest.raises(Exception):
+        ev._validate_payload(bad_payload)
+    assert len(ring) == prior_len  # the valid window payload was NOT applied
+
+
+# ------------------------------------------------------- Running bridge
+
+
+def test_tumbling_ring_matches_running_wrapper():
+    """Satellite: a tumbling every_n=1 ring of N slots == Running(metric,
+    window=N) on the overlap — the serving-scale successor reproduces the
+    wrapper it replaces."""
+    rng = np.random.default_rng(4)
+    window = 4
+    # integer-valued floats: addition is exact in any association order, so
+    # the pin stays BITWISE even though Running folds slots in slot-index
+    # (circular) order while the ring folds chronologically
+    values = [jnp.asarray(rng.integers(-50, 50, 8).astype(np.float32)) for _ in range(9)]
+    base = SumMetric(distributed_available_fn=lambda: False)
+    ring = WindowRing(base, slots=window, every_n=1)
+    wrapped = Running(SumMetric(distributed_available_fn=lambda: False), window=window)
+    for i, x in enumerate(values):
+        base.update(x)
+        ring.observe(i + 1)
+        wrapped.update(x)
+        if i + 1 >= window:
+            assert np.asarray(ring.query(last=window)) == np.asarray(wrapped.compute()), i
+
+
+# --------------------------------------------------------- runner plumbing
+
+
+def test_runner_drives_rotation_and_snapshot_payload(tmp_path):
+    batches = _batches(8, seed=5)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=3, every_n=2)
+    store = CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=2)
+    StreamingEvaluator(acc, store=store, snapshot_every_n=4, window_ring=ring).run(batches)
+    assert len(ring) == 3  # 4 closed, oldest expired
+    _, payload = store.latest()
+    assert payload["window"]["ring"]  # closed windows ride the snapshot
+
+
+def test_runner_windowed_kill_and_resume_parity(tmp_path):
+    batches = _batches(9, seed=6)
+
+    def build():
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+        return m, WindowRing(m, slots=3, every_n=2)
+
+    ref_metric, ref_ring = build()
+    StreamingEvaluator(ref_metric, window_ring=ref_ring).run(batches)
+
+    victim, victim_ring = build()
+    store = CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=3)
+    poisoned = batches[:6] + [None]
+    with pytest.raises(Exception):
+        StreamingEvaluator(
+            victim, store=store, snapshot_every_n=2, window_ring=victim_ring
+        ).run(poisoned)
+
+    resumed, resumed_ring = build()
+    StreamingEvaluator(
+        resumed,
+        store=CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=3),
+        window_ring=resumed_ring,
+    ).resume(batches)
+    assert len(resumed_ring) == len(ref_ring)
+    for k in (1, 3):
+        assert np.asarray(resumed_ring.query(last=k)) == np.asarray(ref_ring.query(last=k)), k
+    for name in ref_metric._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref_metric, name)), np.asarray(getattr(resumed, name))
+        )
+
+
+def test_runner_windowed_restore_refuses_unwindowed_snapshot(tmp_path):
+    batches = _batches(4, seed=7)
+    plain = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    store = CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=2)
+    StreamingEvaluator(plain, store=store, snapshot_every_n=2).run(batches)
+
+    windowed = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(windowed, slots=2, every_n=2)
+    ev = StreamingEvaluator(
+        windowed,
+        store=CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=2),
+        window_ring=ring,
+    )
+    # every snapshot lacks the ring: the recovery ladder exhausts and the
+    # run restarts from batch 0 (the ladder's contract for invalid payloads)
+    with pytest.warns(Warning):
+        ev.resume(batches)
+    assert ev.cursor == len(batches)
+
+
+def test_runner_unwindowed_resume_refuses_windowed_snapshot(tmp_path):
+    """Review fix: an evaluator WITHOUT a ring must refuse a windowed
+    snapshot rather than silently dropping the closed windows (and erasing
+    them from the store at the next snapshot)."""
+    batches = _batches(4, seed=11)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=2, every_n=2)
+    store = CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=2)
+    StreamingEvaluator(acc, store=store, snapshot_every_n=2, window_ring=ring).run(batches)
+
+    bare = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ev = StreamingEvaluator(bare, store=CheckpointStore(os.path.join(str(tmp_path), "s"), keep_last=2))
+    # every snapshot is windowed: the recovery ladder exhausts (each refusal
+    # is a named validation error) and the run restarts from 0
+    with pytest.warns(Warning):
+        ev.resume(batches)
+    assert ev.cursor == len(batches)
+
+
+def test_runner_rejects_bad_ring_combinations():
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    other = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(other, slots=2, every_n=1)
+    with pytest.raises(ValueError, match="SAME metric"):
+        StreamingEvaluator(acc, window_ring=ring)
+    ring2 = WindowRing(acc, slots=2, every_n=1)
+    with pytest.raises(ValueError, match="fused"):
+        StreamingEvaluator(acc, window_ring=ring2, fused=True)
+    with pytest.raises(ValueError, match="WindowRing"):
+        StreamingEvaluator(acc, window_ring=object())
+
+
+# ----------------------------------------------------------- payload + obs
+
+
+def test_window_payload_negatives():
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=2, every_n=1)
+    acc.update(*_batches(1, seed=8)[0])
+    ring.observe(1)
+    payload = ring.payload()
+
+    with pytest.raises(StateRestoreError, match="version"):
+        ring.restore(dict(payload, window_payload_version=99))
+    with pytest.raises(StateRestoreError, match="slots"):
+        WindowRing(MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()), slots=3).restore(payload)
+    oversized = dict(payload)
+    oversized["ring"] = [payload["ring"][0]] * 5  # more entries than slots
+    with pytest.raises(StateRestoreError, match="at most slots"):
+        ring.restore(oversized)
+    corrupt = dict(payload)
+    corrupt["ring"] = [dict(payload["ring"][0])]
+    corrupt["ring"][0]["members"] = {
+        "MulticlassAccuracy": {"tp": np.zeros((2, 2)), "_update_count": 1}
+    }
+    with pytest.raises(StateRestoreError):
+        ring.restore(corrupt)
+    assert len(ring) == 1  # failed restore touched nothing
+
+
+def test_window_payload_cache_tracks_rotations():
+    """Review fix: the encoded closed ring is cached per rotation (the
+    per-batch stall-capture path), and a rotation invalidates it."""
+    batches = _batches(3, seed=12)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=3, every_n=1)
+    acc.update(*batches[0])
+    ring.observe(1)
+    p1 = ring.payload()
+    p2 = ring.payload()
+    assert p1["ring"][0] is p2["ring"][0]  # cached between rotations
+    acc.update(*batches[1])
+    ring.observe(2)
+    p3 = ring.payload()
+    assert len(p3["ring"]) == 2  # rotation invalidated + re-encoded
+    np.testing.assert_array_equal(
+        p3["ring"][0]["members"]["MulticlassAccuracy"]["tp"],
+        p1["ring"][0]["members"]["MulticlassAccuracy"]["tp"],
+    )
+    # the cached payload round-trips like a fresh one
+    ring2 = WindowRing(MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw()), slots=3, every_n=1)
+    ring2.restore(p3)
+    assert len(ring2) == 2
+
+
+def test_window_gauges_and_probe():
+    from torchmetrics_tpu import obs
+    from torchmetrics_tpu.obs import counters as obs_counters
+
+    batches = _batches(2, seed=9)
+    acc = MulticlassAccuracy(num_classes=NUM_CLASSES, **_kw())
+    ring = WindowRing(acc, slots=2, every_n=1)
+    acc.update(*batches[0])
+    ring.observe(1)  # obs off: no gauges
+    assert "window.MulticlassAccuracy.slots_live" not in obs_counters.snapshot()["gauges"]
+    with obs.tracing():
+        acc.update(*batches[1])
+        ring.observe(2)
+        snap = obs_counters.snapshot()
+        assert snap["gauges"]["window.MulticlassAccuracy.slots_live"] == 2
+        assert snap["counters"]["window.MulticlassAccuracy.rotations"] == 1
+    probe = ring.probe()
+    assert probe["window.MulticlassAccuracy.slots_live"] == 2.0
+    assert probe["window.MulticlassAccuracy.age_s"] >= 0.0
+    obs_counters.clear()

@@ -71,6 +71,16 @@ def seeded_collection():
     from torchmetrics_tpu import MetricCollection
 
     return MetricCollection({"kw": SeededKwOnlyMetric()})
+
+
+def seeded_sliced(n_cohorts):
+    from torchmetrics_tpu.parallel import SlicedPlan
+
+    return SlicedPlan(
+        SeededBadMetric(),
+        num_cells=n_cohorts / 2,
+        example_keys=jnp.asarray([1.5, 2.5]),
+    )
 '''
 
 
@@ -119,6 +129,8 @@ def test_seeded_violation_details(seeded_file, tmp_path):
     assert any("set/frozenset" in v.message for v in by_rule["ML005"])
     assert any("sketch" in v.message for v in by_rule["ML006"])
     assert any("fusion-ineligible" in v.message for v in by_rule["ML007"])
+    assert any("slice-table sizing" in v.message for v in by_rule["ML008"])
+    assert any("cohort-key" in v.message for v in by_rule["ML008"])
 
 
 _ML007_SNIPPET = '''
@@ -228,6 +240,73 @@ def test_ml007_agrees_with_runtime_eligibility(tmp_path):
         if fusion_ineligibility(namespace[name]()) is not None
     }
     assert lint_flagged == runtime_flagged
+
+
+_ML008_SNIPPET = '''
+import jax
+import jax.numpy as jnp
+from torchmetrics_tpu.parallel import SlicedPlan
+
+
+def good(metric, cohorts, scores):
+    plan_a = SlicedPlan(metric, num_cells=1024)                       # literal int: fine
+    plan_b = SlicedPlan(metric, num_cells=cohorts * 2)                # int arithmetic: fine
+    plan_c = metric.sliced(num_cells=512, example_keys=jnp.asarray([1, 2]))
+    plan_d = SlicedPlan(metric, num_cells=jax.device_count() * 128)   # host int query: fine
+    plan_e = metric.sliced(                                           # int output despite float bin edges
+        num_cells=64, example_keys=jnp.digitize(scores, jnp.linspace(0.0, 1.0, 16))
+    )
+    plan_f = SlicedPlan(metric, num_cells=int(cohorts / 2))           # int-cast division: fine
+    plan_g = metric.sliced(                                           # explicit int dtype: fine
+        num_cells=64, example_keys=jnp.asarray([1.5, 2.5], dtype=jnp.int32)
+    )
+    return plan_a, plan_b, plan_c, plan_d, plan_e, plan_f, plan_g
+
+
+def bad(metric, cohorts):
+    plan_a = SlicedPlan(metric, num_cells=1024.0)                     # float literal sizing
+    plan_b = SlicedPlan(metric, num_cells=cohorts / 2)                # true division sizing
+    plan_c = SlicedPlan(metric, num_cells=int(jnp.unique(cohorts).size))  # noqa: dynamic
+    plan_d = metric.sliced(num_cells=64, example_keys=jnp.asarray([1.5]))  # float keys
+    plan_e = metric.sliced(num_cells=64, example_keys=scores.astype(jnp.float32))
+    return plan_a, plan_b, plan_c, plan_d, plan_e
+'''
+
+
+def test_ml008_flags_only_contract_violations(tmp_path):
+    path = tmp_path / "ml008_snippet.py"
+    path.write_text(_ML008_SNIPPET)
+    violations = [v for v in lint_paths([str(path)], root=str(tmp_path)) if v.rule == "ML008"]
+    lines = sorted(v.line for v in violations)
+    text = _ML008_SNIPPET.splitlines()
+    # everything inside bad(), nothing inside good()
+    assert all("plan_" in text[line - 1] for line in lines)
+    bad_start = next(i for i, l in enumerate(text) if l.startswith("def bad")) + 1
+    assert all(line > bad_start for line in lines), (lines, bad_start)
+    sizing = [v for v in violations if v.scope == "SlicedPlan.num_cells"]
+    keys = [v for v in violations if v.scope == "SlicedPlan.example_keys"]
+    assert len(sizing) == 3 and len(keys) == 2, violations
+
+
+def test_ml008_agrees_with_runtime_predicates():
+    """The static evidence and the runtime predicates classify the same
+    values the same way — the ML007 agreement pattern for the sliced plane."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.parallel import slice_key_reason, slice_table_size_reason
+
+    # sizing: what ML008 flags as a literal, the runtime refuses — and vice versa
+    assert slice_table_size_reason(1024) is None
+    assert slice_table_size_reason(512.0) is not None  # the float-literal case ML008 flags
+    assert slice_table_size_reason(0) is not None
+    assert slice_table_size_reason(True) is not None
+    assert slice_table_size_reason(jnp.asarray(8)) is not None  # traced/dynamic sizing
+    # keys: float dtypes refused, integer/bool accepted
+    assert slice_key_reason(jnp.int32) is None
+    assert slice_key_reason(jnp.int64) is None
+    assert slice_key_reason(jnp.bool_) is None
+    assert slice_key_reason(jnp.float32) is not None
+    assert slice_key_reason(jnp.bfloat16) is not None
 
 
 def test_ml003_message_tracks_runtime_reductions():

@@ -456,6 +456,23 @@ class MetricCollection(dict):
             axis_name=axis_name,
         )
 
+    def sliced(self, *, num_cells: int, **kwargs: Any) -> "Any":
+        """Fan the whole collection out over cohort cells: ONE compiled
+        dispatch per batch updates every member for every cohort (compute-
+        group leaders trace once; members ride the group assignment, exactly
+        like :meth:`fused`). Let the compute groups form first (two eager
+        updates), then ``reset()`` — the collection must be a pristine
+        per-cell TEMPLATE when the plan builds. See
+        :class:`~torchmetrics_tpu.parallel.sliced.SlicedPlan`::
+
+            plan = suite.sliced(num_cells=1024)
+            plan.update(cohort_ids, preds, target)
+            per_cohort = plan.results()    # {(cohort,): {member: value}}
+        """
+        from torchmetrics_tpu.parallel.sliced import SlicedPlan
+
+        return SlicedPlan(self, num_cells=num_cells, **kwargs)
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         """Deep copy with optional new prefix/postfix (reference ``collections.py:399``)."""
         mc = deepcopy(self)

@@ -23,6 +23,7 @@ RULES: Dict[str, str] = {
     "ML005": "Metric stored in a container _walk_metrics cannot traverse",
     "ML006": "unbounded cat-list state on a metric claiming full_state_update=False",
     "ML007": "fusion-ineligible metric constructed inside a MetricCollection",
+    "ML008": "sliced-plane contract violation at a SlicedPlan construction site",
 }
 
 
@@ -703,6 +704,164 @@ def check_ml007(path: str, tree: ast.Module, index: ClassIndex) -> Iterator[Viol
                 )
 
 
+_FLOAT_DTYPE_ATTRS = ("float16", "float32", "float64", "bfloat16", "float_")
+
+#: array constructors whose ARGUMENTS become the array's values — a float
+#: literal inside them proves a float key; a float inside any other call's
+#: args (``digitize(x, linspace(0.0, ...))`` bin edges) proves nothing about
+#: the call's OUTPUT dtype, so those stay quiet
+_VALUE_CTOR_ATTRS = ("asarray", "array", "stack", "concatenate", "full")
+
+
+def _mentions_float_dtype(node: ast.expr) -> bool:
+    return any(
+        isinstance(sub, ast.Attribute) and sub.attr in _FLOAT_DTYPE_ATTRS
+        for sub in ast.walk(node)
+    )
+
+
+def _float_expr_evidence(node: ast.expr) -> Optional[str]:
+    """Provable float-ness of a cohort-key expression — the static mirror of
+    the runtime ``slice_key_reason`` integer-dtype check. Only constructs
+    whose OUTPUT dtype is provably float count as evidence: value-level
+    float literals (bare, or inside array constructors), true division,
+    ``.astype(float*)`` and ``dtype=float*`` kwargs. Anything else —
+    including float literals buried in an arbitrary call's arguments, whose
+    output may well be integral (``digitize``) — stays quiet; the runtime
+    check is the backstop."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return "contains a float literal"
+    if isinstance(node, ast.BinOp):
+        if isinstance(node.op, ast.Div):
+            return "contains a true division (float result)"
+        return _float_expr_evidence(node.left) or _float_expr_evidence(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _float_expr_evidence(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        for elt in node.elts:
+            evidence = _float_expr_evidence(elt)
+            if evidence:
+                return evidence
+        return None
+    if isinstance(node, ast.Call):
+        func = node.func
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                # an explicit dtype decides the output outright: float dtype
+                # is evidence, any OTHER explicit dtype proves the output
+                # integral regardless of float literals in the values
+                # (``asarray([1.5], dtype=int32)``) — quiet
+                return "passes an explicit float dtype" if _mentions_float_dtype(kw.value) else None
+        if isinstance(func, ast.Attribute) and func.attr == "astype":
+            operands = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_mentions_float_dtype(arg) for arg in operands):
+                return "casts to an explicit float dtype (.astype)"
+            return None
+        if isinstance(func, ast.Attribute) and func.attr in _VALUE_CTOR_ATTRS:
+            for arg in node.args:
+                evidence = _float_expr_evidence(arg)
+                if evidence:
+                    return evidence
+        return None
+    return None
+
+
+def _walk_outside_int_casts(node: ast.expr) -> Iterator[ast.AST]:
+    """Walk an expression without descending into ``int(...)`` calls — an
+    explicit int cast makes whatever is inside a static python int, so
+    float-ness evidence below it is moot (jnp-derivation is checked by a
+    FULL walk separately: ``int(jnp.unique(keys).size)`` is still
+    data-dependent sizing)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        sub = stack.pop()
+        yield sub
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Name)
+            and sub.func.id == "int"
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _table_size_evidence(node: ast.expr) -> Optional[str]:
+    """Provable bad slice-table sizing. Two classes of evidence:
+
+    - FLOAT sizing (the runtime ``slice_table_size_reason`` refuses it):
+      non-int literals, or a true division not wrapped in ``int(...)``.
+    - DATA-DEPENDENT sizing (``jnp``-derived — ``int(jnp.unique(keys).size)``):
+      the runtime CANNOT see this (it receives a plain int), but the table
+      is a compiled-in shape, so sizing it from data re-traces per run and
+      makes cell indices unstable — this is the anti-pattern the rule
+      exists to catch, and the static check is the only guard.
+
+    Host-side ints (``jax.device_count() * 128``, ``int(n / 2)``) stay
+    quiet."""
+    if isinstance(node, ast.Constant):
+        value = node.value
+        if isinstance(value, bool) or not isinstance(value, int):
+            return f"is the non-int literal {value!r} (the table is a compiled-in shape)"
+        if value < 1:
+            return f"is {value!r}; the table needs at least one cell"
+        return None
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _root_module(sub.func) == "jnp":
+            return "derives from a jnp array value — data-dependent (dynamic-shape) sizing"
+    for sub in _walk_outside_int_casts(node):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Div):
+            return "uses true division (float result) — use // for integer sizing"
+    return None
+
+
+def check_ml008(path: str, tree: ast.Module, index: ClassIndex) -> Iterator[Violation]:
+    """Sliced-plane contract violations at construction sites.
+
+    The slice table (``parallel/sliced.py``) is a compiled-in shape keyed by
+    hashed integer cohort keys: ``num_cells`` must be a static positive
+    python int (float expressions and jnp-derived values are dynamic-shape
+    sizing) and cohort keys must be integer arrays (a float key is an
+    unhashable cohort — 1.0000001 is a new cohort every batch). This rule
+    flags provable violations at ``SlicedPlan(...)``/``.sliced(...)`` call
+    sites, with the SAME predicates the runtime applies
+    (``slice_table_size_reason``/``slice_key_reason`` — agreement pinned by
+    ``test_ml008_agrees_with_runtime_predicates``). Values the AST cannot
+    prove stay quiet; the runtime check is the backstop.
+    """
+
+    def callee_name(call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        return None
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and callee_name(node) in ("SlicedPlan", "sliced")):
+            continue
+        num_cells = next((kw.value for kw in node.keywords if kw.arg == "num_cells"), None)
+        if num_cells is not None:
+            evidence = _table_size_evidence(num_cells)
+            if evidence:
+                yield Violation(
+                    "ML008", path, num_cells.lineno, num_cells.col_offset,
+                    "SlicedPlan.num_cells",
+                    f"slice-table sizing num_cells {evidence} — the runtime"
+                    " (slice_table_size_reason) refuses it; size with a static positive int",
+                )
+        example_keys = next((kw.value for kw in node.keywords if kw.arg == "example_keys"), None)
+        if example_keys is not None:
+            evidence = _float_expr_evidence(example_keys)
+            if evidence:
+                yield Violation(
+                    "ML008", path, example_keys.lineno, example_keys.col_offset,
+                    "SlicedPlan.example_keys",
+                    f"cohort-key expression {evidence} — keys are hashed and compared for"
+                    " exact equality, so the runtime (slice_key_reason) refuses float keys;"
+                    " bucket or hash float features to ints",
+                )
+
+
 # ------------------------------------------------------------- file checking
 
 
@@ -710,6 +869,7 @@ def check_file(path: str, tree: ast.Module, index: ClassIndex) -> List[Violation
     violations: List[Violation] = []
     checked_methods: Set[int] = set()
     violations.extend(check_ml007(path, tree, index))
+    violations.extend(check_ml008(path, tree, index))
     for info in index.classes_in_file(path):
         if not index.is_metric_class(info.name):
             continue

@@ -961,6 +961,26 @@ class Metric:
             else:
                 setattr(self, attr, fn(jnp.asarray(current)))
 
+    # ----------------------------------------------------------------- sliced
+    def sliced(self, *, num_cells: int, **kwargs: Any) -> Any:
+        """Fan this metric out over up to ``num_cells`` cohort cells — one
+        compiled dispatch per batch updates EVERY cohort's copy of the state
+        (hashed slice table + a leading ``[num_cells]`` state axis; see
+        :class:`~torchmetrics_tpu.parallel.sliced.SlicedPlan`)::
+
+            plan = acc.sliced(num_cells=1024)
+            plan.update(cohort_ids, preds, target)   # one dispatch, all cohorts
+            per_cohort = plan.results()
+
+        The metric is the pristine per-cell TEMPLATE (``reset()`` first);
+        ``kwargs`` pass through to ``SlicedPlan`` (``cat_capacity``,
+        ``example_batch``, ``donate``, ``mesh``, ``axis_name``,
+        ``key_width``).
+        """
+        from torchmetrics_tpu.parallel.sliced import SlicedPlan
+
+        return SlicedPlan(self, num_cells=num_cells, **kwargs)
+
     # --------------------------------------------------------------- plotting
     def plot(self, *args: Any, **kwargs: Any):
         """Plot a single or multiple values from the metric (reference ``metric.py:656-690``)."""

@@ -124,30 +124,37 @@ def _instance_slot(metric: Any) -> Dict[str, Any]:
     return slot
 
 
+def _add_leaf_entries(table: Dict[Any, Tuple[Any, int]], slot: Dict[Any, Any], name: str, leaves: Any) -> None:
+    """Add one state's leaves to a leaf-byte table, keyed so that SHARED
+    leaves dedup across slots: array leaves key by object identity
+    (compute-group members referencing the same tp/fp arrays collapse to one
+    entry in the global sum), scalar/non-weakref-able leaves by a
+    slot-unique key (never shared). Each array entry carries a weakref to
+    its leaf — an ``id()`` is only meaningful while the object lives, so the
+    global sum validates liveness before trusting a key (a freed array's id
+    can be REUSED by a new allocation; without the check two unrelated
+    arrays would merge as "shared")."""
+    for i, leaf in enumerate(leaves):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            try:
+                ref = weakref.ref(leaf)
+            except TypeError:  # not weakref-able: slot-unique, no dedup
+                table[(id(slot), name, i)] = (None, int(nbytes))
+            else:
+                table[id(leaf)] = (ref, int(nbytes))
+        else:
+            scalar_bytes = _leaf_nbytes(leaf)
+            if scalar_bytes:
+                table[(id(slot), name, i)] = (None, scalar_bytes)
+
+
 def _leaf_byte_table(metric: Any, slot: Dict[Any, Any]) -> Dict[Any, Tuple[Any, int]]:
-    """State bytes keyed so that SHARED leaves dedup across slots: array
-    leaves key by object identity (compute-group members referencing the
-    same tp/fp arrays collapse to one entry in the global sum), scalar
-    leaves by a slot-unique key (scalars are immutable, never shared). Each
-    entry carries a weakref to its leaf — an ``id()`` is only meaningful
-    while the object lives, so the global sum validates liveness before
-    trusting a key (a freed array's id can be REUSED by a new allocation;
-    without the check two unrelated arrays would merge as "shared")."""
+    """The leaf-byte table over a metric's registered states (see
+    :func:`_add_leaf_entries` for the dedup keying)."""
     table: Dict[Any, Tuple[Any, int]] = {}
     for name in metric._defaults:
-        for i, leaf in enumerate(_state_leaves(getattr(metric, name))):
-            nbytes = getattr(leaf, "nbytes", None)
-            if nbytes is not None:
-                try:
-                    ref = weakref.ref(leaf)
-                except TypeError:  # not weakref-able: slot-unique, no dedup
-                    table[(id(slot), name, i)] = (None, int(nbytes))
-                else:
-                    table[id(leaf)] = (ref, int(nbytes))
-            else:
-                scalar_bytes = _leaf_nbytes(leaf)
-                if scalar_bytes:
-                    table[(id(slot), name, i)] = (None, scalar_bytes)
+        _add_leaf_entries(table, slot, name, _state_leaves(getattr(metric, name)))
     return table
 
 
@@ -263,6 +270,42 @@ def note_instances(cls_name: str, member_names: Iterable[str]) -> None:
         if row is None:
             row = _registry[cls_name] = _new_row()
         row["instances"].update(member_names)
+
+
+def note_state_bytes(
+    obj: Any,
+    sizes: Dict[str, int],
+    updates: int = 0,
+    leaves: Optional[Dict[str, List[Any]]] = None,
+) -> None:
+    """Producer hook for NON-Metric state holders (the sliced plane's slice
+    tables): fold ``obj``'s per-state byte split + update count into the
+    registry under ``type(obj).__name__`` — the ledger then carries a
+    ``state_bytes`` row per plan, exactly like a metric's — and publish the
+    ``metric.<Class>.state_bytes`` gauge as the sum across live instances.
+    ``leaves`` (``{state name: [array leaf, ...]}``) enrolls the holder's
+    buffers in the leaf-identity table so the deduplicated
+    ``metric.state_bytes_total`` gauge (what ``metricscope watch`` prefers)
+    includes the carry — without it a plan's footprint would silently drop
+    out of the process total. Callers guard with the trace/live flags (the
+    disabled path never reaches here)."""
+    cls = type(obj).__name__
+    with _lock:
+        slot = _instance_slot(obj)
+        slot["state_bytes"] = {name: int(nbytes) for name, nbytes in sizes.items()}
+        slot["updates"] = int(updates)
+        if leaves is not None:
+            table: Dict[Any, Tuple[Any, int]] = {}
+            for name, leaf_list in leaves.items():
+                _add_leaf_entries(table, slot, name, leaf_list)
+            slot["leaf_bytes"] = table
+        total = sum(
+            sum(s["state_bytes"].values()) for s in _registry[cls]["by_instance"].values()
+        )
+        total_dedup = _global_state_bytes_locked()
+    _counters.set_gauge(f"metric.{cls}.state_bytes", total)
+    if leaves is not None:
+        _counters.set_gauge("metric.state_bytes_total", total_dedup)
 
 
 def metric_boundary(metric: Any) -> None:

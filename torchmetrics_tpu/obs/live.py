@@ -552,7 +552,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
 
     header = (
         "rank", "state", "batches", "samples", "samples/s", "cursor",
-        "snap_age_s", "snap_bytes", "state_bytes", "margin_s", "behind_s", "flags",
+        "snap_age_s", "snap_bytes", "state_bytes", "occup", "margin_s", "behind_s", "flags",
     )
     rows = [header]
     n_stale = 0
@@ -560,7 +560,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
     for status in statuses:
         rank = str(status.get("rank", "?"))
         if "_problem" in status:
-            rows.append((rank, "unreadable", "-", "-", "-", "-", "-", "-", "-", "-", "-", "UNREADABLE"))
+            rows.append((rank, "unreadable", "-", "-", "-", "-", "-", "-", "-", "-", "-", "-", "UNREADABLE"))
             states["unreadable"] = states.get("unreadable", 0) + 1
             continue
         counters = status.get("counters", {})
@@ -595,6 +595,10 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
             _fmt_num(gauges.get("runner.snapshot.age_s"), "{:.1f}"),
             _fmt_num(gauges.get("runner.snapshot.bytes_last")),
             _fmt_num(sum(state_gauges) if state_gauges else None),
+            # sliced-plane table occupancy (0..1, rendered %): "-" for runs
+            # without a slice table, 100% + growing spills = undersized table
+            "-" if gauges.get("slice.table.occupancy") is None
+            else "{:.0f}%".format(100.0 * gauges["slice.table.occupancy"]),
             _fmt_num(gauges.get("runner.watchdog.margin_s"), "{:.2f}"),
             "-" if behind_s is None else f"{behind_s:.1f}",
             ",".join(flags),

@@ -39,6 +39,14 @@ from torchmetrics_tpu.parallel.fused import (
     fusion_ineligibility,
     fusion_report,
 )
+from torchmetrics_tpu.parallel.sliced import (
+    SlicedPlan,
+    SliceTable,
+    slice_key_reason,
+    slice_table_size_reason,
+    sliced_ineligibility,
+)
+from torchmetrics_tpu.parallel.windowing import WindowRing
 from torchmetrics_tpu.parallel.sharded import (
     ShardedMetric,
     deep_reductions,
@@ -57,6 +65,9 @@ __all__ = [
     "DeviceFeed",
     "FusedCollectionPlan",
     "ShardedMetric",
+    "SliceTable",
+    "SlicedPlan",
+    "WindowRing",
     "cat_buffer_all_gather",
     "cat_buffer_append",
     "cat_buffer_init",
@@ -72,5 +83,8 @@ __all__ = [
     "metric_merge",
     "mesh_reduce_tree",
     "sharded_update",
+    "slice_key_reason",
+    "slice_table_size_reason",
+    "sliced_ineligibility",
     "tree_merge",
 ]

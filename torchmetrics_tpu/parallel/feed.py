@@ -3,46 +3,75 @@
 """Async double-buffered host→device batch feed.
 
 A streaming evaluation that calls ``step(state, *batch)`` on host-resident
-batches serializes two things that could overlap: the host→device transfer
-of batch k+1 and the compiled step on batch k. JAX dispatch is asynchronous,
-so overlap needs no threads — it needs the ``device_put`` of the NEXT batch
-to be *issued* before the current batch is consumed. :class:`DeviceFeed`
-does exactly that with a depth-bounded buffer (the classic double-buffer at
-``depth=2``, the default):
-
-::
+batches serializes three things that could overlap: producing batch k+1
+(decode/augment/host copy), its host→device transfer, and the compiled step
+on batch k. :class:`DeviceFeed` overlaps all three with a background staging
+thread and a depth-bounded queue (the classic double-buffer at ``depth=2``,
+the default)::
 
     plan = suite.fused()
-    for batch in DeviceFeed(batches):      # transfer k+1 overlaps step k
+    for batch in DeviceFeed(batches):      # producer + transfer overlap step k
         plan.update(*batch)
 
-``depth`` bounds device memory: at most ``depth`` staged batches are alive
-at once. Tuples/lists/dicts of arrays transfer as one pytree; numpy inputs
-upload, device-resident arrays pass through (a no-op ``device_put``).
+``depth`` bounds device memory: at most ``depth`` staged batches sit in the
+queue (plus the one being staged). Tuples/lists/dicts of arrays transfer as
+one pytree; numpy inputs upload, device-resident arrays pass through (a
+no-op ``device_put``).
+
+**Failure contract.** A producer exception — the batch iterable raising, or
+the ``device_put`` staging itself failing — is captured by the staging
+thread and re-raised to the CONSUMER on its next ``get()``/iteration step,
+at the position where the batch would have appeared. Before this contract
+the consumer would block on a queue that was never going to fill until the
+runner's watchdog fired (a stall disguised as a slow device); now the drive
+loop dies promptly with the real error. The ``feed.stage`` fault-injection
+point (``robustness/faults.py``) rehearses exactly that path, and
+``tests/unittests/bases/test_fused.py`` pins it.
+
+Abandoning the iterator early (``break`` in the consumer loop) stops the
+producer thread promptly — it never blocks forever on a full queue.
 
 This is the host-side half of the fused evaluation plane's feed path
 (ISSUE 9); :meth:`FusedCollectionPlan.run_stream` wires it in.
 """
 from __future__ import annotations
 
-from collections import deque
+import queue
+import threading
 from typing import Any, Iterable, Iterator, Optional
 
 import jax
 
+from torchmetrics_tpu.robustness import faults
+
 __all__ = ["DeviceFeed"]
+
+_DONE = object()  # producer sentinel: the batch iterable is exhausted
+
+
+class _ProducerError:
+    """Envelope for an exception captured on the staging thread — re-raised
+    on the consumer at the position where the failed batch would have
+    appeared."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException) -> None:
+        self.error = error
 
 
 class DeviceFeed:
-    """Iterate ``batches`` with up to ``depth`` device transfers in flight.
+    """Iterate ``batches`` with a background thread staging up to ``depth``
+    device transfers ahead of the consumer.
 
     Args:
         batches: any iterable of batches (pytrees of arrays — tuples of
-            ``(preds, target)`` in the common case).
+            ``(preds, target)`` in the common case). Consumed on the staging
+            thread: its ``__next__`` must not require the consumer's thread.
         device: target device; ``None`` uses the default device.
-        depth: how many batches to keep staged ahead of the consumer
-            (``2`` = classic double buffering; ``1`` degenerates to eager
-            per-batch transfer).
+        depth: how many staged batches to keep queued ahead of the consumer
+            (``2`` = classic double buffering; ``1`` degenerates to one
+            batch ahead).
     """
 
     def __init__(self, batches: Iterable[Any], device: Optional[Any] = None, depth: int = 2) -> None:
@@ -52,16 +81,56 @@ class DeviceFeed:
         self._device = device
         self._depth = depth
 
-    def _put(self, batch: Any) -> Any:
+    @staticmethod
+    def _stage(batch: Any, device: Optional[Any]) -> Any:
         # device_put on a pytree dispatches every leaf's transfer
         # asynchronously and returns immediately
-        return jax.device_put(batch, self._device)
+        if faults._ACTIVE:  # staging-fault drill: a poisoned batch/transfer
+            faults.fire("feed.stage")
+        return jax.device_put(batch, device)
 
     def __iter__(self) -> Iterator[Any]:
-        staged: deque = deque()
-        for batch in self._batches:
-            staged.append(self._put(batch))
-            if len(staged) >= self._depth:
-                yield staged.popleft()
-        while staged:
-            yield staged.popleft()
+        staged: queue.Queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        # resolve the target device on the CONSUMER's thread: a
+        # `with jax.default_device(...)` scope is thread-local, and the
+        # staging thread would otherwise silently fall back to the global
+        # default — batches must land where the consumer's context says
+        device = self._device if self._device is not None else jax.config.jax_default_device
+
+        def put_until_stopped(item: Any) -> bool:
+            """Blocking put that yields to the stop flag (an abandoned
+            consumer must never leave the producer wedged on a full queue);
+            True when the item landed."""
+            while not stop.is_set():
+                try:
+                    staged.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            payload: Any = _DONE
+            try:
+                for batch in self._batches:
+                    if not put_until_stopped(self._stage(batch, device)):
+                        return
+            except BaseException as err:  # noqa: BLE001 - surfaced to the consumer
+                payload = _ProducerError(err)
+            # terminal marker (end-of-stream or the captured error): the
+            # consumer is guaranteed to unblock on its next get()
+            put_until_stopped(payload)
+
+        worker = threading.Thread(target=produce, daemon=True, name="tm-tpu-device-feed")
+        worker.start()
+        try:
+            while True:
+                item = staged.get()
+                if item is _DONE:
+                    return
+                if isinstance(item, _ProducerError):
+                    raise item.error
+                yield item
+        finally:
+            stop.set()  # consumer done/abandoned: unblock a put-blocked producer

@@ -54,7 +54,6 @@ attributes the fused step's flops/compile cost.
 from __future__ import annotations
 
 import inspect
-import weakref
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -74,13 +73,14 @@ from torchmetrics_tpu.parallel.cat_buffer import (
     infer_cat_layout,
 )
 from torchmetrics_tpu.parallel.sharded import (
-    _SHARDED_FN_CACHE,
     _batch_update_state,
     _fingerprint_digest,
     _update_arity,
     _walk_fingerprint,
     _walk_metrics,
     mesh_reduce_tree,
+    plan_cache_lookup,
+    plan_cache_store,
     shard_map,
     tree_merge,
 )
@@ -364,25 +364,11 @@ class FusedCollectionPlan:
         # a plan over the same target — a resumed evaluator, a fresh plan per
         # epoch — reuses the compiled steps instead of paying trace+compile
         # again (the carry-riding update counts exist precisely so rebuilt
-        # programs are cache-identical). The "fused" marker keeps the key
-        # space disjoint from sharded_update's (id, id, axis, ...) keys.
-        cache_key = (
-            "fused", id(self._ref_target()),
-            id(self._mesh) if self._mesh is not None else None,
-            self._axis, key,
-        )
-        entry = _SHARDED_FN_CACHE.get(cache_key)
-        if (
-            entry is not None
-            and entry[0]() is self._ref_target()
-            and (self._mesh is None or entry[1]() is self._mesh)
-        ):
-            if _obs_trace.ENABLED:
-                _obs_counters.inc("fused.cache.hit")
-            self._step, self._scan_step = entry[2]
+        # programs are cache-identical).
+        cache_key, cached = plan_cache_lookup("fused", self._ref_target(), self._mesh, self._axis, key)
+        if cached is not None:
+            self._step, self._scan_step = cached
             return
-        if _obs_trace.ENABLED:
-            _obs_counters.inc("fused.cache.miss")
 
         def step_fn(state, *batch):
             return raw(state, batch)
@@ -401,26 +387,8 @@ class FusedCollectionPlan:
             jax.jit(chunk_fn, **jit_kwargs),
             key=f"{key}:scan", metric=self._target_cls, kind="fused_scan", span_prefix="fused.scan",
         )
-        def _dead(k: Tuple) -> bool:
-            # fresh-plan-per-collection is advertised usage: entries whose
-            # target (or mesh) was garbage-collected would otherwise pin the
-            # member metrics + compiled steps via the closure forever
-            e = _SHARDED_FN_CACHE[k]
-            return e[0]() is None or (e[1] is not None and e[1]() is None)
-
-        stale = [
-            k for k in _SHARDED_FN_CACHE
-            if isinstance(k, tuple) and k[:1] == ("fused",) and k != cache_key
-            and (k[1:4] == cache_key[1:4] or _dead(k))
-        ]
-        for old in stale:
-            del _SHARDED_FN_CACHE[old]
-        if stale and _obs_trace.ENABLED:
-            _obs_counters.inc("fused.cache.evict", len(stale))
-        _SHARDED_FN_CACHE[cache_key] = (
-            weakref.ref(self._ref_target()),
-            weakref.ref(self._mesh) if self._mesh is not None else None,
-            (self._step, self._scan_step),
+        plan_cache_store(
+            "fused", cache_key, self._ref_target(), self._mesh, (self._step, self._scan_step)
         )
 
     def _ref_target(self) -> Any:

@@ -68,6 +68,48 @@ Array = jax.Array
 _SHARDED_FN_CACHE: Dict[Tuple, Tuple] = {}
 
 
+def plan_cache_lookup(kind: str, target: Any, mesh: Optional[Mesh], axis: str, key: str) -> Tuple[Tuple, Optional[Any]]:
+    """Shared compiled-step cache lookup for the plan planes (fused/sliced):
+    returns ``(cache_key, steps-or-None)`` and bumps ``<kind>.cache.hit/miss``.
+    Keys lead with the ``kind`` marker so each plane's key space stays
+    disjoint from ``sharded_update``'s ``(id, id, axis, ...)`` keys."""
+    cache_key = (kind, id(target), id(mesh) if mesh is not None else None, axis, key)
+    entry = _SHARDED_FN_CACHE.get(cache_key)
+    if entry is not None and entry[0]() is target and (mesh is None or entry[1]() is mesh):
+        if _obs_trace.ENABLED:
+            _obs_counters.inc(f"{kind}.cache.hit")
+        return cache_key, entry[2]
+    if _obs_trace.ENABLED:
+        _obs_counters.inc(f"{kind}.cache.miss")
+    return cache_key, None
+
+
+def plan_cache_store(kind: str, cache_key: Tuple, target: Any, mesh: Optional[Mesh], steps: Any) -> None:
+    """Store a plan's compiled steps, evicting superseded fingerprints of the
+    same (target, mesh, axis) and entries whose target/mesh was garbage-
+    collected — fresh-plan-per-target is advertised usage, and dead entries
+    would otherwise pin metrics + compiled steps via the closure forever."""
+
+    def _dead(k: Tuple) -> bool:
+        e = _SHARDED_FN_CACHE[k]
+        return e[0]() is None or (e[1] is not None and e[1]() is None)
+
+    stale = [
+        k for k in _SHARDED_FN_CACHE
+        if isinstance(k, tuple) and k[:1] == (kind,) and k != cache_key
+        and (k[1:4] == cache_key[1:4] or _dead(k))
+    ]
+    for old in stale:
+        del _SHARDED_FN_CACHE[old]
+    if stale and _obs_trace.ENABLED:
+        _obs_counters.inc(f"{kind}.cache.evict", len(stale))
+    _SHARDED_FN_CACHE[cache_key] = (
+        weakref.ref(target),
+        weakref.ref(mesh) if mesh is not None else None,
+        steps,
+    )
+
+
 # ------------------------------------------------------------------ pure merge
 
 

@@ -37,6 +37,10 @@ point                      kinds                  fires
 ``store.payload``          corrupt, truncate      on the snapshot bytes as written to disk; the
                                                   manifest keeps the TRUE crc, so ``latest()`` detects
                                                   the bitrot and falls back
+``feed.stage``             fail, delay            on the ``DeviceFeed`` staging thread, per batch
+                                                  staged — the captured error must propagate to the
+                                                  consumer's next ``get()``, never stall the drive
+                                                  loop until the watchdog
 =========================  =====================  ==================================
 
 Faults are scoped with the :func:`inject` context manager (in-process tests)

@@ -97,6 +97,15 @@ class StreamingEvaluator:
         fused_options: kwargs for the plan build (``cat_capacity``,
             ``example_batch``, ``donate``, ``mesh``, ``axis_name``);
             ``example_batch`` defaults to the first batch.
+        window_ring: a :class:`~torchmetrics_tpu.parallel.windowing.WindowRing`
+            wrapping the SAME ``metric``: after every applied batch the ring
+            observes the cursor and closes the open window when its
+            ``every_n``/``every_s`` trigger fires; the ring's closed windows
+            ride every snapshot payload (kill-and-resume restores them with
+            the open state), and its ``window.<Class>.*`` probe publishes
+            through the live plane while the drive runs. Mutually exclusive
+            with ``fused=True``: a rotation resets the metric mid-stream,
+            which the fused plane's donated carry cannot observe.
 
     One evaluator instance drives one pass: :meth:`run` starts from batch 0
     (and demands a fresh store), :meth:`resume` restores the newest valid
@@ -115,6 +124,7 @@ class StreamingEvaluator:
         on_stall: str = "raise",
         fused: bool = False,
         fused_options: Optional[Dict[str, Any]] = None,
+        window_ring: Optional[Any] = None,
     ) -> None:
         if snapshot_every_n is not None and snapshot_every_n < 1:
             raise ValueError(f"snapshot_every_n must be >= 1, got {snapshot_every_n}")
@@ -128,6 +138,18 @@ class StreamingEvaluator:
             raise ValueError(f"store must be a CheckpointStore, got {type(store).__name__}")
         if fused and update_fn is not None:
             raise ValueError("fused=True drives the FusedCollectionPlan itself; it cannot combine with update_fn")
+        if window_ring is not None:
+            from torchmetrics_tpu.parallel.windowing import WindowRing
+
+            if not isinstance(window_ring, WindowRing):
+                raise ValueError(f"window_ring must be a WindowRing, got {type(window_ring).__name__}")
+            if window_ring.target is not metric:
+                raise ValueError("window_ring must wrap the SAME metric object this evaluator drives")
+            if fused:
+                raise ValueError(
+                    "window_ring cannot combine with fused=True: a window rotation resets the"
+                    " metric mid-stream, which the fused plane's donated carry cannot observe"
+                )
         self.metric = metric
         self.store = store
         self.snapshot_every_n = snapshot_every_n
@@ -135,6 +157,7 @@ class StreamingEvaluator:
         self.update_fn = update_fn or _default_update
         self.fused = bool(fused)
         self.fused_options = dict(fused_options or {})
+        self.window_ring = window_ring
         #: the live FusedCollectionPlan while a fused drive is in flight
         self._fused_plan: Optional[Any] = None
         self.watchdog_timeout_s = watchdog_timeout_s
@@ -233,12 +256,17 @@ class StreamingEvaluator:
             # back into the member metrics first, so every snapshot (periodic,
             # stall capture, final) serializes exactly the applied batches
             self._fused_plan.fold_back()
-        return {
+        payload = {
             "payload_version": RUNNER_PAYLOAD_VERSION,
             "cursor": self.cursor,
             "kind": "collection" if self._is_collection() else "metric",
             "checkpoint": self._checkpoint(),
         }
+        if self.window_ring is not None:
+            # the closed windows travel WITH the open state + cursor: a
+            # resumed run's ring is exactly the killed run's at that snapshot
+            payload["window"] = self.window_ring.payload()
+        return payload
 
     def _validate_payload(self, payload: Dict[str, Any]) -> None:
         """``CheckpointStore.latest`` hook: raise ``StateRestoreError`` for a
@@ -264,7 +292,28 @@ class StreamingEvaluator:
                 f"runner snapshot was written for a {payload.get('kind')!r} target, this"
                 f" evaluator wraps a {kind!r}"
             )
+        ring_parts = None
+        if self.window_ring is None and "window" in payload:
+            raise StateRestoreError(
+                "runner snapshot carries a window-ring payload but this evaluator has no"
+                " window_ring attached — resuming would silently DROP the closed windows"
+                " (and the next snapshot would erase them from the store); attach the ring"
+                " or point at an un-windowed store"
+            )
+        if self.window_ring is not None:
+            if "window" not in payload:
+                raise StateRestoreError(
+                    "runner snapshot carries no window-ring payload but this evaluator has a"
+                    " window_ring attached — the snapshot came from an un-windowed run"
+                )
+            # validate WITHOUT applying: if the metric checkpoint below is
+            # rejected, the live ring must not be left holding this
+            # snapshot's closed windows (validate-ALL-then-apply holds
+            # across BOTH restores)
+            ring_parts = self.window_ring.validated_parts(payload["window"])
         self._restore_checkpoint(payload["checkpoint"])
+        if ring_parts is not None:
+            self.window_ring.apply_parts(ring_parts)
 
     # -------------------------------------------------------------- snapshot
     def snapshot(self) -> Optional[int]:
@@ -484,10 +533,16 @@ class StreamingEvaluator:
             # other's live telemetry
             probe_name = f"runner-{id(self)}"
             _obs_live.register_probe(probe_name, self._live_probe)
+            ring_probe = None
+            if self.window_ring is not None:
+                ring_probe = f"window-{id(self)}"
+                _obs_live.register_probe(ring_probe, self.window_ring.probe)
             try:
                 return self._drive_impl(batches, skip)
             finally:
                 _obs_live.unregister_probe(probe_name)
+                if ring_probe is not None:
+                    _obs_live.unregister_probe(ring_probe)
         return self._drive_impl(batches, skip)
 
     def _make_apply(self) -> Callable[[Any], None]:
@@ -549,6 +604,10 @@ class StreamingEvaluator:
             self.cursor += 1
             if _obs_live.ENABLED or _obs_trace.ENABLED:
                 self._record_progress(batch)
+            if self.window_ring is not None:
+                # rotation happens AFTER the batch fully applied and BEFORE
+                # its snapshot, so every snapshot's ring is cursor-consistent
+                self.window_ring.observe(self.cursor)
             if faults._ACTIVE:  # preemption drill: die after batch k, before its snapshot
                 faults.fire("runner.preempt")
             self._maybe_snapshot()

@@ -5,6 +5,15 @@
 Stores ``window`` copies of every state of the wrapped metric keyed
 ``key_{i}`` (reference ``running.py:101-113``); ``compute`` folds the window
 slots back into the base metric with its declared reductions.
+
+Serving-scale note: for "metric over the last N batches/minutes" at
+production scale prefer the windowed evaluation plane
+(:class:`torchmetrics_tpu.parallel.WindowRing`, ARCHITECTURE §14) — a
+tumbling ``every_n=1`` ring reproduces ``Running(metric, window=N)`` exactly
+(pinned in ``tests/unittests/bases/test_windowing.py``) while adding time
+triggers, checkpointed kill-and-resume, live ``window.*`` gauges and
+thousands-of-windows capacity. ``Running`` remains the lightweight
+in-training wrapper.
 """
 from __future__ import annotations
 
