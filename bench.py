@@ -323,6 +323,7 @@ def main() -> None:
             bench_fused_suite,
             bench_live_publish,
             bench_retrieval_ndcg,
+            bench_serve_sustained,
             bench_sketch_quantile,
             bench_sliced_fanout,
             bench_ssim,
@@ -351,6 +352,9 @@ def main() -> None:
             # live telemetry publisher cost on a streaming evaluation
             # (ISSUE 7): host+disk only, cheap, runs early
             ("live_publish_overhead", bench_live_publish, (), 30),
+            # sustained multi-stream ingest through the metricserve daemon
+            # (ISSUE 14): host+disk only, asserts zero dropped batches
+            ("serve_sustained_streams", bench_serve_sustained, (), 45),
             ("fid50k", bench_fid50k, (), 120),
             ("coco_map_scale", bench_coco_map_scale, (), 180),
             # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;

@@ -517,6 +517,103 @@ def bench_live_publish(n_batches: int = 48, repeats: int = 3) -> Dict:
     }
 
 
+def bench_serve_sustained(n_batches: int = 24, repeats: int = 3) -> Dict:
+    """``serve_sustained_streams``: the metricserve daemon (ISSUE 14) under
+    sustained multi-tenant load. Four durable streams — plain 4-class
+    accuracy, per-cohort sliced accuracy (16 cells), windowed binary
+    accuracy (4-slot ring) and a bounded-memory KLL quantile — are fed
+    round-robin with wire-shaped (JSON-list) batches through the blocking
+    admission gate, snapshotting every 8 batches, then drained in sorted
+    order. Headline is aggregate drained samples/s; ``p95_ingest_ms`` is
+    the admission-latency tail a client sees, and ``dropped_batches`` is
+    asserted ZERO — backpressure must delay, never drop, so a nonzero
+    latch fails the leg outright instead of recording a slow run."""
+    import shutil
+    import tempfile
+
+    from torchmetrics_tpu.obs import counters as obs_counters
+    from torchmetrics_tpu.serve import ServeDaemon
+
+    rng = np.random.RandomState(0)
+    batch = 2048
+    n = batch * n_batches
+    labels = rng.randint(0, 4, n)
+    target4 = rng.randint(0, 4, n)
+    keys = rng.randint(0, 16, n)
+    bpreds = rng.rand(n).astype(np.float32)
+    btarget = rng.randint(0, 2, n)
+    values = rng.randn(n).astype(np.float32)
+
+    def split(*cols):
+        return [
+            [np.array_split(c, n_batches)[k].tolist() for c in cols] for k in range(n_batches)
+        ]
+
+    specs = {
+        "plain": {"name": "plain", "target": "torchmetrics_tpu.serve.factories:accuracy",
+                  "snapshot_every_n": 8, "use_feed": False},
+        "sliced": {"name": "sliced", "target": "torchmetrics_tpu.serve.factories:sliced_accuracy",
+                   "kwargs": {"num_classes": 4, "num_cells": 16},
+                   "snapshot_every_n": 8, "use_feed": False},
+        "windowed": {"name": "windowed", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                     "window": {"slots": 4, "every_n": 4}, "snapshot_every_n": 8, "use_feed": False},
+        "quantile": {"name": "quantile", "target": "torchmetrics_tpu.serve.factories:quantile",
+                     "kwargs": {"q": 0.5, "capacity": 256, "levels": 14},
+                     "snapshot_every_n": 8, "use_feed": False},
+    }
+    wire_batches = {
+        "plain": split(labels, target4),
+        "sliced": split(keys, labels, target4),
+        "windowed": split(bpreds, btarget),
+        "quantile": split(values),
+    }
+    n_samples = len(specs) * n  # rows drained per run
+
+    runs, p95s = [], []
+    dropped_before = obs_counters.get("serve.dropped_batches")
+    for _ in range(repeats):
+        base = tempfile.mkdtemp(prefix="tm_tpu_serve_bench_")
+        daemon = ServeDaemon(base, publish=False).start()
+        try:
+            for name in sorted(specs):
+                reply = daemon.create_stream(specs[name])
+                if not reply.get("ok"):
+                    raise RuntimeError(f"create {name}: {reply}")
+            lat = []
+            t0 = time.perf_counter()
+            for seq in range(n_batches):  # round-robin: a real multi-tenant interleave
+                for name in sorted(specs):
+                    t1 = time.perf_counter()
+                    reply = daemon.ingest(name, seq, wire_batches[name][seq], block=True, deadline_s=120.0)
+                    lat.append(time.perf_counter() - t1)
+                    if not reply.get("ok"):
+                        raise RuntimeError(f"ingest {name}[{seq}]: {reply}")
+            for name in sorted(specs):
+                reply = daemon.drain_stream(name)
+                if not reply.get("ok"):
+                    raise RuntimeError(f"drain {name}: {reply}")
+            elapsed = time.perf_counter() - t0
+        finally:
+            daemon.shutdown(drain=False)
+            shutil.rmtree(base, ignore_errors=True)
+        runs.append(n_samples / elapsed)
+        p95s.append(sorted(lat)[int(0.95 * (len(lat) - 1))] * 1e3)
+    dropped = obs_counters.get("serve.dropped_batches") - dropped_before
+    if dropped:
+        raise RuntimeError(
+            f"serve.dropped_batches latched {dropped}: admission control must delay, never drop"
+        )
+    return {
+        "runs": runs,
+        "unit": "samples/s",
+        "baseline": None,
+        "streams": len(specs),
+        "batches_per_stream": n_batches,
+        "p95_ingest_ms": round(sorted(p95s)[len(p95s) // 2], 3),
+        "dropped_batches": dropped,
+    }
+
+
 def _synth_detections(n_images, n_dets, n_gts, n_classes, seed=0):
     rng = np.random.default_rng(seed)
     preds, target = [], []

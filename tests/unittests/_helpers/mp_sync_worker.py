@@ -50,6 +50,15 @@ into the shared ``TM_TPU_PUBLISH_DIR``; after the synced run rank 1 freezes
 parent's ``metricscope watch --once`` (under a poisoned jax) must see both
 ranks clock-aligned — and flag rank 1 as STALE via the epoch anchors.
 
+A seventh scenario, ``serve``, exercises the ``metricserve`` daemon
+(ISSUE 14): both ranks run a :class:`~torchmetrics_tpu.serve.ServeDaemon`
+over per-rank base directories serving the same three streams (elementwise
+sum, cat and ``dist_reduce_fx="merge"`` states); rank 1's daemon is killed
+mid-ingest by a fault-injected preemption and restarted, the client replays
+from each restored stream's ``next_seq``, and the lockstep sorted drains
+(each final compute is a cross-rank collective) produce exactly the
+uninterrupted single-process results.
+
 A fourth scenario, ``durable``, exercises preemption-safe evaluation
 (ISSUE 5): on each rank a ``StreamingEvaluator`` accumulates its shard of
 the stream into a per-rank ``CheckpointStore`` (``TM_TPU_STORE_DIR`` set by
@@ -398,6 +407,138 @@ def run_live_scenario(pid: int, nproc: int) -> None:
     print(f"rank {pid}: live status published and synced value verified")
 
 
+def run_serve_scenario(pid: int, nproc: int) -> None:
+    """metricserve under the real 2-process group (ISSUE 14): both ranks run
+    the daemon against per-rank base dirs on a shared stream set (elementwise
+    sum, cat and merge states). Rank 1's daemon is killed mid-ingest (a
+    lockstep-deterministic ``runner.preempt`` on a stream worker) and
+    restarted; the client replays from each stream's restored ``next_seq``,
+    both ranks drain in sorted order (the collective inside each final
+    compute lines up), and every drained value equals the uninterrupted
+    single-process run."""
+    import os
+    import time
+
+    import numpy as np
+
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryAveragePrecision
+    from torchmetrics_tpu.robustness import faults
+    from torchmetrics_tpu.serve import ServeDaemon
+    from torchmetrics_tpu.sketch import kll_error_bound
+
+    base = os.path.join(os.environ["TM_TPU_STORE_DIR"], f"rank{pid}")
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 96
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    bounds = [0, 60, n_total]  # uneven shards
+    lo, hi = bounds[pid], bounds[pid + 1]
+    n_batches = 6
+    data = rng.randn(20_000).astype(np.float32)  # same on both ranks
+    dlo, dhi = (0, 13_000) if pid == 0 else (13_000, 20_000)
+
+    # per-stream wire batch streams (lists — exactly what ingest carries)
+    wire_batches = {
+        "acc": [
+            [p.tolist(), t.tolist()]
+            for p, t in zip(np.array_split(preds[lo:hi], n_batches), np.array_split(target[lo:hi], n_batches))
+        ],
+        "ap": [
+            [p.tolist(), t.tolist()]
+            for p, t in zip(np.array_split(preds[lo:hi], n_batches), np.array_split(target[lo:hi], n_batches))
+        ],
+        "q": [[c.tolist()] for c in np.array_split(data[dlo:dhi], n_batches)],
+    }
+    specs = {
+        "acc": {"name": "acc", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                "snapshot_every_n": 2, "use_feed": False},
+        "ap": {"name": "ap", "target": "torchmetrics_tpu.serve.factories:binary_average_precision",
+               "snapshot_every_n": 2, "use_feed": False},
+        "q": {"name": "q", "target": "torchmetrics_tpu.serve.factories:quantile",
+              "kwargs": {"q": 0.5, "capacity": 256, "levels": 14},
+              "snapshot_every_n": 2, "use_feed": False},
+    }
+
+    daemon = ServeDaemon(base, publish=False).start()
+    for name in sorted(specs):
+        reply = daemon.create_stream(specs[name])
+        assert reply["ok"], reply
+
+    def ingest_all(d, start_at):
+        """Replay every stream from its ``start_at[name]``; tolerate a failed
+        stream (the kill) — returns True when everything was acked."""
+        clean = True
+        for name in sorted(wire_batches):
+            for seq in range(start_at.get(name, 0), n_batches):
+                reply = d.ingest(name, seq, wire_batches[name][seq])
+                while not reply.get("ok") and reply.get("error", {}).get("code") == "backpressure":
+                    time.sleep(0.01)
+                    reply = d.ingest(name, seq, wire_batches[name][seq])
+                if not reply.get("ok"):
+                    assert reply["error"]["code"] == "failed", reply
+                    clean = False
+                    break
+        return clean
+
+    if pid == 1:
+        # the kill: a preemption fires on a stream worker mid-ingest; the
+        # daemon is then torn down WITHOUT drain — exactly a SIGKILL's
+        # durable footprint (snapshots only), plus latched dropped batches
+        with faults.inject(faults.Fault("preempt", "runner.preempt", after=3, count=1)):
+            clean = ingest_all(daemon, {})
+            deadline = time.monotonic() + 30
+            while clean and time.monotonic() < deadline:
+                # the preempt may hit a worker AFTER every offer was acked;
+                # wait for the fault to surface in some stream
+                states = [s["state"] for s in daemon.status()["streams"]]
+                if "failed" in states:
+                    clean = False
+                    break
+                time.sleep(0.05)
+        assert not clean, "rank 1's injected preemption never fired"
+        daemon.shutdown(drain=False)
+
+        # the restart: specs survive on disk; every stream resumes from its
+        # snapshot cursor and the client replays the unpersisted suffix
+        daemon = ServeDaemon(base, publish=False).start()
+        status = daemon.status()
+        start_at = {s["name"]: s["next_seq"] for s in status["streams"]}
+        assert any(v < n_batches for v in start_at.values()), f"nothing to replay: {start_at}"
+        assert ingest_all(daemon, start_at), "replay after restart did not ack cleanly"
+    else:
+        assert ingest_all(daemon, {}), "rank 0's ingest must be clean"
+
+    # lockstep drain, sorted order on BOTH ranks: each final compute is a
+    # collective — rank 0 parks in gloo until rank 1's replay catches up
+    results = {}
+    for name in sorted(specs):
+        reply = daemon.drain_stream(name)
+        assert reply["ok"], reply
+        results[name] = reply["results"]
+
+    # elementwise (sum states): bitwise vs the uninterrupted single-process run
+    ref = BinaryAccuracy(distributed_available_fn=lambda: False, validate_args=False)
+    ref.update(preds, target)
+    assert results["acc"] == float(ref.compute()), f"serve elementwise: {results['acc']}"
+
+    # cat (list states): gathered rows across ranks
+    ap_ref = BinaryAveragePrecision(distributed_available_fn=lambda: False, validate_args=False)
+    ap_ref.update(preds, target)
+    assert abs(results["ap"] - float(ap_ref.compute())) < 1e-6, f"serve cat: {results['ap']}"
+
+    # merge (sketch) state: inside the merged sketch's own rank-error bound
+    q_metric = daemon._get("q").evaluator.metric
+    q_metric.sync()
+    bound = float(kll_error_bound(q_metric.sketch))
+    assert int(q_metric.sketch.count) == data.size, "merged sketch lost samples across the kill"
+    rank_err = abs(float((data <= float(results["q"])).sum()) - 0.5 * data.size)
+    assert rank_err <= bound + 1, f"serve sketch: rank error {rank_err} > bound {bound}"
+    q_metric.unsync()
+
+    daemon.shutdown(drain=True)
+    print(f"rank {pid}: serve daemon kill/restart/replay parity verified")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -417,6 +558,9 @@ def main() -> None:
         return
     if scenario == "live":
         run_live_scenario(pid, nproc)
+        return
+    if scenario == "serve":
+        run_serve_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

@@ -178,6 +178,25 @@ def test_two_process_live_status_watch(tmp_path):
         assert rank_rows[rank].split()[2] == "6", result.stdout
 
 
+@pytest.mark.timeout(300)
+def test_two_process_serve_daemon(tmp_path):
+    """The eval-service daemon under a REAL 2-process group (ISSUE 14
+    satellite): both ranks run a ``ServeDaemon`` over per-rank base dirs
+    serving the same three streams (elementwise sum, cat and merge states);
+    rank 1's daemon is killed mid-ingest by a fault-injected preemption and
+    restarted, the client replays from each restored stream's ``next_seq``,
+    and the lockstep sorted drains (each final compute is a cross-rank
+    collective) match the uninterrupted single-process results."""
+    results = _run_workers(
+        "serve",
+        timeout=240,
+        extra_env={"TM_TPU_STORE_DIR": str(tmp_path)},
+    )
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: serve daemon kill/restart/replay parity verified" in out, out
+
+
 @pytest.mark.timeout(240)
 def test_two_process_injected_faults():
     """The robustness layer under REAL injected faults across the group: a

@@ -227,7 +227,7 @@ def test_metrics_endpoint_serves_live_run(tmp_path):
     batches = _cls_batches()
     store = CheckpointStore(str(tmp_path / "s"))
     with live.publishing(http=":0", cadence_s=5.0, rank=1) as pub:
-        host, port = pub.http_address
+        host, port = pub.http_address()
         ev = StreamingEvaluator(MulticlassAccuracy(num_classes=5), store=store, snapshot_every_n=4)
         ev.run(batches)
         body = urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=5).read().decode()
@@ -243,9 +243,50 @@ def test_metrics_endpoint_serves_live_run(tmp_path):
     assert by_name["tm_tpu_robustness_store_save_total"][1] >= 2
 
 
+def test_stop_publishes_final_status_tick(tmp_path):
+    """ISSUE 14 satellite: ``stop()`` flushes one last status tick AFTER the
+    loop thread joins, so the post-stop file carries the drain-final counters
+    (what a metricserve graceful shutdown banks) marked ``"final": true``."""
+    pub = live.enable(directory=str(tmp_path), cadence_s=3600.0, rank=0)  # cadence never fires
+    counters.inc("runner.progress.batches", 6)
+    # the start tick ran BEFORE the counters moved: on disk they are absent
+    before = json.loads((tmp_path / "status.rank0.json").read_text())
+    assert "final" not in before
+    assert before["counters"].get("runner.progress.batches") is None
+    live.disable()  # -> pub.stop() -> the final tick
+    after = json.loads((tmp_path / "status.rank0.json").read_text())
+    assert after["final"] is True
+    assert after["counters"]["runner.progress.batches"] == 6
+    assert after["seq"] > before["seq"]
+    assert pub.publish_errors == 0
+    # non-final periodic payloads never carry the key at all
+    assert "final" not in pub.tick()
+
+
+def test_two_ephemeral_publishers_side_by_side(tmp_path):
+    """ISSUE 14 satellite: ``http=":0"`` binds an ephemeral port per
+    publisher, discoverable via ``http_address()`` — two publishers (two
+    daemons on one host) coexist without a port collision."""
+    first = live.TelemetryPublisher(http=":0", cadence_s=60.0, rank=0).start()
+    second = live.TelemetryPublisher(http=":0", cadence_s=60.0, rank=1).start()
+    try:
+        addr0, addr1 = first.http_address(), second.http_address()
+        assert addr0 is not None and addr1 is not None
+        assert addr0[1] != addr1[1] and addr0[1] > 0 and addr1[1] > 0
+        for (host, port), rank in ((addr0, 0), (addr1, 1)):
+            body = json.loads(
+                urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5).read()
+            )
+            assert body["state"] == "ok" and body["rank"] == rank
+    finally:
+        first.stop()
+        second.stop()
+    assert first.http_address() is None  # the sink is really down
+
+
 def test_healthz_reports_cursor_and_matching_status(tmp_path):
     with live.publishing(http=":0", cadence_s=5.0, rank=0) as pub:
-        host, port = pub.http_address
+        host, port = pub.http_address()
         ev = StreamingEvaluator(MulticlassAccuracy(num_classes=5))
         ev.run(_cls_batches(n=4))
         response = urllib.request.urlopen(f"http://{host}:{port}/healthz", timeout=5)
@@ -279,7 +320,7 @@ def test_healthz_transitions_ok_to_stalled_before_stallerror():
     stop = threading.Event()
 
     with live.publishing(http=":0", cadence_s=0.1, rank=0) as pub:
-        host, port = pub.http_address
+        host, port = pub.http_address()
         url = f"http://{host}:{port}/healthz"
 
         def poll():

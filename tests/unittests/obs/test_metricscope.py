@@ -182,6 +182,43 @@ def test_watch_once_standalone_does_not_import_jax(tmp_path):
     assert "640" in rows["0"]  # throughput column rendered
 
 
+def test_watch_json_emits_rank_and_stream_rows(tmp_path):
+    """ISSUE 14 satellite: ``watch --json`` prints one compact JSON object
+    per rank AND per ``serve.<stream>.*`` gauge family — machine-readable
+    fleet state — still without ever importing jax."""
+    env = _poisoned_env(tmp_path)
+    status_dir = tmp_path / "status"
+    status_dir.mkdir()
+    now = 1_000_000_000_000_000_000
+    _write_status_file(str(status_dir), 0, now)
+    _write_status_file(str(status_dir), 1, now - 5_000_000_000)  # frozen 5s behind
+    # rank 0 is a metricserve daemon: splice in a stream gauge family
+    path = status_dir / "status.rank0.json"
+    payload = json.loads(path.read_text())
+    payload["gauges"].update({
+        "serve.streams": 1.0, "serve.m1.health_state": 3.0, "serve.m1.state": 4.0,
+        "serve.m1.cursor": 5.0, "serve.m1.pending": 2.0, "serve.m1.dropped": 2.0,
+    })
+    path.write_text(json.dumps(payload))
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "watch", "--json", "--once", "--stale-after", "2.0", str(status_dir)],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    rows = [json.loads(ln) for ln in result.stdout.splitlines() if ln.strip()]
+    ranks = {r["rank"]: r for r in rows if r["kind"] == "rank"}
+    assert set(ranks) == {0, 1}
+    assert ranks[0]["batches"] == 6 and ranks[0]["stale"] is False
+    assert ranks[1]["stale"] is True and ranks[1]["behind_s"] == pytest.approx(5.0)
+    (stream_row,) = [r for r in rows if r["kind"] == "stream"]
+    assert stream_row["rank"] == 0 and stream_row["stream"] == "m1"
+    assert stream_row["health"] == "stalled"  # health_state 3
+    assert stream_row["state"] == 4.0  # lifecycle gauge: failed
+    assert stream_row["pending"] == 2.0 and stream_row["dropped"] == 2.0
+    # daemon-global gauges (no stream component) never masquerade as streams
+    assert all(r.get("stream") != "streams" for r in rows)
+
+
 def _write_span_trace(path, dur_scale=1.0):
     events = [
         {"type": "span", "name": "metric.update", "ts": i * 1000, "dur": int(1_000_000 * dur_scale),

@@ -1,0 +1,27 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Test-only stream-target factories (referenced by ``module:callable`` path
+from daemon tests — the same declarative mechanism deployments use)."""
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+#: gate for :func:`blocking_accuracy` — tests set it to unstick the update
+BLOCK = threading.Event()
+
+
+def blocking_accuracy() -> Any:
+    """A metric whose first update hangs until :data:`BLOCK` is set — a stand-in
+    for a wedged device step, so watchdog-margin health decay is observable."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    metric = BinaryAccuracy(validate_args=False)
+    orig = metric.update
+
+    def update(*args: Any, **kwargs: Any) -> None:
+        BLOCK.wait()
+        orig(*args, **kwargs)
+
+    metric.update = update
+    return metric

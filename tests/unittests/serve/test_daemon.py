@@ -1,0 +1,342 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""ServeDaemon: registry, HTTP/socket planes, chaos restart parity, health
+(ISSUE 14)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.serve import ServeDaemon
+
+_SEED = 11
+
+
+def _http(daemon, method, path, body=None):
+    """One control-plane round trip; returns (http_status, parsed body, headers)."""
+    host, port = daemon.http_address()
+    data = None if body is None else json.dumps({"v": 1, **body}).encode()
+    req = urllib.request.Request(f"http://{host}:{port}{path}", data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read()), dict(err.headers)
+
+
+def _four_stream_fixtures(n_batches=6, n=96):
+    """Specs + wire batches for the chaos quartet: plain, fused collection,
+    sliced and windowed — the ISSUE's ≥ 4 concurrent stream shapes."""
+    rng = np.random.RandomState(_SEED)
+    labels = rng.randint(0, 4, n)
+    target4 = rng.randint(0, 4, n)
+    probs = rng.rand(n, 4).astype(np.float32)
+    probs /= probs.sum(axis=1, keepdims=True)
+    keys = rng.randint(0, 4, n)
+    bpreds = rng.rand(n).astype(np.float32)
+    btarget = rng.randint(0, 2, n)
+
+    def split(*cols):
+        return [
+            [np.array_split(c, n_batches)[k].tolist() for c in cols] for k in range(n_batches)
+        ]
+
+    specs = {
+        "plain": {"name": "plain", "target": "torchmetrics_tpu.serve.factories:accuracy",
+                  "snapshot_every_n": 2, "use_feed": False},
+        "fusedc": {"name": "fusedc", "target": "torchmetrics_tpu.serve.factories:collection",
+                   "fused": True, "fused_options": {"cat_capacity": 128},
+                   "snapshot_every_n": 2, "use_feed": False},
+        "sliced": {"name": "sliced", "target": "torchmetrics_tpu.serve.factories:sliced_accuracy",
+                   "kwargs": {"num_classes": 4, "num_cells": 4}, "snapshot_every_n": 2,
+                   "use_feed": True},
+        "windowed": {"name": "windowed", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                     "window": {"slots": 3, "every_n": 2}, "snapshot_every_n": 2, "use_feed": False},
+    }
+    batches = {
+        "plain": split(labels, target4),
+        "fusedc": split(probs, target4),
+        "sliced": split(keys, labels, target4),
+        "windowed": split(bpreds, btarget),
+    }
+    return specs, batches
+
+
+def _ingest_all(daemon, batches, start_at=None):
+    """Offer every batch from each stream's start seq; stops a stream's feed
+    at the first hard failure (the injected kill)."""
+    clean = True
+    for name in sorted(batches):
+        for seq in range((start_at or {}).get(name, 0), len(batches[name])):
+            reply = daemon.ingest(name, seq, batches[name][seq], block=True, deadline_s=30.0)
+            if not reply.get("ok"):
+                clean = False
+                break
+    return clean
+
+
+def _drain_all(daemon, names):
+    results = {}
+    for name in sorted(names):
+        reply = daemon.drain_stream(name)
+        assert reply["ok"], reply
+        results[name] = reply["results"]
+    return results
+
+
+class TestChaosRestartParity:
+    def test_kill_restart_replay_is_bitwise_equal(self, tmp_path):
+        """ISSUE 14 chaos acceptance: ≥ 4 concurrent streams (fused, sliced,
+        windowed among them) survive a mid-ingest kill — worker death plus a
+        drainless teardown, the in-process twin of SIGKILL's durable footprint
+        (snapshots + specs only) — and the restarted daemon's resumed results
+        are EXACTLY the uninterrupted run's."""
+        specs, batches = _four_stream_fixtures()
+
+        # the uninterrupted reference run
+        ref = ServeDaemon(str(tmp_path / "ref"), publish=False).start()
+        for name in sorted(specs):
+            assert ref.create_stream(specs[name])["ok"]
+        assert _ingest_all(ref, batches)
+        want = _drain_all(ref, specs)
+        ref.shutdown(drain=False)
+
+        # the chaos run: a lockstep preemption kills one stream's worker
+        # mid-ingest; the daemon is then torn down WITHOUT drain
+        chaos_dir = str(tmp_path / "chaos")
+        daemon = ServeDaemon(chaos_dir, publish=False).start()
+        for name in sorted(specs):
+            assert daemon.create_stream(specs[name])["ok"]
+        with faults.inject(faults.Fault("preempt", "runner.preempt", after=5, count=1)):
+            clean = _ingest_all(daemon, batches)
+            deadline = time.monotonic() + 30
+            while clean and time.monotonic() < deadline:
+                if any(s["state"] == "failed" for s in daemon.status()["streams"]):
+                    clean = False
+                    break
+                time.sleep(0.02)
+        assert not clean, "the injected mid-ingest kill never fired"
+        daemon.shutdown(drain=False)
+
+        # restart = resume: every spec.json rebuilds its stream at the
+        # snapshot cursor; the client replays exactly the unpersisted suffix
+        daemon = ServeDaemon(chaos_dir, publish=False).start()
+        status = daemon.status()
+        start_at = {s["name"]: s["next_seq"] for s in status["streams"]}
+        assert set(start_at) == set(specs), "restart lost a stream"
+        assert any(v < 6 for v in start_at.values()), f"nothing to replay: {start_at}"
+        assert _ingest_all(daemon, batches, start_at)
+        got = _drain_all(daemon, specs)
+        daemon.shutdown(drain=False)
+
+        # bitwise: results travelled JSON (binary64-exact) both times
+        assert got == want
+
+    def test_restart_after_clean_drain_reports_drained_results(self, tmp_path):
+        specs, batches = _four_stream_fixtures(n_batches=2, n=16)
+        daemon = ServeDaemon(str(tmp_path), publish=False).start()
+        assert daemon.create_stream(specs["plain"])["ok"]
+        assert _ingest_all(daemon, {"plain": batches["plain"]})
+        drained = daemon.shutdown(drain=True)
+        assert drained["plain"]["ok"] and drained["plain"]["cursor"] == 2
+        # per-stream costs ledger lands at the compute boundary
+        assert os.path.isfile(os.path.join(str(tmp_path), "streams", "plain", "costs.json"))
+
+
+class TestHttpPlane:
+    @pytest.fixture()
+    def daemon(self, tmp_path):
+        d = ServeDaemon(str(tmp_path), publish=False).start()
+        yield d
+        d.shutdown(drain=False)
+
+    def test_crud_and_ingest_round_trip(self, daemon):
+        code, reply, _ = _http(daemon, "POST", "/v1/streams", {
+            "name": "m1", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+            "use_feed": False,
+        })
+        assert code == 200 and reply["ok"] and reply["next_seq"] == 0
+        code, reply, _ = _http(daemon, "POST", "/v1/streams", {
+            "name": "m1", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+        })
+        assert code == 409 and reply["error"]["code"] == "exists"
+
+        batch = [[0.9, 0.1], [1, 0]]
+        code, reply, _ = _http(daemon, "POST", "/v1/streams/m1/ingest", {"seq": 0, "batch": batch})
+        assert code == 200 and reply["next_seq"] == 1
+        # a gap is a 409 carrying the expected seq — the client rewinds
+        code, reply, _ = _http(daemon, "POST", "/v1/streams/m1/ingest", {"seq": 7, "batch": batch})
+        assert code == 409 and reply["error"]["code"] == "bad_seq" and reply["error"]["expected"] == 1
+
+        code, reply, _ = _http(daemon, "GET", "/v1/streams/m1")
+        assert code == 200 and reply["state"] == "serving" and reply["next_seq"] == 1
+        code, reply, _ = _http(daemon, "POST", "/v1/streams/m1/flush")
+        assert code == 200 and reply["cursor"] == 1
+        code, reply, _ = _http(daemon, "POST", "/v1/streams/m1/drain")
+        assert code == 200 and reply["results"] == 1.0
+
+        code, reply, _ = _http(daemon, "DELETE", "/v1/streams/m1")
+        assert code == 200 and reply["ok"]
+        assert not os.path.isdir(os.path.join(daemon.base_dir, "streams", "m1"))
+        code, reply, _ = _http(daemon, "GET", "/v1/streams/m1")
+        assert code == 404 and reply["error"]["code"] == "not_found"
+
+    def test_bad_requests_are_400s_not_hangups(self, daemon):
+        code, reply, _ = _http(daemon, "POST", "/v1/streams", {"name": "x"})
+        assert code == 400 and "target" in reply["error"]["message"]
+        code, reply, _ = _http(daemon, "POST", "/v1/streams", {"name": "m2", "target": "nope"})
+        assert code == 400 and reply["error"]["code"] == "bad_request"
+        code, reply, _ = _http(daemon, "GET", "/wat")
+        assert code == 404
+        # a future wire version is refused instead of guessed at
+        host, port = daemon.http_address()
+        req = urllib.request.Request(
+            f"http://{host}:{port}/v1/streams", data=json.dumps({"v": 99, "name": "z"}).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 400
+
+    def test_backpressure_is_429_with_retry_after(self, tmp_path):
+        daemon = ServeDaemon(str(tmp_path), publish=False).start()
+        try:
+            assert daemon.create_stream({
+                "name": "q", "target": "torchmetrics_tpu.serve.factories:quantile",
+                "queue_max": 1, "use_feed": False,
+            })["ok"]
+            batch = [np.zeros(8, np.float32).tolist()]
+            saw_429 = False
+            seq = 0
+            for _ in range(300):
+                code, reply, headers = _http(daemon, "POST", "/v1/streams/q/ingest",
+                                             {"seq": seq, "batch": batch})
+                if code == 200:
+                    seq = reply["next_seq"]
+                elif code == 429:
+                    assert reply["error"]["code"] == "backpressure"
+                    assert float(headers["Retry-After"]) > 0
+                    saw_429 = True
+                    break
+                else:
+                    raise AssertionError((code, reply))
+            assert saw_429, "queue_max=1 never pushed back over HTTP"
+            # admission control never dropped anything: the drain applies
+            # every acked batch and the latched counter stays zero
+            reply = daemon.drain_stream("q")
+            assert reply["ok"] and reply["cursor"] == seq
+            assert daemon._get("q").dropped == 0
+        finally:
+            daemon.shutdown(drain=False)
+
+
+class TestHealth:
+    def test_healthz_is_worst_stream(self, tmp_path):
+        daemon = ServeDaemon(str(tmp_path), publish=False).start()
+        try:
+            for name in ("good", "bad"):
+                assert daemon.create_stream({
+                    "name": name, "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                    "use_feed": False,
+                })["ok"]
+            code, body, _ = _http(daemon, "GET", "/healthz")
+            assert code == 200 and body["state"] == "ok"
+
+            with faults.inject(faults.Fault("fail", "runner.preempt", count=1)):
+                daemon.ingest("bad", 0, [[0.9], [1]])
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if daemon._get("bad").status()["state"] == "failed":
+                        break
+                    time.sleep(0.02)
+            code, body, _ = _http(daemon, "GET", "/healthz")
+            assert code == 503 and body["state"] == "stalled"
+            assert "bad" in body["reason"]
+            # the healthy stream is untouched — health is worst-of, not avg
+            assert daemon._get("good").status()["state"] == "serving"
+        finally:
+            daemon.shutdown(drain=False)
+
+    def test_healthz_body_carries_per_stream_detail_via_publisher(self, tmp_path):
+        daemon = ServeDaemon(str(tmp_path), publish=True).start()
+        try:
+            assert daemon.create_stream({
+                "name": "m1", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                "use_feed": False,
+            })["ok"]
+            code, body, _ = _http(daemon, "GET", "/healthz")
+            assert code == 200
+            assert body["streams"]["m1"]["health"] == "ok"
+            assert body["streams"]["m1"]["state"] == 1.0  # serving (STATE_CODES)
+            assert body["streams"]["m1"]["cursor"] == 0.0
+            # the OpenMetrics scrape exposes the serve gauge family too
+            code, _, _ = _http(daemon, "GET", "/healthz")
+            host, port = daemon.http_address()
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as resp:
+                text = resp.read().decode()
+            assert 'serve_m1_state' in text.replace(".", "_") or "serve.m1.state" in text
+        finally:
+            daemon.shutdown(drain=False)
+
+    def test_healthz_flips_stalled_before_watchdog_raises(self, tmp_path):
+        """ISSUE acceptance: the live watchdog margin decays DURING the wedged
+        update, so /healthz reports stalled strictly before StallError fires
+        and the stream is still 'serving' when it does."""
+        from tests.unittests.serve import _targets
+
+        _targets.BLOCK.clear()
+        daemon = ServeDaemon(str(tmp_path), publish=False).start()
+        try:
+            assert daemon.create_stream({
+                "name": "wedged", "target": "tests.unittests.serve._targets:blocking_accuracy",
+                "use_feed": False, "watchdog_timeout_s": 6.0, "on_stall": "raise",
+            })["ok"]
+            assert daemon.ingest("wedged", 0, [[0.9, 0.2], [1, 0]])["ok"]
+            flipped_while_serving = False
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                code, body, _ = _http(daemon, "GET", "/healthz")
+                state = daemon._get("wedged").status()["state"]
+                if body["state"] == "stalled" and state == "serving":
+                    flipped_while_serving = True
+                    break
+                if state == "failed":
+                    break
+                time.sleep(0.05)
+            assert flipped_while_serving, "/healthz did not flip before the watchdog raise"
+            # ... and the watchdog then actually raises, failing the stream
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                status = daemon._get("wedged").status()
+                if status["state"] == "failed":
+                    break
+                time.sleep(0.05)
+            assert status["state"] == "failed" and "StallError" in status["failure"]
+        finally:
+            _targets.BLOCK.set()  # unstick the abandoned update thread
+            daemon.shutdown(drain=False)
+
+
+class TestAcceptFault:
+    def test_rejected_create_leaves_no_directory(self, tmp_path):
+        daemon = ServeDaemon(str(tmp_path), publish=False).start()
+        try:
+            with faults.inject(faults.Fault("fail", "serve.accept", count=1)):
+                with pytest.raises(faults.FaultInjected):
+                    daemon.create_stream({
+                        "name": "m1", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                    })
+            assert not os.path.isdir(os.path.join(str(tmp_path), "streams", "m1"))
+            # a bad factory is also cleaned up (create fully succeeds or not at all)
+            reply = daemon.create_stream({"name": "m2", "target": "nope:nope"})
+            assert not reply["ok"]
+            assert not os.path.isdir(os.path.join(str(tmp_path), "streams", "m2"))
+        finally:
+            daemon.shutdown(drain=False)

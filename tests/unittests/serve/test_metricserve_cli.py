@@ -1,0 +1,173 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""The metricserve CLI end to end: a real daemon subprocess driven by the
+jax-free ctl client, SIGKILL chaos and SIGTERM grace (ISSUE 14)."""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+_REPO_ROOT = Path(__file__).parent.parent.parent.parent
+_CLI = str(_REPO_ROOT / "tools" / "metricserve.py")
+
+def _poisoned_env(tmp_path):
+    """ctl must never import jax — a poisoned module makes any attempt fatal."""
+    poison = tmp_path / "poison"
+    poison.mkdir(exist_ok=True)
+    (poison / "jax.py").write_text("raise ImportError('metricserve ctl must not import jax')\n")
+    return dict(os.environ, PYTHONPATH=str(poison))
+
+
+def _start_daemon(base_dir):
+    proc = subprocess.Popen(
+        [sys.executable, _CLI, "serve", "--base-dir", str(base_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(_REPO_ROOT),
+    )
+    ready = proc.stdout.readline()
+    assert ready, proc.stderr.read()
+    info = json.loads(ready)
+    assert info["ok"] and info["pid"] == proc.pid
+    return proc, info
+
+
+def _ctl(env, *args, stdin=None):
+    result = subprocess.run(
+        [sys.executable, _CLI, "ctl", *args],
+        input=stdin, capture_output=True, text=True, timeout=120, env=env, cwd=str(_REPO_ROOT),
+    )
+    return result
+
+
+def _batches_jsonl(n_batches=6, n=48, seed=3):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n)
+    return "\n".join(
+        json.dumps([p.tolist(), t.tolist()])
+        for p, t in zip(np.array_split(preds, n_batches), np.array_split(target, n_batches))
+    ) + "\n"
+
+
+@pytest.mark.timeout(180)
+def test_serve_ready_line_ctl_round_trip_and_sigterm_drain(tmp_path):
+    base = tmp_path / "base"
+    proc, info = _start_daemon(base)
+    try:
+        http = "{}:{}".format(*info["http"])
+        env = _poisoned_env(tmp_path)
+        # the socket path is discoverable from the ready line too
+        assert info["socket"] == str(base / "ingest.sock")
+
+        out = _ctl(env, "--http", http, "create", "--name", "m1",
+                   "--target", "torchmetrics_tpu.serve.factories:binary_accuracy",
+                   "--snapshot-every-n", "2", "--json")
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout)["next_seq"] == 0
+
+        # replay over the persistent unix socket (the ingest fast path)
+        jsonl = _batches_jsonl()
+        out = _ctl(env, "--socket", info["socket"], "replay", "m1", stdin=jsonl)
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout)
+        assert summary["acked"] == 6 and summary["skipped"] == 0
+
+        # re-running the SAME replay is a no-op: everything skips as duplicate
+        out = _ctl(env, "--http", http, "--socket", info["socket"], "replay", "m1", stdin=jsonl)
+        assert json.loads(out.stdout)["sent"] == 0
+
+        out = _ctl(env, "--http", http, "status", "m1", "--json")
+        status = json.loads(out.stdout)
+        assert status["state"] == "serving" and status["next_seq"] == 6
+
+        # SIGTERM = graceful drain: every admitted batch applies, results print
+        proc.send_signal(signal.SIGTERM)
+        stdout, stderr = proc.communicate(timeout=90)
+        assert proc.returncode == 0, stderr
+        assert json.loads(stdout.splitlines()[-1]) == {"ok": True, "drained": ["m1"]}
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@pytest.mark.timeout(240)
+def test_sigkill_restart_resumes_with_parity(tmp_path):
+    """The acceptance chaos, through the real process boundary: SIGKILL the
+    daemon mid-stream, restart it on the same base dir, replay the same
+    JSONL — the drained result equals an uninterrupted daemon's, exactly."""
+    jsonl = _batches_jsonl()
+    env = _poisoned_env(tmp_path)
+    spec_args = ["create", "--name", "m1",
+                 "--target", "torchmetrics_tpu.serve.factories:binary_accuracy",
+                 "--snapshot-every-n", "2"]
+
+    # uninterrupted reference daemon
+    ref_proc, ref_info = _start_daemon(tmp_path / "ref")
+    try:
+        http = "{}:{}".format(*ref_info["http"])
+        assert _ctl(env, "--http", http, *spec_args).returncode == 0
+        assert _ctl(env, "--socket", ref_info["socket"], "replay", "m1", stdin=jsonl).returncode == 0
+        out = _ctl(env, "--http", http, "drain", "m1", "--json")
+        want = json.loads(out.stdout)["results"]
+    finally:
+        ref_proc.kill()
+        ref_proc.communicate(timeout=30)
+
+    # chaos daemon: ingest part of the stream, flush a snapshot, SIGKILL
+    base = tmp_path / "chaos"
+    proc, info = _start_daemon(base)
+    http = "{}:{}".format(*info["http"])
+    try:
+        assert _ctl(env, "--http", http, *spec_args).returncode == 0
+        partial = "\n".join(jsonl.splitlines()[:4]) + "\n"
+        assert _ctl(env, "--socket", info["socket"], "replay", "m1", stdin=partial).returncode == 0
+        assert _ctl(env, "--http", http, "flush", "m1").returncode == 0
+        proc.send_signal(signal.SIGKILL)  # no drain, no goodbye
+        proc.communicate(timeout=30)
+        assert proc.returncode == -signal.SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    # restart on the same base dir: the stream is already there, resumed at
+    # its snapshot cursor; replaying the SAME file sends only the suffix
+    proc, info = _start_daemon(base)
+    http = "{}:{}".format(*info["http"])
+    try:
+        out = _ctl(env, "--http", http, "status", "m1", "--json")
+        resumed_at = json.loads(out.stdout)["next_seq"]
+        assert 0 < resumed_at <= 4, out.stdout
+        out = _ctl(env, "--socket", info["socket"], "replay", "m1", stdin=jsonl)
+        summary = json.loads(out.stdout)
+        assert summary["skipped"] == resumed_at and summary["acked"] == 6 - resumed_at
+        out = _ctl(env, "--http", http, "drain", "m1", "--json")
+        got = json.loads(out.stdout)["results"]
+        assert got == want  # bitwise through JSON binary64
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)
+
+
+@pytest.mark.timeout(120)
+def test_ctl_reports_wire_errors_cleanly(tmp_path):
+    proc, info = _start_daemon(tmp_path / "base")
+    try:
+        http = "{}:{}".format(*info["http"])
+        env = _poisoned_env(tmp_path)
+        out = _ctl(env, "--http", http, "status", "ghost")
+        assert out.returncode == 1
+        assert "error [not_found]" in out.stderr
+        out = _ctl(env, "--http", http, "create", "--name", "bad/name",
+                   "--target", "torchmetrics_tpu.serve.factories:binary_accuracy")
+        assert out.returncode == 1 and "bad_request" in out.stderr
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)

@@ -1,0 +1,214 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""One stream's lifecycle: exactly-once ingest, flush/drain ops, failure
+accounting (ISSUE 14)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.robustness.faults import FaultInjected
+from torchmetrics_tpu.serve.stream import Stream, StreamSpec, decode_batch, resolve_target
+
+_ACC = "torchmetrics_tpu.serve.factories:binary_accuracy"
+
+
+def _wire_batches(n_batches=6, n=48, seed=7):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(0, 2, n)
+    return (
+        [[p.tolist(), t.tolist()] for p, t in zip(np.array_split(preds, n_batches), np.array_split(target, n_batches))],
+        preds,
+        target,
+    )
+
+
+def _start(tmp_path, **spec_kw) -> Stream:
+    spec_kw.setdefault("name", "m1")
+    spec_kw.setdefault("target", _ACC)
+    spec_kw.setdefault("use_feed", False)
+    stream = Stream(StreamSpec(**spec_kw), str(tmp_path / "store"))
+    stream.start()
+    return stream
+
+
+class TestSpec:
+    @pytest.mark.parametrize("bad", ["", "a/b", "a.b", "a\\b", " pad "])
+    def test_rejects_unclean_names(self, bad):
+        with pytest.raises(ValueError, match="clean path component"):
+            StreamSpec(name=bad, target=_ACC)
+
+    def test_wire_round_trip(self):
+        spec = StreamSpec(name="m1", target=_ACC, kwargs={"threshold": 0.25}, snapshot_every_n=2)
+        again = StreamSpec.from_wire(spec.to_wire())
+        assert again.to_wire() == spec.to_wire()
+
+    def test_from_wire_rejects_unknown_fields(self):
+        from torchmetrics_tpu.serve import wire
+
+        with pytest.raises(wire.WireError, match="unknown StreamSpec field"):
+            StreamSpec.from_wire({"name": "m1", "target": _ACC, "wat": 1})
+
+    def test_resolve_target_validates_path(self):
+        with pytest.raises(ValueError, match="module:callable"):
+            resolve_target("no-colon-here")
+
+    def test_decode_batch_rejects_empty(self):
+        from torchmetrics_tpu.serve import wire
+
+        with pytest.raises(wire.WireError, match="non-empty"):
+            decode_batch([])
+
+
+class TestSeqProtocol:
+    def test_exactly_once_duplicates_and_gaps(self, tmp_path):
+        stream = _start(tmp_path)
+        batches, _, _ = _wire_batches()
+        assert stream.offer(0, batches[0])["next_seq"] == 1
+        assert stream.offer(1, batches[1])["next_seq"] == 2
+        # duplicate replay: acked idempotently, nothing re-applied
+        dup = stream.offer(0, batches[0])
+        assert dup["ok"] and dup["duplicate"] and dup["next_seq"] == 2
+        # gap: rejected with the expected value so the client can rewind
+        gap = stream.offer(5, batches[2])
+        assert not gap["ok"]
+        assert gap["error"]["code"] == "bad_seq" and gap["error"]["expected"] == 2
+        # duplicates and gaps never moved the watermark
+        reply = stream.drain()
+        assert reply["ok"] and reply["cursor"] == 2
+        stream.abandon()
+
+    def test_bad_seq_types_rejected(self, tmp_path):
+        stream = _start(tmp_path)
+        for bad in (-1, "0", True, None, 1.0):
+            reply = stream.offer(bad, [[1.0], [1]])
+            assert not reply["ok"] and reply["error"]["code"] == "bad_request", bad
+        stream.abandon()
+
+    def test_drain_parity_with_inprocess_run(self, tmp_path):
+        """The whole point: wire-ingested results == in-process results,
+        bitwise, through the shared decode path."""
+        stream = _start(tmp_path, snapshot_every_n=2)
+        batches, preds, target = _wire_batches()
+        for seq, batch in enumerate(batches):
+            assert stream.offer(seq, batch)["ok"]
+        reply = stream.drain()
+        assert reply["ok"] and reply["cursor"] == len(batches)
+        assert stream.dropped == 0  # graceful drain applies everything
+
+        ref = resolve_target(_ACC)
+        for batch in batches:
+            ref.update(*decode_batch(batch))
+        assert reply["results"] == float(ref.compute())
+        # a second drain is idempotent — same results, no re-compute
+        again = stream.drain()
+        assert again["ok"] and again["results"] == reply["results"]
+
+    def test_offers_after_drain_are_refused(self, tmp_path):
+        stream = _start(tmp_path)
+        batches, _, _ = _wire_batches()
+        assert stream.offer(0, batches[0])["ok"]
+        assert stream.drain()["ok"]
+        reply = stream.offer(1, batches[1])
+        assert not reply["ok"] and reply["error"]["code"] == "draining"
+
+
+class TestBackpressure:
+    def test_full_queue_pushes_back_then_recovers(self, tmp_path):
+        # a glacial update keeps the worker busy so the queue actually fills
+        stream = _start(
+            tmp_path,
+            name="slow",
+            target="torchmetrics_tpu.serve.factories:quantile",
+            queue_max=2,
+        )
+        big = [np.zeros(4, np.float32).tolist()]
+        seq = 0
+        saw_backpressure = False
+        for _ in range(200):
+            reply = stream.offer(seq, big)
+            if reply.get("ok"):
+                seq = reply["next_seq"]
+            elif reply["error"]["code"] == "backpressure":
+                assert reply["error"]["retry_after_s"] > 0
+                saw_backpressure = True
+                break
+            else:
+                raise AssertionError(reply)
+        # blocking (socket) mode waits a slot out instead of erroring
+        if saw_backpressure:
+            reply = stream.offer(seq, big, block=True, deadline_s=30.0)
+            assert reply["ok"], reply
+        assert stream.drain()["ok"]
+        assert stream.dropped == 0
+
+
+class TestFailure:
+    def test_ingest_fault_does_not_advance_watermark(self, tmp_path):
+        stream = _start(tmp_path)
+        batches, _, _ = _wire_batches()
+        assert stream.offer(0, batches[0])["ok"]
+        with faults.inject(faults.Fault("fail", "serve.ingest", count=1)):
+            with pytest.raises(FaultInjected):
+                stream.offer(1, batches[1])
+        # the failed admission never acked: the SAME seq retries cleanly
+        reply = stream.offer(1, batches[1])
+        assert reply["ok"] and reply["next_seq"] == 2
+        assert stream.drain()["cursor"] == 2
+
+    def test_worker_death_latches_dropped_and_reports_cause(self, tmp_path):
+        stream = _start(tmp_path, name="doomed", snapshot_every_n=2)
+        batches, _, _ = _wire_batches()
+        with faults.inject(faults.Fault("preempt", "runner.preempt", after=2, count=1)):
+            for seq, batch in enumerate(batches):
+                reply = stream.offer(seq, batch)
+                if not reply.get("ok"):
+                    break
+            stream._finished.wait(30.0)
+        status = stream.status()
+        assert status["state"] == "failed"
+        assert "SimulatedPreemption" in status["failure"]
+        # acked-but-never-applied batches latched as dropped (cursor died at 3)
+        assert stream.dropped == status["next_seq"] - status["cursor"] > 0
+        # post-mortem ops and offers report the cause instead of hanging
+        assert stream.offer(status["next_seq"], batches[0])["error"]["code"] == "failed"
+        assert not stream.drain()["ok"]
+        assert stream.gauges()["serve.doomed.health_state"] == 3.0
+
+    def test_abandon_without_compute(self, tmp_path):
+        stream = _start(tmp_path)
+        batches, _, _ = _wire_batches()
+        for seq in range(3):
+            assert stream.offer(seq, batches[seq])["ok"]
+        stream.abandon()
+        assert stream.status()["state"] == "failed"
+        assert stream.result is None  # no final compute on the delete path
+
+
+class TestOps:
+    def test_flush_serializes_after_admitted_batches(self, tmp_path):
+        stream = _start(tmp_path, snapshot_every_n=100)  # only flush snapshots
+        batches, _, _ = _wire_batches()
+        for seq in range(4):
+            assert stream.offer(seq, batches[seq])["ok"]
+        reply = stream.flush()
+        assert reply["ok"] and reply["cursor"] == 4 and reply["snapshot_step"] == 4
+        # the snapshot is durable: a fresh stream resumes at the flush point
+        stream.abandon()
+        resumed = Stream(stream.spec, stream.store_dir)
+        assert resumed.start() == 4
+        resumed.abandon()
+
+    def test_feed_path_matches_plain_path(self, tmp_path):
+        batches, _, _ = _wire_batches()
+        results = []
+        for use_feed, sub in ((False, "plain"), (True, "feed")):
+            stream = _start(tmp_path / sub, name=f"s{int(use_feed)}", use_feed=use_feed)
+            for seq, batch in enumerate(batches):
+                assert stream.offer(seq, batch)["ok"]
+            # an op marker rides the feed too (leafless pytree stages as no-op)
+            assert stream.flush()["ok"]
+            results.append(stream.drain()["results"])
+        assert results[0] == results[1]

@@ -1,0 +1,88 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""The versioned wire schema (ISSUE 14): envelopes, frames, jsonable."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.serve import wire
+
+
+class TestEnvelopes:
+    def test_ok_envelope_carries_version_and_fields(self):
+        reply = wire.ok(stream="m1", next_seq=4)
+        assert reply == {"v": wire.WIRE_VERSION, "ok": True, "stream": "m1", "next_seq": 4}
+
+    def test_error_envelope_carries_code_message_and_extras(self):
+        reply = wire.error("backpressure", "queue full", retry_after_s=0.05)
+        assert reply["v"] == wire.WIRE_VERSION and reply["ok"] is False
+        assert reply["error"]["code"] == "backpressure"
+        assert reply["error"]["message"] == "queue full"
+        assert reply["error"]["retry_after_s"] == 0.05
+
+    def test_error_rejects_unknown_code(self):
+        with pytest.raises(ValueError, match="unknown error code"):
+            wire.error("not_a_code", "nope")
+
+    def test_every_declared_code_builds(self):
+        for code in wire.ERROR_CODES:
+            assert wire.error(code, "x")["error"]["code"] == code
+
+
+class TestFrames:
+    def test_frame_round_trip(self):
+        frame = wire.encode_frame({"op": "ingest", "seq": 3})
+        assert frame.endswith(b"\n") and frame.count(b"\n") == 1
+        assert wire.decode_frame(frame) == {"op": "ingest", "seq": 3}
+
+    def test_decode_rejects_non_object(self):
+        with pytest.raises(wire.WireError, match="JSON object"):
+            wire.decode_frame(b"[1, 2]\n")
+
+    def test_decode_rejects_garbage(self):
+        with pytest.raises(wire.WireError):
+            wire.decode_frame(b"{nope\n")
+
+
+class TestVersion:
+    def test_current_version_passes(self):
+        wire.check_version({"v": wire.WIRE_VERSION, "op": "status"})
+
+    def test_missing_version_rejected(self):
+        with pytest.raises(wire.WireError, match="unsupported wire version"):
+            wire.check_version({"op": "status"})
+
+    def test_future_version_rejected(self):
+        with pytest.raises(wire.WireError, match="unsupported wire version"):
+            wire.check_version({"v": wire.WIRE_VERSION + 1})
+
+
+class TestJsonable:
+    def test_arrays_scalars_and_nests(self):
+        obj = {
+            "a": np.arange(3, dtype=np.float32),
+            "b": np.float64(2.5),
+            "c": [np.int32(1), (np.ones(2), "s")],
+        }
+        out = wire.to_jsonable(obj)
+        assert out == {"a": [0.0, 1.0, 2.0], "b": 2.5, "c": [1, [[1.0, 1.0], "s"]]}
+        json.dumps(out)  # actually serializable
+
+    def test_float32_round_trip_is_bitwise(self):
+        # wire batches are float32 → JSON binary64 → float32: bit-exact both ways
+        vals = np.random.RandomState(0).rand(64).astype(np.float32)
+        back = np.asarray(json.loads(json.dumps(wire.to_jsonable(vals))), dtype=np.float32)
+        assert np.array_equal(back, vals)
+
+    def test_wire_module_is_stdlib_only(self):
+        # the ctl plane path-loads this module on jax-free supervisor hosts
+        import torchmetrics_tpu.serve.wire as mod
+
+        import re
+
+        src = open(mod.__file__).read()
+        bad = re.findall(r"^\s*(?:import|from)\s+(jax|numpy|torchmetrics_tpu)\b", src, re.M)
+        assert not bad, f"wire.py must stay stdlib-only (found imports of {bad})"

@@ -30,7 +30,9 @@ a multi-process run reads as a single picture. ``watch`` renders the LIVE
 plane: a terminal dashboard over the ``status.rank<k>.json`` files a
 ``TM_TPU_PUBLISH=<dir>`` run's publisher writes — per-rank throughput,
 progress, health and watchdog margin, with stale-rank detection via the
-payloads' wall-clock anchors (``--once`` prints a single frame and exits).
+payloads' wall-clock anchors (``--once`` prints a single frame and exits;
+``--json`` emits one JSON object per rank/stream row instead of the table,
+the form supervisors and ``metricserve ctl status`` consume).
 ``diff`` compares two recorded traces span by span (count, p50, p95 deltas
 per ``(metric, span)`` row) and, with ``--fail-on-regress <pct>``, exits
 non-zero when any common span slowed beyond the threshold — a CI perf gate
@@ -240,6 +242,13 @@ def _cmd_watch(args) -> int:
         except FileNotFoundError as err:
             print(err, file=sys.stderr)
             return 1
+        if args.json:
+            frame = obs.live.format_watch_json(statuses, stale_after_s=args.stale_after)
+            print(frame)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+            continue
         frame = obs.live.format_watch_table(statuses, stale_after_s=args.stale_after)
         if args.once:
             print(frame)
@@ -336,6 +345,10 @@ def main(argv=None) -> int:
     p_watch = sub.add_parser("watch", help="live dashboard over a TM_TPU_PUBLISH status-file directory")
     p_watch.add_argument("directory", help="directory the publisher writes status.rank<k>.json files into")
     p_watch.add_argument("--once", action="store_true", help="print one frame and exit (scripts/tests)")
+    p_watch.add_argument(
+        "--json", action="store_true",
+        help="machine-readable frames: one JSON object per rank/stream row (supervisors, metricserve ctl)",
+    )
     p_watch.add_argument("--interval", type=float, default=2.0, help="refresh period in seconds (default 2)")
     p_watch.add_argument(
         "--stale-after", type=float, default=10.0,

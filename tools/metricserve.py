@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metricserve CLI — run and drive the always-on eval-service daemon.
+
+Usage::
+
+    # the daemon (imports jax; one per host/rank)
+    python tools/metricserve.py serve --base-dir /tmp/metricserve
+
+    # the jax-free client mode (supervisors, CI, your laptop)
+    python tools/metricserve.py ctl --http 127.0.0.1:8799 status --json
+    python tools/metricserve.py ctl --http ... create --name m1-val \\
+        --target torchmetrics_tpu.serve.factories:accuracy \\
+        --kwargs '{"num_classes": 10}'
+    python tools/metricserve.py ctl --http ... ingest m1-val --seq 0 \\
+        --batch '[[...preds...], [...target...]]'
+    cat batches.jsonl | python tools/metricserve.py ctl --socket \\
+        /tmp/metricserve/ingest.sock replay m1-val
+    python tools/metricserve.py ctl --http ... flush m1-val
+    python tools/metricserve.py ctl --http ... drain m1-val
+    python tools/metricserve.py ctl --http ... delete m1-val
+
+``serve`` starts a :class:`torchmetrics_tpu.serve.ServeDaemon` over
+``--base-dir``, restores every stream whose ``spec.json`` survives there
+(restart = resume from the snapshot cursor), prints ONE ready line of JSON
+(``{"ok": true, "http": [host, port], "socket": ..., "pid": ...}`` — parse
+it to discover the ephemeral port) and then blocks. SIGTERM/SIGINT trigger
+the graceful drain: stop admitting, apply every admitted batch, snapshot +
+final-compute every stream in sorted order, one last telemetry tick.
+
+``ctl`` is the client plane: it loads ONLY the wire-schema module by file
+path, so it never imports jax (or even torchmetrics_tpu) — safe on any
+supervisor host. ``replay`` streams newline-JSON batches from stdin over the
+unix socket, asking the daemon for the stream's ``next_seq`` first, so
+re-running the same replay after a crash sends exactly the unpersisted
+suffix (duplicates are acked, nothing double-counts).
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_wire():
+    """Import torchmetrics_tpu/serve/wire.py by PATH — the ctl plane must
+    never pay (or require) the jax import behind the package root."""
+    if "torchmetrics_tpu" in sys.modules:  # already paid (e.g. serve) — reuse
+        from torchmetrics_tpu.serve import wire
+
+        return wire
+    path = os.path.join(_REPO_ROOT, "torchmetrics_tpu", "serve", "wire.py")
+    spec = importlib.util.spec_from_file_location("metricserve_wire", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["metricserve_wire"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+# ------------------------------------------------------------------- serve
+
+
+def _cmd_serve(args) -> int:
+    sys.path.insert(0, _REPO_ROOT)
+    from torchmetrics_tpu.serve import ServeDaemon
+
+    socket_path = None
+    if not args.no_socket:
+        socket_path = args.socket or os.path.join(args.base_dir, "ingest.sock")
+    daemon = ServeDaemon(
+        args.base_dir,
+        http=f"{args.host}:{args.port}",
+        socket_path=socket_path,
+        publish=not args.no_publish,
+    ).start()
+    host, port = daemon.http_address()
+    ready = {"ok": True, "http": [host, port], "socket": socket_path, "pid": os.getpid()}
+    print(json.dumps(ready), flush=True)
+
+    stop = threading.Event()
+
+    def _graceful(signum, frame) -> None:
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    stop.wait()
+    results = daemon.shutdown(drain=True)
+    print(json.dumps({"ok": True, "drained": sorted(results)}), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------- ctl
+
+
+class _Client:
+    """Thin wire client: HTTP control verbs, socket frames for ingest."""
+
+    def __init__(self, wire, http=None, socket_path=None):
+        if http is None and socket_path is None:
+            raise SystemExit("ctl needs --http host:port and/or --socket path")
+        self.wire = wire
+        self.http = http
+        self.socket_path = socket_path
+        self._conn = None
+
+    # HTTP -----------------------------------------------------------------
+    def request(self, method: str, path: str, body=None):
+        import urllib.error
+        import urllib.request
+
+        if self.http is None:
+            return self.frame({"op": path})  # unreachable for current verbs
+        data = None
+        if body is not None:
+            data = json.dumps({"v": self.wire.WIRE_VERSION, **body}).encode()
+        req = urllib.request.Request(f"http://{self.http}{path}", data=data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return json.loads(err.read())
+
+    # socket ---------------------------------------------------------------
+    def frame(self, obj):
+        if self._conn is None:
+            self._conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._conn.connect(self.socket_path)
+            self._file = self._conn.makefile("rwb")
+        self._file.write(self.wire.encode_frame({"v": self.wire.WIRE_VERSION, **obj}))
+        self._file.flush()
+        line = self._file.readline()
+        if not line:
+            raise SystemExit("daemon closed the ingest socket")
+        return self.wire.decode_frame(line)
+
+    def op(self, obj):
+        """Control verb over whichever plane is configured (HTTP preferred)."""
+        if self.http is not None:
+            verb = obj["op"]
+            name = obj.get("stream")
+            if verb == "status":
+                return self.request("GET", f"/v1/streams/{name}" if name else "/v1/streams")
+            if verb == "create":
+                return self.request("POST", "/v1/streams", obj["spec"])
+            if verb == "delete":
+                return self.request("DELETE", f"/v1/streams/{name}")
+            if verb == "ingest":
+                return self.request(
+                    "POST", f"/v1/streams/{name}/ingest", {"seq": obj["seq"], "batch": obj["batch"]}
+                )
+            return self.request("POST", f"/v1/streams/{name}/{verb}")
+        return self.frame(obj)
+
+
+def _emit(reply, as_json: bool) -> int:
+    if as_json:
+        print(json.dumps(reply))
+    elif reply.get("ok"):
+        fields = {k: v for k, v in reply.items() if k not in ("v", "ok")}
+        print(json.dumps(fields) if fields else "ok")
+    else:
+        err = reply.get("error", {})
+        print(f"error [{err.get('code')}]: {err.get('message')}", file=sys.stderr)
+    return 0 if reply.get("ok") else 1
+
+
+def _cmd_ctl(args) -> int:
+    wire = _load_wire()
+    client = _Client(wire, http=args.http, socket_path=args.socket)
+    if args.verb == "status":
+        reply = client.op({"op": "status", "stream": args.stream})
+        return _emit(reply, args.json)
+    if args.verb == "create":
+        spec = json.loads(args.spec) if args.spec else {}
+        if args.name:
+            spec["name"] = args.name
+        if args.target:
+            spec["target"] = args.target
+        if args.kwargs:
+            spec["kwargs"] = json.loads(args.kwargs)
+        if args.fused:
+            spec["fused"] = True
+        if args.window:
+            spec["window"] = json.loads(args.window)
+        if args.snapshot_every_n is not None:
+            spec["snapshot_every_n"] = args.snapshot_every_n
+        return _emit(client.op({"op": "create", "spec": spec}), args.json)
+    if args.verb == "ingest":
+        batch = json.loads(args.batch)
+        reply = client.op({"op": "ingest", "stream": args.stream, "seq": args.seq, "batch": batch})
+        return _emit(reply, args.json)
+    if args.verb == "replay":
+        return _cmd_replay(client, args)
+    if args.verb in ("flush", "drain", "delete"):
+        return _emit(client.op({"op": args.verb, "stream": args.stream}), args.json)
+    raise SystemExit(f"unknown ctl verb {args.verb!r}")
+
+
+def _cmd_replay(client, args) -> int:
+    """Stream stdin's newline-JSON batches from the daemon's ``next_seq``:
+    line k of the input is ALWAYS seq k, so replaying the same file after a
+    crash skips (as duplicates) everything already persisted."""
+    status = client.op({"op": "status", "stream": args.stream})
+    if not status.get("ok"):
+        return _emit(status, args.json)
+    next_seq = int(status["next_seq"])
+    sent = acked = 0
+    for k, line in enumerate(sys.stdin):
+        line = line.strip()
+        if not line:
+            continue
+        if k < next_seq:
+            continue  # already persisted server-side — skip without a round-trip
+        reply = client.op({"op": "ingest", "stream": args.stream, "seq": k, "batch": json.loads(line)})
+        sent += 1
+        while not reply.get("ok") and reply.get("error", {}).get("code") == "backpressure":
+            import time
+
+            time.sleep(float(reply["error"].get("retry_after_s", 0.05)))
+            reply = client.op({"op": "ingest", "stream": args.stream, "seq": k, "batch": json.loads(line)})
+        if not reply.get("ok"):
+            return _emit(reply, args.json)
+        acked += 1
+    print(json.dumps({"ok": True, "stream": args.stream, "skipped": next_seq, "sent": sent, "acked": acked}))
+    return 0
+
+
+# -------------------------------------------------------------------- main
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="metricserve", description=__doc__.split("\n\n")[0])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="run the eval-service daemon (imports jax)")
+    serve.add_argument("--base-dir", required=True, help="durable root for streams/stores/status")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=0, help="control-plane port (0 = ephemeral)")
+    serve.add_argument("--socket", default=None, help="ingest socket path (default <base-dir>/ingest.sock)")
+    serve.add_argument("--no-socket", action="store_true", help="disable the unix-socket ingest plane")
+    serve.add_argument("--no-publish", action="store_true", help="do not start the live status-file plane")
+    serve.set_defaults(fn=_cmd_serve)
+
+    ctl = sub.add_parser("ctl", help="jax-free client: drive a running daemon")
+    ctl.add_argument("--http", default=None, help="control plane address host:port")
+    ctl.add_argument("--socket", default=None, help="ingest socket path")
+    ctl_sub = ctl.add_subparsers(dest="verb", required=True)
+
+    st = ctl_sub.add_parser("status", help="daemon or per-stream status")
+    st.add_argument("stream", nargs="?", default=None)
+
+    cr = ctl_sub.add_parser("create", help="create a stream")
+    cr.add_argument("--spec", default=None, help="full StreamSpec JSON (flags below override)")
+    cr.add_argument("--name")
+    cr.add_argument("--target", help="factory path module:callable")
+    cr.add_argument("--kwargs", help="factory kwargs JSON")
+    cr.add_argument("--fused", action="store_true")
+    cr.add_argument("--window", help="WindowRing kwargs JSON, e.g. '{\"slots\":4,\"every_n\":8}'")
+    cr.add_argument("--snapshot-every-n", type=int, default=None)
+
+    ing = ctl_sub.add_parser("ingest", help="send one batch")
+    ing.add_argument("stream")
+    ing.add_argument("--seq", type=int, required=True)
+    ing.add_argument("--batch", required=True, help="JSON list, one entry per update argument")
+
+    rp = ctl_sub.add_parser("replay", help="stream stdin JSONL batches from the daemon's next_seq")
+    rp.add_argument("stream")
+
+    for verb in ("flush", "drain", "delete"):
+        v = ctl_sub.add_parser(verb)
+        v.add_argument("stream")
+
+    for verb_parser in (st, cr, ing, rp, *(ctl_sub.choices[v] for v in ("flush", "drain", "delete"))):
+        verb_parser.add_argument("--json", action="store_true", help="print raw wire envelopes")
+
+    ctl.set_defaults(fn=_cmd_ctl)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,6 +57,15 @@ name                                incremented when
 ``obs.costs.emit_errors``           a configured ``costs.json`` emission failed
                                     (I/O error; attribution never raises into
                                     the evaluation it observes)
+``serve.dropped_batches``           a metricserve stream acked batches it will
+                                    never apply (worker death or ``delete``
+                                    latched them) — admission control delays
+                                    instead of dropping, so the
+                                    ``serve_sustained_streams`` bench leg holds
+                                    this at zero
+``serve.costs_errors``              a per-stream drain-time ``costs.json``
+                                    emission failed (I/O; a drain never fails
+                                    over its own attribution)
 ==================================  ==============================================
 
 Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the

@@ -130,12 +130,19 @@ def sample_probes() -> Dict[str, float]:
 #: reported healthier than "degraded" just because a long-but-fine step dips
 #: into the stalling window: /healthz must not flap 503 -> 200 -> 503
 _SEVERITY = {"ok": 0, "stalling": 1, "degraded": 2, "stalled": 3}
+_SEVERITY_NAME = {code: name for name, code in _SEVERITY.items()}
+
+_SERVE_HEALTH_RE = re.compile(r"^serve\.(?P<stream>[^.]+)\.health_state$")
 
 
 def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[str, Any]:
     """Liveness state from a counter/gauge snapshot (see the module table).
 
     Severity-monotone: ``stalled`` > ``degraded`` > ``stalling`` > ``ok``.
+    When ``metricserve`` streams publish ``serve.<stream>.health_state``
+    gauges (0 ok … 3 stalled), the process health is additionally floored at
+    the WORST stream's state — a daemon is only as healthy as its sickest
+    stream.
     """
     margin = gauges.get("runner.watchdog.margin_s")
     timeout = gauges.get("runner.watchdog.timeout_s")
@@ -162,7 +169,35 @@ def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[st
         )
     if stalls:
         escalate("stalled", f"watchdog raised StallError {stalls} time(s)")
+    for name, value in gauges.items():
+        match = _SERVE_HEALTH_RE.match(name)
+        if match is None:
+            continue
+        code = max(0, min(int(value), 3))
+        if code:
+            escalate(
+                _SEVERITY_NAME[code],
+                f"stream {match.group('stream')} is {_SEVERITY_NAME[code]}",
+            )
     return {"state": state, "reason": reason, "http_status": HEALTH_HTTP_STATUS[state]}
+
+
+def group_stream_gauges(gauges: Dict[str, float]) -> Dict[str, Dict[str, Any]]:
+    """Group ``serve.<stream>.<field>`` gauges into ``{stream: {field: v}}``.
+
+    Daemon-global serve gauges (``serve.streams``, ``serve.dropped_batches``
+    — no field component) are left out; stream names never contain dots
+    (the daemon enforces that at create time).
+    """
+    streams: Dict[str, Dict[str, Any]] = {}
+    for name, value in gauges.items():
+        if not name.startswith("serve."):
+            continue
+        rest = name[len("serve."):]
+        stream, dot, field = rest.partition(".")
+        if dot and stream and field:
+            streams.setdefault(stream, {})[field] = value
+    return streams
 
 
 # ------------------------------------------------------- file-sink plumbing
@@ -285,7 +320,10 @@ class TelemetryPublisher:
 
     def health(self) -> Dict[str, Any]:
         """Fresh liveness derivation (probes sampled now), plus the runner's
-        cursor when a runner probe is live — the ``/healthz`` body."""
+        cursor when a runner probe is live — the ``/healthz`` body. When a
+        ``metricserve`` daemon publishes ``serve.<stream>.*`` gauges, the body
+        carries a ``streams`` section with the per-stream detail behind the
+        worst-stream summary state."""
         snap = _counters.snapshot()
         gauges = {**snap["gauges"], **sample_probes()}
         health = derive_health(snap["counters"], gauges)
@@ -293,6 +331,14 @@ class TelemetryPublisher:
         health["seq"] = self.seq
         if "runner.cursor" in gauges:
             health["cursor"] = int(gauges["runner.cursor"])
+        streams = group_stream_gauges(gauges)
+        if streams:
+            for detail in streams.values():
+                code = max(0, min(int(detail.get("health_state", 0)), 3))
+                # "health" is the severity NAME; "state" stays the numeric
+                # lifecycle gauge (serve.stream.STATE_CODES)
+                detail["health"] = _SEVERITY_NAME[code]
+            health["streams"] = streams
         return health
 
     def render_metrics(self) -> str:
@@ -316,9 +362,16 @@ class TelemetryPublisher:
         return _openmetrics.render(counters, gauges, labels={"rank": str(self.rank)}, gauge_epoch_s=gauge_epoch_s)
 
     # ------------------------------------------------------------ lifecycle
-    def tick(self) -> Dict[str, Any]:
-        """Publish one status snapshot now (the loop calls this per cadence)."""
+    def tick(self, final: bool = False) -> Dict[str, Any]:
+        """Publish one status snapshot now (the loop calls this per cadence).
+
+        ``final=True`` marks the payload — the drain-final tick :meth:`stop`
+        publishes after the thread exits — so consumers of the post-stop
+        ``status.rank<k>.json`` can tell "the run ended here" from "the
+        publisher just has not ticked yet"."""
         payload = self.status()
+        if final:
+            payload["final"] = True
         self.seq += 1
         if self.directory is not None:
             data = json.dumps(payload, separators=(",", ":")).encode()
@@ -346,14 +399,16 @@ class TelemetryPublisher:
         return self
 
     def stop(self) -> None:
-        """Stop the thread (one final flush tick so the status file carries
-        the end-of-run state) and shut the HTTP server down."""
+        """Stop the thread (one final flush tick — published AFTER the loop
+        thread has joined, so the on-disk status file reflects the drain-final
+        counters/cursor/health, marked ``"final": true``) and shut the HTTP
+        server down."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
         try:
-            self.tick()
+            self.tick(final=True)
         except Exception:
             self.publish_errors += 1
         if self._server is not None:
@@ -365,9 +420,11 @@ class TelemetryPublisher:
             self._server_thread = None
 
     # ----------------------------------------------------------------- http
-    @property
     def http_address(self) -> Optional[Tuple[str, int]]:
-        """``(host, port)`` actually bound (port 0 resolves here), or None."""
+        """``(host, port)`` actually bound — port 0 (ephemeral) resolves to
+        the real port here, so concurrent publishers/daemons can each bind
+        ``http=":0"`` and discover where they landed — or ``None`` while no
+        HTTP sink is up."""
         if self._server is None:
             return None
         return self._server.server_address[:2]
@@ -609,4 +666,60 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
     lines.append(f"{len(statuses)} rank(s): {summary}" + (f"; {n_stale} STALE (> {stale_after_s:.1f}s behind)" if n_stale else ""))
     if ref_epoch_ns is not None:
         lines.append(f"fleet last published {max(0.0, (time.time_ns() - ref_epoch_ns) / 1e9):.1f}s ago")
+    return "\n".join(lines)
+
+
+def format_watch_json(statuses: List[Dict[str, Any]], stale_after_s: float = 10.0) -> str:
+    """The ``metricscope watch --json`` output: one compact JSON object per
+    line — a ``{"kind": "rank", ...}`` row per status file, followed by a
+    ``{"kind": "stream", ...}`` row per ``serve.<stream>.*`` gauge family the
+    rank publishes — so supervisors and ``metricserve ctl status`` consume
+    fleet state line-by-line instead of scraping the human table. Staleness
+    is the same fleet-relative ``epoch_ns`` comparison as the table."""
+    anchored = [s for s in statuses if isinstance(s.get("epoch_ns"), int)]
+    ref_epoch_ns = max(s["epoch_ns"] for s in anchored) if anchored else None
+    lines: List[str] = []
+    for status in statuses:
+        rank = status.get("rank")
+        if "_problem" in status:
+            lines.append(json.dumps(
+                {"kind": "rank", "rank": rank, "state": "unreadable", "problem": status["_problem"]},
+                separators=(",", ":"),
+            ))
+            continue
+        counters = status.get("counters", {})
+        gauges = status.get("gauges", {})
+        behind_s = None
+        if ref_epoch_ns is not None and isinstance(status.get("epoch_ns"), int):
+            behind_s = (ref_epoch_ns - status["epoch_ns"]) / 1e9
+        row: Dict[str, Any] = {
+            "kind": "rank",
+            "rank": rank,
+            "seq": status.get("seq"),
+            "state": status.get("health", {}).get("state"),
+            "reason": status.get("health", {}).get("reason"),
+            "final": bool(status.get("final", False)),
+            "batches": counters.get("runner.progress.batches"),
+            "samples": counters.get("runner.progress.samples"),
+            "samples_per_s": gauges.get("runner.throughput.samples_per_s"),
+            "cursor": gauges.get("runner.cursor"),
+            "snapshot_age_s": gauges.get("runner.snapshot.age_s"),
+            "snapshot_bytes": gauges.get("runner.snapshot.bytes_last"),
+            "watchdog_margin_s": gauges.get("runner.watchdog.margin_s"),
+            "behind_s": behind_s,
+            "stale": bool(behind_s is not None and behind_s > stale_after_s),
+        }
+        lines.append(json.dumps(row, separators=(",", ":")))
+        for stream, detail in sorted(group_stream_gauges(gauges).items()):
+            code = max(0, min(int(detail.get("health_state", 0)), 3))
+            stream_row: Dict[str, Any] = {
+                "kind": "stream",
+                "rank": rank,
+                "stream": stream,
+                # severity NAME under "health"; detail's "state" stays the
+                # numeric lifecycle gauge (serve.stream.STATE_CODES)
+                "health": _SEVERITY_NAME[code],
+            }
+            stream_row.update(sorted(detail.items()))
+            lines.append(json.dumps(stream_row, separators=(",", ":")))
     return "\n".join(lines)

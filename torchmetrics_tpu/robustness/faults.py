@@ -41,6 +41,16 @@ point                      kinds                  fires
                                                   staged — the captured error must propagate to the
                                                   consumer's next ``get()``, never stall the drive
                                                   loop until the watchdog
+``serve.accept``           fail, delay            in ``ServeDaemon.create_stream`` before the spec
+                                                  is admitted — a rejected create must leave no
+                                                  stream directory behind
+``serve.ingest``           fail, delay            in ``Stream.offer`` after decode, before the
+                                                  batch is admitted to the queue — a failed
+                                                  admission must NOT advance ``next_seq`` (the
+                                                  client retries the same seq)
+``serve.drain``            fail, delay, preempt   at the top of ``Stream.drain`` — a daemon killed
+                                                  mid-drain must restart from the last snapshot
+                                                  with no double count
 =========================  =====================  ==================================
 
 Faults are scoped with the :func:`inject` context manager (in-process tests)

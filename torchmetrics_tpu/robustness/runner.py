@@ -107,10 +107,21 @@ class StreamingEvaluator:
             with ``fused=True``: a rotation resets the metric mid-stream,
             which the fused plane's donated carry cannot observe.
 
+    ``metric`` may also be a
+    :class:`~torchmetrics_tpu.parallel.sliced.SlicedPlan`: the evaluator then
+    drives ``plan.update(*batch)`` per batch (batches are ``(keys, *arrays)``
+    tuples), snapshots the plan's whole carry (slice table included) through
+    the store under ``kind="sliced"``, and the final result is
+    ``plan.compute_all()``. Mutually exclusive with ``fused``/``update_fn``/
+    ``window_ring`` — the plan owns its own dispatch and state layout.
+
     One evaluator instance drives one pass: :meth:`run` starts from batch 0
     (and demands a fresh store), :meth:`resume` restores the newest valid
     snapshot — or starts from 0 on an empty store, so supervisors can always
-    call ``resume()``.
+    call ``resume()``. A long-lived service instead pumps the open-loop form
+    (:meth:`serve_open` / :meth:`serve_step` / :meth:`serve_close`), where the
+    FEED positions itself at the restored cursor rather than replaying the
+    stream past it — the ``metricserve`` daemon's drive protocol.
     """
 
     def __init__(
@@ -138,6 +149,16 @@ class StreamingEvaluator:
             raise ValueError(f"store must be a CheckpointStore, got {type(store).__name__}")
         if fused and update_fn is not None:
             raise ValueError("fused=True drives the FusedCollectionPlan itself; it cannot combine with update_fn")
+        self._is_plan = False
+        if type(metric).__name__ == "SlicedPlan":  # cheap gate before the parallel import
+            from torchmetrics_tpu.parallel.sliced import SlicedPlan
+
+            self._is_plan = isinstance(metric, SlicedPlan)
+        if self._is_plan and (fused or update_fn is not None or window_ring is not None):
+            raise ValueError(
+                "a SlicedPlan target owns its own dispatch and state layout; it cannot"
+                " combine with fused/update_fn/window_ring"
+            )
         if window_ring is not None:
             from torchmetrics_tpu.parallel.windowing import WindowRing
 
@@ -166,6 +187,10 @@ class StreamingEvaluator:
         self.cursor = 0
         self._last_snapshot_t: Optional[float] = None
         self._last_good_payload: Optional[Dict[str, Any]] = None
+        # per-drive loop state, installed by _begin_drive (also the open-loop
+        # serve_open): the hoisted apply callable and the stall-policy flag
+        self._apply_batch: Optional[Callable[[Any], None]] = None
+        self._snapshotting_stalls = False
         # live-plane producer state (obs/live.py): deadline of the in-flight
         # bounded step (the watchdog-margin probe reads it while the step
         # runs — a stalled update shows a shrinking margin in real time),
@@ -185,16 +210,25 @@ class StreamingEvaluator:
 
     # ----------------------------------------------------------- checkpoints
     def _is_collection(self) -> bool:
+        if self._is_plan:
+            return False
         from torchmetrics_tpu.collections import MetricCollection
 
         return isinstance(self.metric, MetricCollection)
 
+    def _kind(self) -> str:
+        if self._is_plan:
+            return "sliced"
+        return "collection" if self._is_collection() else "metric"
+
     def _fingerprint(self) -> str:
         """PR-2 registry fingerprint of the wrapped target: the metric's deep
-        checkpoint fingerprint, or a digest over every member's for a
-        collection."""
+        checkpoint fingerprint, a digest over every member's for a collection,
+        or the plan's stable fingerprint for a ``SlicedPlan`` target."""
         from torchmetrics_tpu.robustness.checkpoint import checkpoint_fingerprint
 
+        if self._is_plan:
+            return self.metric.stable_fingerprint()
         if self._is_collection():
             import hashlib
             import json
@@ -207,6 +241,8 @@ class StreamingEvaluator:
         return checkpoint_fingerprint(self.metric)
 
     def _checkpoint(self) -> Dict[str, Any]:
+        if self._is_plan:
+            return self.metric.save_checkpoint()  # the whole carry, table included
         if self._is_collection():
             # copy_state=True materializes per-member states out of compute-
             # group aliasing, so each member checkpoints its own (equal) state
@@ -214,6 +250,9 @@ class StreamingEvaluator:
         return self.metric.save_checkpoint()
 
     def _restore_checkpoint(self, checkpoint: Dict[str, Any]) -> None:
+        if self._is_plan:
+            self.metric.load_checkpoint(checkpoint)  # validate-ALL-then-apply (PR 10)
+            return
         if not self._is_collection():
             self.metric.load_checkpoint(checkpoint)  # validate-ALL-then-apply (PR 2)
             return
@@ -259,7 +298,7 @@ class StreamingEvaluator:
         payload = {
             "payload_version": RUNNER_PAYLOAD_VERSION,
             "cursor": self.cursor,
-            "kind": "collection" if self._is_collection() else "metric",
+            "kind": self._kind(),
             "checkpoint": self._checkpoint(),
         }
         if self.window_ring is not None:
@@ -286,7 +325,7 @@ class StreamingEvaluator:
         cursor = payload["cursor"]
         if not isinstance(cursor, int) or cursor < 0:
             raise StateRestoreError(f"runner snapshot cursor {cursor!r} is not a non-negative int")
-        kind = "collection" if self._is_collection() else "metric"
+        kind = self._kind()
         if payload.get("kind") != kind:
             raise StateRestoreError(
                 f"runner snapshot was written for a {payload.get('kind')!r} target, this"
@@ -347,6 +386,9 @@ class StreamingEvaluator:
         the cost-ledger registry) at a snapshot boundary, so the live plane
         shows the state-memory footprint next to throughput. Callers guard
         with the trace/live flags."""
+        if self._is_plan:
+            self.metric.publish_gauges()  # slice.table.* + the plan's state-bytes row
+            return
         if self._is_collection():
             for name, member in self.metric.items(keep_base=True, copy_state=False):
                 _obs_attr.note_instance(type(member).__name__, name)
@@ -526,23 +568,31 @@ class StreamingEvaluator:
             _obs_counters.set_gauge("runner.throughput.samples_per_s", self._ewma_sps)
         self._last_batch_t = now
 
+    def _register_probes(self, force: bool = False) -> None:
+        """Per-instance probe names: two evaluators driving concurrently in
+        one process must not clobber (or, on finish, unregister) each
+        other's live telemetry. ``force`` registers even with the live plane
+        off — the serve daemon answers ``/healthz``/``/metrics`` itself, so
+        its streams' watchdog margins must be probe-visible regardless."""
+        if not (force or _obs_live.ENABLED):
+            return
+        _obs_live.register_probe(f"runner-{id(self)}", self._live_probe)
+        if self.window_ring is not None:
+            _obs_live.register_probe(f"window-{id(self)}", self.window_ring.probe)
+        if self._is_plan:
+            _obs_live.register_probe(f"sliced-{id(self)}", self.metric.live_probe)
+
+    def _unregister_probes(self) -> None:
+        for prefix in ("runner", "window", "sliced"):
+            _obs_live.unregister_probe(f"{prefix}-{id(self)}")
+
     def _drive(self, batches: Iterable[Any], skip: int) -> Any:
         if _obs_live.ENABLED:
-            # per-instance probe name: two evaluators driving concurrently in
-            # one process must not clobber (or, on finish, unregister) each
-            # other's live telemetry
-            probe_name = f"runner-{id(self)}"
-            _obs_live.register_probe(probe_name, self._live_probe)
-            ring_probe = None
-            if self.window_ring is not None:
-                ring_probe = f"window-{id(self)}"
-                _obs_live.register_probe(ring_probe, self.window_ring.probe)
+            self._register_probes()
             try:
                 return self._drive_impl(batches, skip)
             finally:
-                _obs_live.unregister_probe(probe_name)
-                if ring_probe is not None:
-                    _obs_live.unregister_probe(ring_probe)
+                self._unregister_probes()
         return self._drive_impl(batches, skip)
 
     def _make_apply(self) -> Callable[[Any], None]:
@@ -552,6 +602,9 @@ class StreamingEvaluator:
         plane exists to eliminate. Fused drives build the plan lazily at the
         first batch, so ``resume()`` restores state first and the plan's
         carry seeds from the restored members."""
+        if self._is_plan:
+            plan = self.metric
+            return lambda batch: plan.update(*batch) if isinstance(batch, tuple) else plan.update(batch)
         if not self.fused:
             update_fn, metric = self.update_fn, self.metric
             return lambda batch: update_fn(metric, batch)
@@ -575,11 +628,58 @@ class StreamingEvaluator:
         self._fused_plan = FusedCollectionPlan(self.metric, **options)
         return self._fused_plan
 
-    def _drive_impl(self, batches: Iterable[Any], skip: int) -> Any:
-        self.cursor = skip
+    def _begin_drive(self, start: int) -> None:
+        self.cursor = start
         self._last_snapshot_t = time.monotonic()
         self._fused_plan = None  # one plan per drive, built at the first batch
-        snapshotting_stalls = self.on_stall == "snapshot_then_raise" and self.watchdog_timeout_s
+        self._snapshotting_stalls = bool(
+            self.on_stall == "snapshot_then_raise" and self.watchdog_timeout_s
+        )
+        self._apply_batch = self._make_apply()
+
+    def _step_impl(self, batch: Any) -> None:
+        if self._snapshotting_stalls:
+            # the stall snapshot must pre-date the (possibly half-applied)
+            # stalled update; capture costs one host round-trip per batch
+            # (plus a fused fold-back) and is only paid when the policy
+            # asks for it
+            self._last_good_payload = self._payload()
+        self._bounded(self._apply_batch, "update", batch)
+        self.cursor += 1
+        if _obs_live.ENABLED or _obs_trace.ENABLED:
+            self._record_progress(batch)
+        if self.window_ring is not None:
+            # rotation happens AFTER the batch fully applied and BEFORE
+            # its snapshot, so every snapshot's ring is cursor-consistent
+            self.window_ring.observe(self.cursor)
+        if faults._ACTIVE:  # preemption drill: die after batch k, before its snapshot
+            faults.fire("runner.preempt")
+        self._maybe_snapshot()
+
+    def _finish_drive(self) -> Any:
+        if self._fused_plan is not None:
+            # the drive is over: fold the carried totals into the members so
+            # the final snapshot AND compute() see them (non-writer ranks
+            # never reach _payload, so this fold cannot ride it)
+            self._fused_plan.fold_back()
+        # final snapshot so a completed pass is restorable/auditable ...
+        self.snapshot()
+        if self._snapshotting_stalls:
+            self._last_good_payload = self._payload()
+        # ... then compute (which may sync across the process group) under the
+        # same watchdog deadline
+        compute = self.metric.compute_all if self._is_plan else self.metric.compute
+        result = self._bounded(compute, "compute")
+        if _obs_trace.ENABLED:
+            # the evaluation is over: every plane (spans, xla records, state
+            # bytes, sync bytes) is final — emit the cost ledger. compute()
+            # already emitted for Metric/MetricCollection targets; this
+            # covers custom update_fn targets too, newest write wins.
+            _obs_attr.maybe_emit()
+        return result
+
+    def _drive_impl(self, batches: Iterable[Any], skip: int) -> Any:
+        self._begin_drive(skip)
         stream = iter(batches)
         skipped = 0
         while skipped < skip:
@@ -592,41 +692,46 @@ class StreamingEvaluator:
                     " interrupted run consumed"
                 ) from None
             skipped += 1
-        apply_batch = self._make_apply()
         for batch in stream:
-            if snapshotting_stalls:
-                # the stall snapshot must pre-date the (possibly half-applied)
-                # stalled update; capture costs one host round-trip per batch
-                # (plus a fused fold-back) and is only paid when the policy
-                # asks for it
-                self._last_good_payload = self._payload()
-            self._bounded(apply_batch, "update", batch)
-            self.cursor += 1
-            if _obs_live.ENABLED or _obs_trace.ENABLED:
-                self._record_progress(batch)
-            if self.window_ring is not None:
-                # rotation happens AFTER the batch fully applied and BEFORE
-                # its snapshot, so every snapshot's ring is cursor-consistent
-                self.window_ring.observe(self.cursor)
-            if faults._ACTIVE:  # preemption drill: die after batch k, before its snapshot
-                faults.fire("runner.preempt")
-            self._maybe_snapshot()
-        if self._fused_plan is not None:
-            # the drive is over: fold the carried totals into the members so
-            # the final snapshot AND compute() see them (non-writer ranks
-            # never reach _payload, so this fold cannot ride it)
-            self._fused_plan.fold_back()
-        # final snapshot so a completed pass is restorable/auditable ...
-        self.snapshot()
-        if snapshotting_stalls:
-            self._last_good_payload = self._payload()
-        # ... then compute (which may sync across the process group) under the
-        # same watchdog deadline
-        result = self._bounded(self.metric.compute, "compute")
-        if _obs_trace.ENABLED:
-            # the evaluation is over: every plane (spans, xla records, state
-            # bytes, sync bytes) is final — emit the cost ledger. compute()
-            # already emitted for Metric/MetricCollection targets; this
-            # covers custom update_fn targets too, newest write wins.
-            _obs_attr.maybe_emit()
-        return result
+            self._step_impl(batch)
+        return self._finish_drive()
+
+    # --------------------------------------------------------- open-loop serve
+    def serve_open(self) -> int:
+        """Open the evaluator for open-loop (service) driving; returns the
+        cursor to serve from.
+
+        Unlike :meth:`resume`, no fast-forward happens: the newest valid
+        snapshot (if any) is restored through the same validate-all-then-apply
+        ladder, and the CALLER — the ``metricserve`` ingest protocol —
+        positions its feed at the returned cursor. A fresh store opens at 0.
+        Pair every open with :meth:`serve_close`; batches arrive one at a
+        time through :meth:`serve_step`.
+        """
+        restored = self.store.latest(validate=self._validate_payload) if self.store is not None else None
+        start = 0
+        if restored is not None:
+            _step, payload = restored
+            # _validate_payload already installed the checkpoint
+            start = int(payload["cursor"])
+        if _obs_trace.ENABLED or _obs_live.ENABLED:
+            _obs_counters.inc("runner.resume")
+        self._begin_drive(start)
+        # forced: the serve daemon's /healthz reads these probes even when
+        # the live publisher is off
+        self._register_probes(force=True)
+        return start
+
+    def serve_step(self, batch: Any) -> None:
+        """Apply ONE batch under the drive invariants (watchdog, windows,
+        cadence snapshots, fault points) — the service's per-ingest step."""
+        self._step_impl(batch)
+
+    def serve_close(self) -> Any:
+        """Final snapshot + compute, then release the live probes. The
+        returned value is :meth:`~SlicedPlan.compute_all` for plan targets,
+        ``metric.compute()`` otherwise — same contract as :meth:`run`."""
+        try:
+            return self._finish_drive()
+        finally:
+            self._unregister_probes()

@@ -1,0 +1,23 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""``metricserve`` — the always-on eval-service plane.
+
+The library planes (fused PR 9, sliced + windowed PR 10, durability PR 5,
+live telemetry PR 7) compose here into a deployable daemon: many named
+durable streams behind one HTTP control plane and a unix-socket ingest
+plane. Run it with ``python tools/metricserve.py serve``; talk to it —
+without importing jax — with ``python tools/metricserve.py ctl``.
+"""
+from torchmetrics_tpu.serve.daemon import ServeDaemon
+from torchmetrics_tpu.serve.stream import Stream, StreamSpec, decode_batch, resolve_target
+from torchmetrics_tpu.serve.wire import WIRE_VERSION, WireError
+
+__all__ = [
+    "ServeDaemon",
+    "Stream",
+    "StreamSpec",
+    "WIRE_VERSION",
+    "WireError",
+    "decode_batch",
+    "resolve_target",
+]

@@ -1,0 +1,78 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Ready-made stream-target factories for ``metricserve``.
+
+A wire ``create`` names its metric target declaratively — a
+``module:callable`` path plus JSON kwargs (see
+:func:`~torchmetrics_tpu.serve.stream.resolve_target`) — because a daemon
+cannot receive live Python objects. These are the built-ins the docs, tests
+and bench use; deployments register their own factories the same way (any
+importable callable returning a ``Metric``, ``MetricCollection`` or
+``SlicedPlan`` works).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+__all__ = [
+    "accuracy",
+    "binary_accuracy",
+    "binary_average_precision",
+    "collection",
+    "quantile",
+    "sliced_accuracy",
+]
+
+
+def accuracy(num_classes: int = 4, average: str = "micro") -> Any:
+    """A plain ``MulticlassAccuracy`` — the simplest stream target."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    return MulticlassAccuracy(num_classes=num_classes, average=average, validate_args=False)
+
+
+def binary_accuracy(threshold: float = 0.5) -> Any:
+    """Elementwise (sum-state) binary accuracy — replica ``sync()`` folds it
+    across ranks at the drain compute."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    return BinaryAccuracy(threshold=threshold, validate_args=False)
+
+
+def binary_average_precision() -> Any:
+    """Cat (list-state) average precision — per-rank rows gather (pad/trim)
+    across ranks at the drain compute."""
+    from torchmetrics_tpu.classification import BinaryAveragePrecision
+
+    return BinaryAveragePrecision(validate_args=False)
+
+
+def quantile(q: float = 0.5, capacity: int = 256, levels: int = 14) -> Any:
+    """Bounded-memory KLL quantile — the ``dist_reduce_fx="merge"`` regime;
+    ranks pairwise-merge sketches at the drain compute."""
+    from torchmetrics_tpu import Quantile
+
+    return Quantile(q=q, capacity=capacity, levels=levels)
+
+
+def collection(num_classes: int = 4) -> Any:
+    """An accuracy + AUROC ``MetricCollection`` — pair with ``fused=True``
+    for the one-dispatch evaluation plane."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC
+    from torchmetrics_tpu.collections import MetricCollection
+
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=num_classes, validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=num_classes, validate_args=False),
+        }
+    )
+
+
+def sliced_accuracy(num_classes: int = 4, num_cells: int = 16, key_width: int = 1) -> Any:
+    """A per-cohort accuracy ``SlicedPlan``; wire batches lead with the
+    integer cohort-key column(s): ``[keys, preds, target]``."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=num_classes, validate_args=False)
+    return metric.sliced(num_cells=num_cells, key_width=key_width)

@@ -1,0 +1,500 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""One durable evaluation stream inside a ``metricserve`` daemon.
+
+A :class:`Stream` is the service-side unit the daemon multiplexes: one named
+(model-version × dataset) evaluation owning
+
+- a declarative :class:`StreamSpec` (factory import path + evaluator knobs,
+  the wire-facing description a ``create`` request carries),
+- its own :class:`~torchmetrics_tpu.robustness.store.CheckpointStore`
+  sub-directory (restart = resume from the snapshot cursor, never recount),
+- a bounded ingest queue (admission control — the **only** place a batch
+  waits) feeding ONE worker thread that pumps the evaluator's open-loop
+  serve API (:meth:`~torchmetrics_tpu.robustness.runner.StreamingEvaluator.
+  serve_step`), optionally through a
+  :class:`~torchmetrics_tpu.parallel.feed.DeviceFeed` so host decode overlaps
+  device work exactly like a batch run.
+
+**Exactly-once ingest.** Every batch carries a client sequence number. The
+stream acks ``seq == next_seq`` (advancing), re-acks ``seq < next_seq``
+(duplicate — idempotent replay), and rejects ``seq > next_seq`` with the
+expected value (gap — the client rewinds). After a crash ``next_seq``
+restarts at the restored snapshot cursor, so the client replays exactly the
+acked-but-unpersisted suffix and no sample is counted twice or dropped.
+
+**Control ops ride the batch queue.** flush/drain must serialize with the
+batches already admitted, so ops travel the same queue. With a DeviceFeed in
+front, an op enqueues a leafless ``()`` marker into the feed (an empty
+pytree — ``device_put`` stages nothing) and parks the op itself on a FIFO
+side-channel; the worker executes the op when the marker surfaces, which is
+exactly its queue position.
+
+**Dropped-batch accounting.** ``serve.dropped_batches`` counts batches the
+daemon ACKED but will never apply — the suffix abandoned when a stream fails
+or is deleted with work still queued. Graceful drain applies everything
+first, and a crash never acks, so the counter stays zero on every healthy
+path; the sustained-load bench latches on it.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from torchmetrics_tpu.obs import counters as _obs_counters
+from torchmetrics_tpu.robustness import faults
+from torchmetrics_tpu.robustness.store import CheckpointStore
+from torchmetrics_tpu.serve import wire
+
+__all__ = ["StreamSpec", "Stream", "decode_batch", "resolve_target"]
+
+#: ``()`` is the op marker: real batches are always NON-empty tuples (or a
+#: bare array), so an empty tuple is unambiguous — and leafless, so a
+#: DeviceFeed stages it as a no-op instead of choking on non-array leaves
+_OP_MARKER: Tuple[()] = ()
+
+_STATE_HEALTH = {
+    "starting": 0,
+    "serving": 0,
+    "draining": 0,
+    "drained": 0,
+    "failed": 3,
+}
+
+#: numeric state codes for the ``serve.<name>.state`` gauge (gauges are
+#: floats; scrapers map back through this table)
+STATE_CODES = {"starting": 0, "serving": 1, "draining": 2, "drained": 3, "failed": 4}
+
+
+def resolve_target(path: str, kwargs: Optional[Dict[str, Any]] = None) -> Any:
+    """Build a stream's metric target from a ``module:callable`` factory
+    path — the declarative form a wire ``create`` carries (a server cannot
+    receive live Python objects). The factory returns a ``Metric``,
+    ``MetricCollection`` or ``SlicedPlan``; see
+    :mod:`torchmetrics_tpu.serve.factories` for ready-made ones."""
+    module_name, sep, attr = path.partition(":")
+    if not sep or not module_name or not attr:
+        raise ValueError(f"target must be 'module:callable', got {path!r}")
+    import importlib
+
+    factory = importlib.import_module(module_name)
+    for part in attr.split("."):
+        factory = getattr(factory, part)
+    return factory(**(kwargs or {}))
+
+
+def decode_batch(batch: Any) -> Tuple[Any, ...]:
+    """Wire batch (list of nested number lists, one per positional update
+    argument) → tuple of arrays. One decode path for the daemon AND for
+    parity tests replaying the same stream in-process, so a resumed service
+    run compares bitwise against an uninterrupted one."""
+    import numpy as np
+
+    if not isinstance(batch, (list, tuple)) or not batch:
+        raise wire.WireError("batch must be a non-empty JSON list (one entry per update argument)")
+    return tuple(np.asarray(part) for part in batch)
+
+
+class StreamSpec:
+    """Declarative stream description — what a wire ``create`` carries.
+
+    Args:
+        name: registry key; one path component (no ``/``, no ``.`` — it names
+            a store sub-directory and a ``serve.<name>.*`` gauge family).
+        target: ``module:callable`` factory path for the metric target.
+        kwargs: keyword arguments for the factory.
+        fused: drive a ``MetricCollection`` target through the fused plane.
+        fused_options: fused-plan build kwargs (``cat_capacity`` etc.; a
+            fused collection with cat-state members NEEDS ``cat_capacity``
+            so its carries get fixed-capacity buffers).
+        window: ``WindowRing`` knobs (``slots`` + ``every_n``/``every_s``)
+            wrapped around the target, or ``None``.
+        snapshot_every_n / snapshot_every_s: evaluator snapshot cadence.
+        queue_max: ingest queue bound (admission control), default 64.
+        use_feed: stage batches through a ``DeviceFeed`` (default True).
+        watchdog_timeout_s / on_stall: evaluator watchdog policy.
+    """
+
+    _FIELDS = (
+        "name", "target", "kwargs", "fused", "fused_options", "window", "snapshot_every_n",
+        "snapshot_every_s", "queue_max", "use_feed", "watchdog_timeout_s", "on_stall",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        target: str,
+        kwargs: Optional[Dict[str, Any]] = None,
+        fused: bool = False,
+        fused_options: Optional[Dict[str, Any]] = None,
+        window: Optional[Dict[str, Any]] = None,
+        snapshot_every_n: Optional[int] = None,
+        snapshot_every_s: Optional[float] = None,
+        queue_max: int = 64,
+        use_feed: bool = True,
+        watchdog_timeout_s: Optional[float] = None,
+        on_stall: str = "raise",
+    ) -> None:
+        if not name or any(ch in name for ch in "/\\.") or name != name.strip():
+            raise ValueError(
+                f"stream name {name!r} must be one clean path component (it names a store"
+                " sub-directory and a serve.<name>.* gauge family — no '/', '\\\\' or '.')"
+            )
+        if queue_max < 1:
+            raise ValueError(f"queue_max must be >= 1, got {queue_max}")
+        self.name = name
+        self.target = target
+        self.kwargs = dict(kwargs or {})
+        self.fused = bool(fused)
+        self.fused_options = dict(fused_options) if fused_options else None
+        self.window = dict(window) if window else None
+        self.snapshot_every_n = snapshot_every_n
+        self.snapshot_every_s = snapshot_every_s
+        self.queue_max = int(queue_max)
+        self.use_feed = bool(use_feed)
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.on_stall = on_stall
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {field: getattr(self, field) for field in self._FIELDS}
+
+    @classmethod
+    def from_wire(cls, obj: Dict[str, Any]) -> "StreamSpec":
+        unknown = sorted(set(obj) - set(cls._FIELDS))
+        if unknown:
+            raise wire.WireError(f"unknown StreamSpec field(s): {', '.join(unknown)}")
+        if "name" not in obj or "target" not in obj:
+            raise wire.WireError("StreamSpec needs at least 'name' and 'target'")
+        return cls(**obj)
+
+    def build_evaluator(self, store_dir: str) -> Any:
+        """Materialize the evaluator this spec describes over ``store_dir``.
+
+        ``write_rank=None``: a daemon rank owns its whole base directory, so
+        EVERY rank persists (multi-host deployments give each rank its own
+        base dir and fold state through the merge-state sync at compute)."""
+        from torchmetrics_tpu.robustness.runner import StreamingEvaluator
+
+        metric = resolve_target(self.target, self.kwargs)
+        ring = None
+        if self.window is not None:
+            from torchmetrics_tpu.parallel.windowing import WindowRing
+
+            ring = WindowRing(metric, **self.window)
+        store = CheckpointStore(store_dir, keep_last=3, write_rank=None)
+        return StreamingEvaluator(
+            metric,
+            store=store,
+            snapshot_every_n=self.snapshot_every_n,
+            snapshot_every_s=self.snapshot_every_s,
+            fused=self.fused,
+            fused_options=self.fused_options,
+            window_ring=ring,
+            watchdog_timeout_s=self.watchdog_timeout_s,
+            on_stall=self.on_stall,
+        )
+
+
+class _Op:
+    """One control op riding the batch queue (see the module docstring)."""
+
+    __slots__ = ("name", "done", "result", "error")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+
+    def finish(self, result: Any = None, error: Optional[BaseException] = None) -> None:
+        self.result, self.error = result, error
+        self.done.set()
+
+
+class Stream:
+    """One running stream: spec + evaluator + bounded queue + worker thread."""
+
+    def __init__(self, spec: StreamSpec, store_dir: str) -> None:
+        self.spec = spec
+        self.store_dir = str(store_dir)
+        self.evaluator = spec.build_evaluator(self.store_dir)
+        self._queue: "queue.Queue[Tuple[str, Any]]" = queue.Queue(maxsize=spec.queue_max)
+        self._pending_ops: "deque[_Op]" = deque()
+        self._lock = threading.Lock()
+        self._ready = threading.Event()
+        self._finished = threading.Event()
+        self.state = "starting"
+        self.next_seq = 0  # acked watermark; meaningful once _ready is set
+        self.result: Optional[Any] = None
+        self.failure: Optional[str] = None
+        self.dropped = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"metricserve-{spec.name}"
+        )
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self, timeout_s: float = 60.0) -> int:
+        """Start the worker, wait for the durable open (snapshot restore) to
+        finish, and return the cursor batches resume from — the ``next_seq``
+        a client must replay from."""
+        self._thread.start()
+        if not self._ready.wait(timeout_s):
+            raise TimeoutError(f"stream {self.spec.name} did not open within {timeout_s}s")
+        with self._lock:
+            if self.state == "failed":
+                raise RuntimeError(f"stream {self.spec.name} failed to open: {self.failure}")
+            return self.next_seq
+
+    def _run(self) -> None:
+        try:
+            start = self.evaluator.serve_open()
+            with self._lock:
+                self.next_seq = start
+                self.state = "serving"
+            self._ready.set()
+            source = self._source()
+            if self.spec.use_feed:
+                from torchmetrics_tpu.parallel.feed import DeviceFeed
+
+                items: Any = DeviceFeed(source)
+            else:
+                items = source
+            for item in items:
+                if isinstance(item, tuple) and not item:
+                    self._exec_op(self._pending_ops.popleft())
+                else:
+                    self.evaluator.serve_step(item)
+            # the source ended: a drain (or abandon) op asked for the close
+            final_op = self._pending_ops.popleft()
+            if final_op.name == "abandon":
+                self.evaluator._unregister_probes()
+                final_op.finish()
+            else:
+                result = self.evaluator.serve_close()
+                with self._lock:
+                    self.result = wire.to_jsonable(result)
+                    self.state = "drained"
+                final_op.finish(result=self.result)
+        except BaseException as err:  # the worker must report, never vanish
+            self._fail(err)
+        finally:
+            self._ready.set()
+            self._finished.set()
+
+    def _source(self) -> Any:
+        """Queue → iterator the (optional) DeviceFeed stages. Ends at drain."""
+        while True:
+            kind, payload = self._queue.get()
+            if kind == "batch":
+                yield payload
+            elif payload.name in ("drain", "abandon"):
+                self._pending_ops.append(payload)
+                return
+            else:
+                self._pending_ops.append(payload)
+                yield _OP_MARKER
+
+    def _exec_op(self, op: _Op) -> None:
+        try:
+            if op.name == "flush":
+                step = self.evaluator.snapshot()
+                op.finish(result={"snapshot_step": step, "cursor": self.evaluator.cursor})
+            else:
+                raise ValueError(f"unknown stream op {op.name!r}")
+        except BaseException as err:
+            op.finish(error=err)
+            raise
+
+    def _fail(self, err: BaseException) -> None:
+        with self._lock:
+            if self.state in ("drained", "failed"):
+                return
+            self.state = "failed"
+            self.failure = f"{type(err).__name__}: {err}"
+            self._latch_dropped_locked()
+        # release every parked waiter with the cause
+        while self._pending_ops:
+            self._pending_ops.popleft().finish(error=err)
+        while True:
+            try:
+                kind, payload = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if kind == "op":
+                payload.finish(error=err)
+
+    def _latch_dropped_locked(self) -> None:
+        """Latch acked-but-never-applied batches into the dropped counter."""
+        pending = max(0, self.next_seq - self.evaluator.cursor)
+        if pending:
+            self.dropped += pending
+            _obs_counters.inc("serve.dropped_batches", pending)
+
+    # -------------------------------------------------------------- ingest
+    def offer(
+        self, seq: Any, batch: Any, *, block: bool = False, deadline_s: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Admit one wire batch under the seq protocol; returns a wire
+        envelope. ``block=False`` is the HTTP mode (full queue → an immediate
+        ``backpressure`` error the daemon maps to 429 + ``Retry-After``);
+        ``block=True`` is the socket mode (wait up to ``deadline_s`` for a
+        slot, then the same error)."""
+        if not self._ready.is_set():
+            if not self._ready.wait(deadline_s if block and deadline_s else 0.05):
+                return wire.error(
+                    "backpressure", f"stream {self.spec.name} is still opening", retry_after_s=0.1
+                )
+        if not isinstance(seq, int) or isinstance(seq, bool) or seq < 0:
+            return wire.error("bad_request", f"seq must be a non-negative int, got {seq!r}")
+        try:
+            decoded = decode_batch(batch)
+        except wire.WireError as err:
+            return wire.error("bad_request", str(err))
+        if faults._ACTIVE:
+            faults.fire("serve.ingest")
+        # seq check + enqueue + ack are ONE atomic step under the lock —
+        # two racing offers of the same seq must not both enqueue. The socket
+        # mode retries the non-blocking attempt until its deadline rather
+        # than blocking inside the lock (status/gauges stay responsive).
+        deadline = None if deadline_s is None else time.monotonic() + deadline_s
+        while True:
+            with self._lock:
+                if self.state == "failed":
+                    return wire.error("failed", f"stream {self.spec.name} failed: {self.failure}")
+                if self.state in ("draining", "drained"):
+                    return wire.error("draining", f"stream {self.spec.name} is {self.state}")
+                if seq < self.next_seq:
+                    # duplicate replay — ack idempotently, nothing re-applied
+                    return wire.ok(stream=self.spec.name, duplicate=True, next_seq=self.next_seq)
+                if seq > self.next_seq:
+                    return wire.error(
+                        "bad_seq",
+                        f"gap: got seq {seq}, expected {self.next_seq} — rewind the replay",
+                        expected=self.next_seq,
+                    )
+                try:
+                    self._queue.put_nowait(("batch", decoded))
+                except queue.Full:
+                    pass
+                else:
+                    self.next_seq += 1
+                    return wire.ok(stream=self.spec.name, next_seq=self.next_seq)
+            if not block or (deadline is not None and time.monotonic() >= deadline):
+                return wire.error(
+                    "backpressure",
+                    f"stream {self.spec.name} ingest queue is full ({self.spec.queue_max})",
+                    retry_after_s=0.05,
+                )
+            time.sleep(0.005)
+
+    # ------------------------------------------------------------- control
+    def _submit_op(self, name: str, timeout_s: float) -> _Op:
+        op = _Op(name)
+        with self._lock:
+            if self.state == "failed":
+                op.finish(error=RuntimeError(self.failure or "stream failed"))
+                return op
+            if self.state in ("draining", "drained") and name != "drain":
+                op.finish(error=RuntimeError(f"stream {self.spec.name} is {self.state}"))
+                return op
+            if name == "drain":
+                if self.state in ("draining", "drained"):
+                    op.finish(result=self.result)
+                    return op
+                self.state = "draining"
+        try:
+            self._queue.put(("op", op), timeout=timeout_s)
+        except queue.Full:
+            op.finish(error=RuntimeError(f"stream {self.spec.name} queue stayed full for {timeout_s}s"))
+        return op
+
+    def flush(self, timeout_s: float = 60.0) -> Dict[str, Any]:
+        """Snapshot now, AFTER everything already admitted has applied."""
+        op = self._submit_op("flush", timeout_s)
+        if not op.done.wait(timeout_s):
+            return wire.error("failed", f"flush of {self.spec.name} timed out after {timeout_s}s")
+        if op.error is not None:
+            return wire.error("failed", f"flush failed: {op.error}")
+        return wire.ok(stream=self.spec.name, **op.result)
+
+    def drain(self, timeout_s: float = 300.0) -> Dict[str, Any]:
+        """Apply every admitted batch, final snapshot + compute; returns the
+        results envelope. Idempotent — a second drain returns the same
+        results."""
+        if faults._ACTIVE:
+            faults.fire("serve.drain")
+        op = self._submit_op("drain", timeout_s)
+        if not op.done.wait(timeout_s):
+            return wire.error("failed", f"drain of {self.spec.name} timed out after {timeout_s}s")
+        if op.error is not None:
+            return wire.error("failed", f"drain failed: {op.error}")
+        return wire.ok(stream=self.spec.name, cursor=self.evaluator.cursor, results=op.result)
+
+    def abandon(self) -> int:
+        """Stop the stream WITHOUT computing (the delete path): unblocks the
+        worker, latches acked-but-unapplied batches as dropped, returns the
+        dropped count."""
+        with self._lock:
+            already = self.state in ("drained", "failed")
+            if not already:
+                self.state = "failed"
+                self.failure = "deleted"
+                self._latch_dropped_locked()
+        if not already:
+            # wake the worker: the abandon sentinel ends the source without a
+            # final compute; the state machine above already stopped offers
+            try:
+                self._queue.put(("op", _Op("abandon")), timeout=5.0)
+            except queue.Full:
+                pass
+        self._thread.join(timeout=10.0)
+        return self.dropped
+
+    # -------------------------------------------------------------- status
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            info: Dict[str, Any] = {
+                "name": self.spec.name,
+                "state": self.state,
+                "cursor": self.evaluator.cursor,
+                "next_seq": self.next_seq,
+                "pending": max(0, self.next_seq - self.evaluator.cursor),
+                "queue_depth": self._queue.qsize(),
+                "queue_max": self.spec.queue_max,
+                "dropped": self.dropped,
+                "kind": self.evaluator._kind(),
+            }
+            if self.failure is not None:
+                info["failure"] = self.failure
+            if self.result is not None:
+                info["results"] = self.result
+            return info
+
+    def health_code(self) -> int:
+        """0 ok … 3 stalled (the ``serve.<name>.health_state`` gauge): a
+        failed stream is stalled; a queue ≥ 90% full is stalling (admission
+        is about to push back). Watchdog-margin decay rides the evaluator's
+        own runner probe, not this code."""
+        with self._lock:
+            code = _STATE_HEALTH.get(self.state, 0)
+            if self.state == "serving" and self._queue.qsize() >= max(1, int(0.9 * self.spec.queue_max)):
+                code = max(code, 1)
+            return code
+
+    def gauges(self) -> Dict[str, float]:
+        """The ``serve.<name>.*`` gauge family (daemon probe fodder)."""
+        prefix = f"serve.{self.spec.name}."
+        with self._lock:
+            state, qsize = self.state, self._queue.qsize()
+            next_seq, dropped = self.next_seq, self.dropped
+        return {
+            prefix + "health_state": float(self.health_code()),
+            prefix + "state": float(STATE_CODES.get(state, 0)),
+            prefix + "cursor": float(self.evaluator.cursor),
+            prefix + "pending": float(max(0, next_seq - self.evaluator.cursor)),
+            prefix + "queue_depth": float(qsize),
+            prefix + "dropped": float(dropped),
+        }
