@@ -51,13 +51,20 @@ parent's ``metricscope watch --once`` (under a poisoned jax) must see both
 ranks clock-aligned — and flag rank 1 as STALE via the epoch anchors.
 
 A seventh scenario, ``serve``, exercises the ``metricserve`` daemon
-(ISSUE 14): both ranks run a :class:`~torchmetrics_tpu.serve.ServeDaemon`
+(ISSUE 14/15): both ranks run a :class:`~torchmetrics_tpu.serve.ServeDaemon`
 over per-rank base directories serving the same three streams (elementwise
-sum, cat and ``dist_reduce_fx="merge"`` states); rank 1's daemon is killed
-mid-ingest by a fault-injected preemption and restarted, the client replays
-from each restored stream's ``next_seq``, and the lockstep sorted drains
-(each final compute is a cross-rank collective) produce exactly the
-uninterrupted single-process results.
+sum, cat and ``dist_reduce_fx="merge"`` states); a fault-injected preemption
+kills a stream worker on rank 1 mid-ingest — the supervisor heals it with
+nothing dropped — then the daemon is torn down WITHOUT drain and restarted,
+the client replays from each restored stream's ``next_seq``, and the
+lockstep sorted drains (each final compute is a cross-rank collective)
+produce exactly the uninterrupted single-process results.
+
+An eighth scenario, ``chaos``, exercises the self-healing plane's worst
+path (ISSUE 15): rank 1's stream crash-loops past its restart budget and
+parks with the circuit breaker open, a ``revive`` half-opens it and the
+probe incarnation heals, and the lockstep drains still match the
+uninterrupted single-process result bitwise on both ranks.
 
 A fourth scenario, ``durable``, exercises preemption-safe evaluation
 (ISSUE 5): on each rank a ``StreamingEvaluator`` accumulates its shard of
@@ -451,12 +458,12 @@ def run_serve_scenario(pid: int, nproc: int) -> None:
     }
     specs = {
         "acc": {"name": "acc", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
-                "snapshot_every_n": 2, "use_feed": False},
+                "snapshot_every_n": 4, "use_feed": False},
         "ap": {"name": "ap", "target": "torchmetrics_tpu.serve.factories:binary_average_precision",
-               "snapshot_every_n": 2, "use_feed": False},
+               "snapshot_every_n": 4, "use_feed": False},
         "q": {"name": "q", "target": "torchmetrics_tpu.serve.factories:quantile",
               "kwargs": {"q": 0.5, "capacity": 256, "levels": 14},
-              "snapshot_every_n": 2, "use_feed": False},
+              "snapshot_every_n": 4, "use_feed": False},
     }
 
     daemon = ServeDaemon(base, publish=False).start()
@@ -481,21 +488,26 @@ def run_serve_scenario(pid: int, nproc: int) -> None:
         return clean
 
     if pid == 1:
-        # the kill: a preemption fires on a stream worker mid-ingest; the
-        # daemon is then torn down WITHOUT drain — exactly a SIGKILL's
-        # durable footprint (snapshots only), plus latched dropped batches
+        # the kill: a preemption fires on a stream worker mid-ingest. Under
+        # supervision (ISSUE 15) the stream HEALS — every offer still acks,
+        # the supervisor restarts the worker and replays the retained
+        # suffix; nothing is dropped
         with faults.inject(faults.Fault("preempt", "runner.preempt", after=3, count=1)):
-            clean = ingest_all(daemon, {})
-            deadline = time.monotonic() + 30
-            while clean and time.monotonic() < deadline:
-                # the preempt may hit a worker AFTER every offer was acked;
-                # wait for the fault to surface in some stream
-                states = [s["state"] for s in daemon.status()["streams"]]
-                if "failed" in states:
-                    clean = False
+            assert ingest_all(daemon, {}), "supervised ingest must ack everything"
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                streams = daemon.status()["streams"]
+                if any(s["restarts"] >= 1 for s in streams) and all(
+                    s["state"] == "serving" and s["pending"] == 0 for s in streams
+                ):
                     break
                 time.sleep(0.05)
-        assert not clean, "rank 1's injected preemption never fired"
+            streams = daemon.status()["streams"]
+            assert any(s["restarts"] >= 1 for s in streams), f"preempt never fired: {streams}"
+            assert all(s["state"] == "serving" and s["pending"] == 0 for s in streams), streams
+            assert all(s["dropped"] == 0 for s in streams), f"supervision dropped batches: {streams}"
+        # drainless teardown — exactly a SIGKILL's durable footprint
+        # (snapshots only; the healed-but-unsnapshotted suffix is lost)
         daemon.shutdown(drain=False)
 
         # the restart: specs survive on disk; every stream resumes from its
@@ -539,6 +551,102 @@ def run_serve_scenario(pid: int, nproc: int) -> None:
     print(f"rank {pid}: serve daemon kill/restart/replay parity verified")
 
 
+def run_chaos_scenario(pid: int, nproc: int) -> None:
+    """Self-healing serve plane under the real 2-process group (ISSUE 15):
+    rank 1's stream worker crash-loops past its restart budget and parks
+    with the circuit breaker OPEN (zero batches dropped — the retained
+    buffer holds the acked suffix); ``revive`` half-opens the circuit, the
+    probe incarnation heals, the replayed suffix applies, and the lockstep
+    drains (each final compute is a cross-rank collective) still produce
+    exactly the uninterrupted single-process result on BOTH ranks."""
+    import os
+    import time
+
+    import numpy as np
+
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.robustness import faults
+    from torchmetrics_tpu.serve import ServeDaemon
+
+    base = os.path.join(os.environ["TM_TPU_STORE_DIR"], f"rank{pid}")
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 96
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    bounds = [0, 60, n_total]
+    lo, hi = bounds[pid], bounds[pid + 1]
+    n_batches = 6
+    wire = [
+        [p.tolist(), t.tolist()]
+        for p, t in zip(np.array_split(preds[lo:hi], n_batches), np.array_split(target[lo:hi], n_batches))
+    ]
+    spec = {
+        "name": "acc", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+        "snapshot_every_n": 2, "use_feed": False,
+        "max_restarts": 2, "poison_threshold": 10, "backoff_base_s": 0.01,
+    }
+
+    daemon = ServeDaemon(base, publish=False).start()
+    assert daemon.create_stream(spec)["ok"]
+
+    def offer_all(tolerate_failed):
+        start = daemon.status()["streams"][0]["next_seq"]
+        for seq in range(start, n_batches):
+            reply = daemon.ingest("acc", seq, wire[seq])
+            while not reply.get("ok") and reply.get("error", {}).get("code") == "backpressure":
+                time.sleep(0.01)
+                reply = daemon.ingest("acc", seq, wire[seq])
+            if not reply.get("ok"):
+                assert tolerate_failed and reply["error"]["code"] == "failed", reply
+                return False
+        return True
+
+    if pid == 1:
+        # the first 3 apply attempts die; the budget is 2 restarts, so the
+        # 3rd failure parks the circuit open BEFORE the fault exhausts
+        with faults.inject(faults.Fault("fail", "serve.worker.crash", count=3)):
+            offer_all(tolerate_failed=True)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                status = daemon.status()["streams"][0]
+                if status["state"] == "failed" and status["circuit"] == "open":
+                    break
+                time.sleep(0.05)
+            status = daemon.status()["streams"][0]
+            assert status["state"] == "failed" and status["circuit"] == "open", status
+            assert status["dropped"] == 0, f"parking dropped acked batches: {status}"
+            assert "revive" in (status.get("failure") or ""), status
+
+            # revive: half-open -> the probe incarnation applies the fourth
+            # attempt fault-free -> circuit closes; finish the ingest
+            reply = daemon.revive_stream("acc")
+            assert reply["ok"] and reply.get("revived"), reply
+            assert offer_all(tolerate_failed=False), "post-revive ingest must be clean"
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                status = daemon.status()["streams"][0]
+                if status["state"] == "serving" and status["pending"] == 0 and status["circuit"] == "closed":
+                    break
+                time.sleep(0.05)
+            status = daemon.status()["streams"][0]
+            assert status["circuit"] == "closed" and status["pending"] == 0, status
+            assert status["restarts"] >= 2 and status["dropped"] == 0, status
+    else:
+        assert offer_all(tolerate_failed=False), "rank 0's ingest must be clean"
+
+    # lockstep drain: rank 0 parks in the collective until rank 1's revived
+    # stream catches up — the drained value folds BOTH ranks' shards
+    reply = daemon.drain_stream("acc")
+    assert reply["ok"], reply
+
+    ref = BinaryAccuracy(distributed_available_fn=lambda: False, validate_args=False)
+    ref.update(preds, target)
+    assert reply["results"] == float(ref.compute()), f"chaos drain parity: {reply['results']}"
+
+    daemon.shutdown(drain=True)
+    print(f"rank {pid}: circuit-break + revive drain parity verified")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -561,6 +669,9 @@ def main() -> None:
         return
     if scenario == "serve":
         run_serve_scenario(pid, nproc)
+        return
+    if scenario == "chaos":
+        run_chaos_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

@@ -198,6 +198,24 @@ def test_two_process_serve_daemon(tmp_path):
 
 
 @pytest.mark.timeout(240)
+def test_two_process_circuit_break_and_revive(tmp_path):
+    """The self-healing plane's worst path under a REAL 2-process group
+    (ISSUE 15): rank 1's stream crash-loops past its restart budget and
+    parks with the circuit breaker open (zero drops — the retained buffer
+    holds the acked suffix), ``revive`` half-opens it and the probe
+    incarnation heals, and the lockstep collective drains still match the
+    uninterrupted single-process result bitwise on both ranks."""
+    results = _run_workers(
+        "chaos",
+        timeout=240,
+        extra_env={"TM_TPU_STORE_DIR": str(tmp_path)},
+    )
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: circuit-break + revive drain parity verified" in out, out
+
+
+@pytest.mark.timeout(240)
 def test_two_process_injected_faults():
     """The robustness layer under REAL injected faults across the group: a
     corrupt object-gather payload raises ``SyncError`` naming the rank, a

@@ -329,8 +329,26 @@ def test_fault_env_spec_parsing():
     with pytest.raises(ValueError, match="malformed"):
         faults.install_from_env("justonefield")
     with pytest.raises(ValueError, match="unknown TM_TPU_FAULTS option"):
-        faults.install_from_env("fail:p:bogus=1")
+        faults.install_from_env("fail:sync.attempt:bogus=1")
     faults.clear()
+
+
+def test_fault_env_rejects_unknown_points_loudly():
+    """A typo'd injection point would make a chaos drill silently test
+    nothing — ``install_from_env`` refuses it, names the entry and lists the
+    valid points (ISSUE 15 satellite)."""
+    with pytest.raises(ValueError, match="unknown TM_TPU_FAULTS point 'runner.preampt'"):
+        faults.install_from_env("preempt:runner.preampt:after=3:count=1")
+    with pytest.raises(ValueError, match="known points:"):
+        faults.install_from_env("fail:serve.worker.crash:count=1;fail:nope.nothere")
+    # nothing half-installed by a rejected spec
+    assert not faults.active()
+    # every registry entry round-trips through the parser
+    installed = faults.install_from_env(";".join(f"fail:{p}" for p in sorted(faults.KNOWN_POINTS)))
+    try:
+        assert {f.point for f in installed} == set(faults.KNOWN_POINTS)
+    finally:
+        faults.clear()
 
 
 class MidFaultMetric(Metric):

@@ -415,6 +415,49 @@ def test_watch_table_surfaces_unreadable_and_unanchored(tmp_path):
     assert "UNANCHORED" in table  # a clock-anchorless payload is not compared
 
 
+def test_watch_table_surfaces_stream_supervision_columns(tmp_path):
+    """The watch stream sub-table carries the self-healing plane (ISSUE 15):
+    restart count, circuit-breaker state, dead-letter depth and the
+    durability verdict — a parked stream reads ``failed / open / NO`` at a
+    glance, a healthy one ``serving / closed / yes``."""
+    _write_status(str(tmp_path), 0, time.time_ns())
+    path = tmp_path / live.status_filename(0)
+    payload = json.loads(path.read_text())
+    payload["gauges"].update({
+        "serve.acc.health_state": 3.0, "serve.acc.state": 4.0,
+        "serve.acc.cursor": 6.0, "serve.acc.pending": 2.0,
+        "serve.acc.queue_depth": 0.0, "serve.acc.dropped": 0.0,
+        "serve.acc.restarts": 3.0, "serve.acc.circuit_state": 2.0,
+        "serve.acc.deadletter_depth": 1.0, "serve.acc.durability": 0.0,
+        "serve.f1.health_state": 0.0, "serve.f1.state": 1.0,
+        "serve.f1.cursor": 6.0, "serve.f1.pending": 0.0,
+        "serve.f1.queue_depth": 0.0, "serve.f1.dropped": 0.0,
+        "serve.f1.restarts": 0.0, "serve.f1.circuit_state": 0.0,
+        "serve.f1.deadletter_depth": 0.0, "serve.f1.durability": 1.0,
+    })
+    path.write_text(json.dumps(payload))
+    statuses = live.read_status_dir(str(tmp_path))
+
+    table = live.format_watch_table(statuses, stale_after_s=10.0)
+    for column in ("restarts", "circuit", "deadletter", "durable"):
+        assert column in table, table
+    rows = {ln.split()[1]: ln.split() for ln in table.splitlines()
+            if ln.split()[1:2] and ln.split()[1] in ("acc", "f1")}
+    assert rows["acc"][2:4] == ["stalled", "failed"]
+    assert "open" in rows["acc"] and "NO" in rows["acc"] and "1" in rows["acc"]
+    assert rows["f1"][2:4] == ["ok", "serving"]
+    assert "closed" in rows["f1"] and "yes" in rows["f1"]
+
+    stream_rows = {json.loads(ln)["stream"]: json.loads(ln)
+                   for ln in live.format_watch_json(statuses).splitlines()
+                   if json.loads(ln)["kind"] == "stream"}
+    acc = stream_rows["acc"]
+    assert acc["circuit"] == "open" and acc["restarts"] == 3.0
+    assert acc["deadletter_depth"] == 1.0 and acc["durability"] == 0.0
+    assert acc["health"] == "stalled"
+    assert stream_rows["f1"]["circuit"] == "closed"
+
+
 # ------------------------------------------------------------------- diff
 
 

@@ -50,15 +50,15 @@ def _four_stream_fixtures(n_batches=6, n=96):
 
     specs = {
         "plain": {"name": "plain", "target": "torchmetrics_tpu.serve.factories:accuracy",
-                  "snapshot_every_n": 2, "use_feed": False},
+                  "snapshot_every_n": 4, "use_feed": False},
         "fusedc": {"name": "fusedc", "target": "torchmetrics_tpu.serve.factories:collection",
                    "fused": True, "fused_options": {"cat_capacity": 128},
-                   "snapshot_every_n": 2, "use_feed": False},
+                   "snapshot_every_n": 4, "use_feed": False},
         "sliced": {"name": "sliced", "target": "torchmetrics_tpu.serve.factories:sliced_accuracy",
-                   "kwargs": {"num_classes": 4, "num_cells": 4}, "snapshot_every_n": 2,
+                   "kwargs": {"num_classes": 4, "num_cells": 4}, "snapshot_every_n": 4,
                    "use_feed": True},
         "windowed": {"name": "windowed", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
-                     "window": {"slots": 3, "every_n": 2}, "snapshot_every_n": 2, "use_feed": False},
+                     "window": {"slots": 3, "every_n": 2}, "snapshot_every_n": 4, "use_feed": False},
     }
     batches = {
         "plain": split(labels, target4),
@@ -93,11 +93,12 @@ def _drain_all(daemon, names):
 
 class TestChaosRestartParity:
     def test_kill_restart_replay_is_bitwise_equal(self, tmp_path):
-        """ISSUE 14 chaos acceptance: ≥ 4 concurrent streams (fused, sliced,
-        windowed among them) survive a mid-ingest kill — worker death plus a
-        drainless teardown, the in-process twin of SIGKILL's durable footprint
-        (snapshots + specs only) — and the restarted daemon's resumed results
-        are EXACTLY the uninterrupted run's."""
+        """Chaos acceptance: ≥ 4 concurrent streams (fused, sliced, windowed
+        among them) survive a mid-ingest worker kill — now SUPERVISED back to
+        serving (restart + retained-buffer replay, no client involvement) —
+        plus a drainless teardown, the in-process twin of SIGKILL's durable
+        footprint (snapshots + specs only); the restarted daemon's resumed
+        results are EXACTLY the uninterrupted run's."""
         specs, batches = _four_stream_fixtures()
 
         # the uninterrupted reference run
@@ -109,20 +110,27 @@ class TestChaosRestartParity:
         ref.shutdown(drain=False)
 
         # the chaos run: a lockstep preemption kills one stream's worker
-        # mid-ingest; the daemon is then torn down WITHOUT drain
+        # mid-ingest; the supervisor heals it (every ack still lands), then
+        # the daemon is torn down WITHOUT drain
         chaos_dir = str(tmp_path / "chaos")
         daemon = ServeDaemon(chaos_dir, publish=False).start()
         for name in sorted(specs):
             assert daemon.create_stream(specs[name])["ok"]
         with faults.inject(faults.Fault("preempt", "runner.preempt", after=5, count=1)):
-            clean = _ingest_all(daemon, batches)
+            assert _ingest_all(daemon, batches)
+            healed = False
             deadline = time.monotonic() + 30
-            while clean and time.monotonic() < deadline:
-                if any(s["state"] == "failed" for s in daemon.status()["streams"]):
-                    clean = False
+            while time.monotonic() < deadline:
+                streams = daemon.status()["streams"]
+                if (
+                    any(s["restarts"] >= 1 for s in streams)
+                    and all(s["state"] == "serving" and s["pending"] == 0 for s in streams)
+                ):
+                    healed = True
                     break
                 time.sleep(0.02)
-        assert not clean, "the injected mid-ingest kill never fired"
+        assert healed, "the injected worker kill was never supervised back to serving"
+        assert all(s["dropped"] == 0 for s in daemon.status()["streams"])
         daemon.shutdown(drain=False)
 
         # restart = resume: every spec.json rebuilds its stream at the
@@ -245,6 +253,9 @@ class TestHealth:
                 assert daemon.create_stream({
                     "name": name, "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
                     "use_feed": False,
+                    # "bad" parks on the FIRST crash (no restart budget), so
+                    # the worst-stream health flip is deterministic
+                    "max_restarts": 0,
                 })["ok"]
             code, body, _ = _http(daemon, "GET", "/healthz")
             assert code == 200 and body["state"] == "ok"
@@ -256,11 +267,26 @@ class TestHealth:
                     if daemon._get("bad").status()["state"] == "failed":
                         break
                     time.sleep(0.02)
+            status = daemon._get("bad").status()
+            assert status["state"] == "failed" and status["circuit"] == "open"
             code, body, _ = _http(daemon, "GET", "/healthz")
             assert code == 503 and body["state"] == "stalled"
             assert "bad" in body["reason"]
             # the healthy stream is untouched — health is worst-of, not avg
             assert daemon._get("good").status()["state"] == "serving"
+            # ctl revive half-opens the circuit; the probe incarnation
+            # replays the retained batch (the fault is spent) and heals
+            reply = daemon.revive_stream("bad")
+            assert reply["ok"] and reply["revived"], reply
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                status = daemon._get("bad").status()
+                if status["state"] == "serving" and status["pending"] == 0 and status["circuit"] == "closed":
+                    break
+                time.sleep(0.02)
+            assert status["circuit"] == "closed" and status["dropped"] == 0
+            code, body, _ = _http(daemon, "GET", "/healthz")
+            assert code == 200 and body["state"] == "ok"
         finally:
             daemon.shutdown(drain=False)
 
@@ -297,6 +323,9 @@ class TestHealth:
             assert daemon.create_stream({
                 "name": "wedged", "target": "tests.unittests.serve._targets:blocking_accuracy",
                 "use_feed": False, "watchdog_timeout_s": 6.0, "on_stall": "raise",
+                # park immediately on the stall — re-running the wedged apply
+                # through the restart budget would just stall 5 more times
+                "max_restarts": 0,
             })["ok"]
             assert daemon.ingest("wedged", 0, [[0.9, 0.2], [1, 0]])["ok"]
             flipped_while_serving = False
@@ -321,6 +350,82 @@ class TestHealth:
             assert status["state"] == "failed" and "StallError" in status["failure"]
         finally:
             _targets.BLOCK.set()  # unstick the abandoned update thread
+            daemon.shutdown(drain=False)
+
+
+class TestDiskFaultDegradation:
+    def test_bounded_enospc_degrades_then_recovers_with_restart_parity(self, tmp_path):
+        """ISSUE 15 satellite: a BOUNDED disk-exhaustion window — ``count``
+        exactly the snapshot retry budget — fails the cursor-2 cadence
+        snapshot through every in-line retry, so the stream detaches its
+        store and keeps serving in-memory-only (healthz 503 ``degraded``,
+        ``durable`` False, ``write_failures`` == the spent attempts, zero
+        restarts: degradation is NOT a crash); once the window clears, the
+        recovery probe re-lands a snapshot and durability resumes; a
+        drainless restart + suffix replay then matches the uninterrupted
+        run bitwise."""
+        spec = {"name": "m1", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                "snapshot_every_n": 2, "use_feed": False}
+        rng = np.random.RandomState(_SEED)
+        preds = np.array_split(rng.rand(48).astype(np.float32), 6)
+        target = np.array_split(rng.randint(0, 2, 48), 6)
+        batches = [[preds[k].tolist(), target[k].tolist()] for k in range(6)]
+
+        ref = ServeDaemon(str(tmp_path / "ref"), publish=False).start()
+        assert ref.create_stream(spec)["ok"]
+        assert _ingest_all(ref, {"m1": batches})
+        want = _drain_all(ref, ["m1"])
+        ref.shutdown(drain=False)
+
+        from torchmetrics_tpu.serve.stream import _DISK_RETRIES
+
+        chaos_dir = str(tmp_path / "chaos")
+        daemon = ServeDaemon(chaos_dir, publish=False).start()
+        try:
+            assert daemon.create_stream(spec)["ok"]
+            with faults.inject(faults.Fault("fail", "store.write.enospc", count=1 + _DISK_RETRIES)):
+                for seq in range(4):
+                    assert daemon.ingest("m1", seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    status = daemon._get("m1").status()
+                    if not status["durable"] and status["pending"] == 0:
+                        break
+                    time.sleep(0.02)
+                assert not status["durable"], "the exhausted retry budget never degraded the stream"
+                assert status["state"] == "serving" and status["restarts"] == 0
+                assert status["write_failures"] == 1 + _DISK_RETRIES
+                code, body, _ = _http(daemon, "GET", "/healthz")
+                assert code == 503 and body["state"] == "degraded"
+                assert "m1" in body["reason"]
+                # the window is spent: the next probe-due apply re-lands a
+                # snapshot and re-attaches the store
+                time.sleep(0.6)
+                for seq in (4, 5):
+                    assert daemon.ingest("m1", seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    status = daemon._get("m1").status()
+                    if status["durable"] and status["pending"] == 0:
+                        break
+                    time.sleep(0.02)
+            assert status["durable"], "durability never resumed after the fault window cleared"
+            assert status["write_failures"] == 1 + _DISK_RETRIES and status["dropped"] == 0
+            code, body, _ = _http(daemon, "GET", "/healthz")
+            assert code == 200 and body["state"] == "ok"
+        finally:
+            daemon.shutdown(drain=False)
+
+        # restart = resume from the RECOVERED snapshot: the replay suffix is
+        # non-empty (the drainless teardown persisted nothing past the last
+        # cadence snapshot) and the drain is bitwise the reference's
+        daemon = ServeDaemon(chaos_dir, publish=False).start()
+        try:
+            start_at = {s["name"]: s["next_seq"] for s in daemon.status()["streams"]}
+            assert 0 < start_at["m1"] <= 6, f"recovery left no durable footprint: {start_at}"
+            assert _ingest_all(daemon, {"m1": batches}, start_at)
+            assert _drain_all(daemon, ["m1"]) == want
+        finally:
             daemon.shutdown(drain=False)
 
 

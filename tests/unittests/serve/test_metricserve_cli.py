@@ -171,3 +171,148 @@ def test_ctl_reports_wire_errors_cleanly(tmp_path):
     finally:
         proc.kill()
         proc.communicate(timeout=30)
+
+
+def _start_daemon_with_faults(base_dir, fault_spec):
+    proc = subprocess.Popen(
+        [sys.executable, _CLI, "serve", "--base-dir", str(base_dir)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", TM_TPU_FAULTS=fault_spec), cwd=str(_REPO_ROOT),
+    )
+    ready = proc.stdout.readline()
+    assert ready, proc.stderr.read()
+    info = json.loads(ready)
+    assert info["ok"]
+    return proc, info
+
+
+def _poll_status(env, http, name, predicate, timeout_s=60.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        out = _ctl(env, "--http", http, "status", name, "--json")
+        if out.returncode == 0:
+            status = json.loads(out.stdout)
+            if predicate(status):
+                return status
+        time.sleep(0.1)
+    raise AssertionError(f"status predicate never held for {name}")
+
+
+@pytest.mark.timeout(240)
+def test_ctl_deadletter_quarantine_requeue_purge_cycle(tmp_path):
+    """The repair verbs end to end (ISSUE 15): a poison batch quarantines to
+    deadletter.jsonl, ``deadletter list`` shows it, ``requeue`` re-admits it
+    at the watermark (where it poisons AGAIN and re-quarantines under its
+    new seq), and ``purge`` drops it for good — all through the jax-free ctl."""
+    base = tmp_path / "base"
+    proc, info = _start_daemon(base)
+    try:
+        http = "{}:{}".format(*info["http"])
+        env = _poisoned_env(tmp_path)
+        spec = json.dumps({
+            "name": "toxic",
+            "target": "torchmetrics_tpu.serve.factories:checked_binary_accuracy",
+            "snapshot_every_n": 2, "poison_threshold": 1, "backoff_base_s": 0.01,
+        })
+        assert _ctl(env, "--http", http, "create", "--spec", spec).returncode == 0
+
+        lines = _batches_jsonl().splitlines()
+        lines[2] = json.dumps([[0.5, 0.5, 0.5], [7, 7, 7]])  # clean avals, poison values
+        out = _ctl(env, "--socket", info["socket"], "replay", "toxic", stdin="\n".join(lines) + "\n")
+        assert out.returncode == 0, out.stderr
+        assert json.loads(out.stdout)["acked"] == 6
+
+        _poll_status(env, http, "toxic",
+                     lambda s: s["deadletter_depth"] == 1 and s["pending"] == 0 and s["state"] == "serving")
+        out = _ctl(env, "--http", http, "deadletter", "toxic", "list", "--json")
+        listing = json.loads(out.stdout)
+        assert listing["depth"] == 1 and listing["deadletter"][0]["seq"] == 2
+        assert (base / "streams" / "toxic" / "deadletter.jsonl").exists()
+
+        # requeue: the poison re-enters at the watermark, kills the worker
+        # once more, and re-quarantines under its NEW seq
+        out = _ctl(env, "--http", http, "deadletter", "toxic", "requeue", "--seq", "2", "--json")
+        assert out.returncode == 0, out.stderr
+        as_seq = json.loads(out.stdout)["as_seq"]
+        assert as_seq == 6
+        status = _poll_status(env, http, "toxic",
+                              lambda s: s["deadletter_depth"] == 1 and s["pending"] == 0)
+        out = _ctl(env, "--http", http, "deadletter", "toxic", "list", "--json")
+        assert json.loads(out.stdout)["deadletter"][0]["seq"] == as_seq
+
+        # purge is the one sanctioned drop
+        out = _ctl(env, "--http", http, "deadletter", "toxic", "purge", "--seq", str(as_seq), "--json")
+        assert out.returncode == 0 and json.loads(out.stdout)["depth"] == 0
+        status = _poll_status(env, http, "toxic", lambda s: s["dropped"] == 1)
+        assert status["deadletter_depth"] == 0
+        out = _ctl(env, "--http", http, "drain", "toxic", "--json")
+        assert out.returncode == 0, out.stderr
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)
+
+
+@pytest.mark.timeout(240)
+def test_ctl_revive_half_opens_a_parked_circuit(tmp_path):
+    """``ctl revive`` end to end: a worker crash parks a zero-budget stream
+    with the circuit open, revive half-opens it, the fault-free probe
+    incarnation heals, and the full replay + drain completes."""
+    proc, info = _start_daemon_with_faults(tmp_path / "base", "fail:serve.worker.crash:count=1")
+    try:
+        http = "{}:{}".format(*info["http"])
+        env = _poisoned_env(tmp_path)
+        spec = json.dumps({
+            "name": "breaker",
+            "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+            "snapshot_every_n": 2, "max_restarts": 0, "backoff_base_s": 0.01,
+        })
+        assert _ctl(env, "--http", http, "create", "--spec", spec).returncode == 0
+        jsonl = _batches_jsonl()
+        first = jsonl.splitlines()[0] + "\n"
+        assert _ctl(env, "--socket", info["socket"], "replay", "breaker", stdin=first).returncode == 0
+
+        status = _poll_status(env, http, "breaker",
+                              lambda s: s["state"] == "failed" and s["circuit"] == "open")
+        assert "revive" in status["failure"] and status["dropped"] == 0
+
+        out = _ctl(env, "--http", http, "revive", "breaker", "--json")
+        assert out.returncode == 0, out.stderr
+        reply = json.loads(out.stdout)
+        assert reply["revived"] is True
+
+        out = _ctl(env, "--socket", info["socket"], "replay", "breaker", stdin=jsonl)
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout)
+        assert summary["skipped"] == 1 and summary["acked"] == 5
+        _poll_status(env, http, "breaker",
+                     lambda s: s["pending"] == 0 and s["circuit"] == "closed" and s["restarts"] == 0)
+        out = _ctl(env, "--http", http, "drain", "breaker", "--json")
+        assert out.returncode == 0 and json.loads(out.stdout)["cursor"] == 6
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)
+
+
+@pytest.mark.timeout(240)
+def test_replay_backoff_caps_at_max_retry_s(tmp_path):
+    """A stream whose worker is stuck (injected per-apply delay, queue of 1)
+    backpressures forever: replay retries with backoff, then fails LOUDLY
+    naming the stalled seq once ``--max-retry-s`` is spent — it never hangs."""
+    proc, info = _start_daemon_with_faults(tmp_path / "base", "delay:serve.worker.crash:arg=120")
+    try:
+        http = "{}:{}".format(*info["http"])
+        env = _poisoned_env(tmp_path)
+        spec = json.dumps({
+            "name": "stuck",
+            "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+            "queue_max": 1,
+        })
+        assert _ctl(env, "--http", http, "create", "--spec", spec).returncode == 0
+        out = _ctl(env, "--socket", info["socket"], "replay", "stuck", "--max-retry-s", "2",
+                   stdin=_batches_jsonl())
+        assert out.returncode == 1
+        assert "backpressure" in out.stderr and "--max-retry-s 2" in out.stderr
+        assert "seq" in out.stderr
+    finally:
+        proc.kill()
+        proc.communicate(timeout=30)

@@ -1,8 +1,12 @@
 # Copyright The TorchMetrics-TPU contributors.
 # Licensed under the Apache License, Version 2.0.
-"""One stream's lifecycle: exactly-once ingest, flush/drain ops, failure
-accounting (ISSUE 14)."""
+"""One stream's lifecycle: exactly-once ingest, flush/drain ops, supervised
+self-healing, poison-batch quarantine and disk-fault degradation (ISSUEs 14
+and 15)."""
 from __future__ import annotations
+
+import json
+import time
 
 import numpy as np
 import pytest
@@ -158,24 +162,63 @@ class TestFailure:
         assert reply["ok"] and reply["next_seq"] == 2
         assert stream.drain()["cursor"] == 2
 
-    def test_worker_death_latches_dropped_and_reports_cause(self, tmp_path):
-        stream = _start(tmp_path, name="doomed", snapshot_every_n=2)
+    def test_worker_death_is_supervised_back_to_serving(self, tmp_path):
+        """A worker crash is no longer terminal: the supervisor restarts the
+        worker, restores from the snapshot, replays the retained suffix —
+        exactly-once with no client involvement, zero drops, and the drain
+        still matches the uninterrupted in-process run bitwise."""
+        stream = _start(tmp_path, name="healed", snapshot_every_n=2, backoff_base_s=0.01)
         batches, _, _ = _wire_batches()
         with faults.inject(faults.Fault("preempt", "runner.preempt", after=2, count=1)):
             for seq, batch in enumerate(batches):
-                reply = stream.offer(seq, batch)
-                if not reply.get("ok"):
-                    break
-            stream._finished.wait(30.0)
+                assert stream.offer(seq, batch, block=True, deadline_s=30.0)["ok"]
+            reply = stream.drain()
+        assert reply["ok"] and reply["cursor"] == len(batches), reply
         status = stream.status()
-        assert status["state"] == "failed"
-        assert "SimulatedPreemption" in status["failure"]
-        # acked-but-never-applied batches latched as dropped (cursor died at 3)
-        assert stream.dropped == status["next_seq"] - status["cursor"] > 0
-        # post-mortem ops and offers report the cause instead of hanging
-        assert stream.offer(status["next_seq"], batches[0])["error"]["code"] == "failed"
-        assert not stream.drain()["ok"]
-        assert stream.gauges()["serve.doomed.health_state"] == 3.0
+        assert status["restarts"] >= 1 and status["circuit"] == "closed"
+        assert "SimulatedPreemption" in status["last_failure"]
+        assert stream.dropped == 0
+        ref = resolve_target(_ACC)
+        for batch in batches:
+            ref.update(*decode_batch(batch))
+        assert reply["results"] == float(ref.compute())
+
+    def test_restart_budget_exhaustion_parks_circuit_open_and_revive_heals(self, tmp_path):
+        """More crashes than ``max_restarts`` inside the window parks the
+        stream: state failed, circuit open, health stalled — but nothing is
+        dropped, and a manual revive replays the retained suffix and heals."""
+        stream = _start(
+            tmp_path, name="parked", snapshot_every_n=2, max_restarts=0, backoff_base_s=0.01
+        )
+        batches, _, _ = _wire_batches()
+        with faults.inject(faults.Fault("preempt", "runner.preempt", after=1, count=1)):
+            for seq in range(3):
+                assert stream.offer(seq, batches[seq])["ok"]
+            assert stream._finished.wait(30.0)
+        status = stream.status()
+        assert status["state"] == "failed" and status["circuit"] == "open"
+        assert "circuit open" in status["failure"] and "revive" in status["failure"]
+        assert stream.gauges()["serve.parked.health_state"] == 3.0
+        assert stream.gauges()["serve.parked.circuit_state"] == 2.0
+        # parked ≠ dropped: the retained buffer still covers the suffix
+        assert stream.dropped == 0
+        refused = stream.offer(status["next_seq"], batches[3])
+        assert refused["error"]["code"] == "failed" and "revive" in refused["error"]["message"]
+
+        reply = stream.revive()
+        assert reply["ok"] and reply["revived"], reply
+        for seq in range(3, len(batches)):
+            assert stream.offer(seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+        reply = stream.drain()
+        assert reply["ok"] and reply["cursor"] == len(batches)
+        status = stream.status()
+        assert status["circuit"] == "closed" and stream.dropped == 0
+        ref = resolve_target(_ACC)
+        for batch in batches:
+            ref.update(*decode_batch(batch))
+        assert reply["results"] == float(ref.compute())
+        # revive on a non-parked stream is a bad_request, not a restart
+        assert stream.revive()["error"]["code"] == "bad_request"
 
     def test_abandon_without_compute(self, tmp_path):
         stream = _start(tmp_path)
@@ -185,6 +228,232 @@ class TestFailure:
         stream.abandon()
         assert stream.status()["state"] == "failed"
         assert stream.result is None  # no final compute on the delete path
+
+
+class TestPayloadValidation:
+    def test_shape_and_dtype_drift_is_bad_payload(self, tmp_path):
+        """The wire layer pins the first-accepted batch's avals: later
+        batches may vary their leading (batch) dim but not part count, dtype
+        or trailing shape — drift errors at ADMISSION, not in the worker."""
+        stream = _start(tmp_path)
+        batches, _, _ = _wire_batches()
+        assert stream.offer(0, batches[0])["ok"]
+        # fewer parts than the stream's update arity
+        reply = stream.offer(1, [[0.5, 0.5]])
+        assert not reply["ok"] and reply["error"]["code"] == "bad_payload"
+        assert "1 part(s)" in reply["error"]["message"]
+        # right arity, wrong dtype (float target vs the pinned int64)
+        reply = stream.offer(1, [[0.5, 0.5], [1.0, 0.5]])
+        assert not reply["ok"] and reply["error"]["code"] == "bad_payload"
+        assert reply["error"]["expected"] and reply["error"]["got"]
+        # right arity, wrong trailing shape (2-d preds vs the pinned 1-d)
+        reply = stream.offer(1, [[[0.5], [0.5]], [1, 0]])
+        assert not reply["ok"] and reply["error"]["code"] == "bad_payload"
+        # a rejected payload never advanced the watermark
+        ok = stream.offer(1, batches[1])
+        assert ok["ok"] and ok["next_seq"] == 2
+        # leading-dim variation is fine (clients split unevenly)
+        assert stream.offer(2, [[0.9], [1]])["ok"]
+        stream.abandon()
+
+
+class TestDeadletter:
+    _POISON = [[0.5, 0.5, 0.5], [7, 7, 7]]  # clean avals, values outside {0, 1}
+
+    def test_poison_batch_is_quarantined_and_skipped(self, tmp_path):
+        """A batch that kills the worker ``poison_threshold`` times in a row
+        lands in deadletter.jsonl with its error; the cursor skips past it
+        and the stream keeps serving — results equal the poison-free run."""
+        stream = _start(
+            tmp_path,
+            name="toxic",
+            target="torchmetrics_tpu.serve.factories:checked_binary_accuracy",
+            snapshot_every_n=2,
+            poison_threshold=2,
+            backoff_base_s=0.01,
+        )
+        batches, _, _ = _wire_batches()
+        for seq in range(2):
+            assert stream.offer(seq, batches[seq])["ok"]
+        assert stream.offer(2, self._POISON)["ok"]  # avals pass; values are poison
+        for seq in range(3, len(batches)):
+            assert stream.offer(seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+        reply = stream.drain()
+        # every seq (incl. the skipped poison one) moved the cursor
+        assert reply["ok"] and reply["cursor"] == len(batches), reply
+
+        listing = stream.deadletter_list()
+        assert listing["ok"] and listing["depth"] == 1
+        record = listing["deadletter"][0]
+        assert record["seq"] == 2 and record["attempts"] == 2
+        # torchmetrics validate_args reports bad targets as a RuntimeError
+        assert "expected only the following values" in record["error"]
+        assert record["batch"] == self._POISON
+        # durable: the quarantine file holds the same record
+        with open(stream.deadletter_path) as fh:
+            on_disk = [json.loads(line) for line in fh if line.strip()]
+        assert [r["seq"] for r in on_disk] == [2]
+        assert stream.dropped == 0  # quarantined, not silently dropped
+        assert stream.gauges()["serve.toxic.deadletter_depth"] == 1.0
+
+        # results equal the run that never saw the poison batch (seq 2 took
+        # batches[2]'s slot, so the reference excludes that index)
+        ref = resolve_target(_ACC)
+        for i, batch in enumerate(batches):
+            if i != 2:
+                ref.update(*decode_batch(batch))
+        assert reply["results"] == float(ref.compute())
+
+    def test_deadletter_survives_restart_and_requeue_re_enters_exactly_once(self, tmp_path):
+        """A transient poison (environmental crash pinned to one batch) is
+        quarantined, survives a stream rebuild from disk, and a requeue
+        re-admits the payload through the normal exactly-once path."""
+        spec_kw = dict(
+            name="dl", target=_ACC, use_feed=False, snapshot_every_n=2,
+            poison_threshold=1, backoff_base_s=0.01,
+        )
+        stream = Stream(StreamSpec(**spec_kw), str(tmp_path / "store"))
+        stream.start()
+        batches, _, _ = _wire_batches()
+        with faults.inject(faults.Fault("fail", "serve.worker.crash", after=2, count=1)):
+            for seq in range(3):
+                assert stream.offer(seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if stream.status()["deadletter_depth"] == 1 and stream.status()["pending"] == 0:
+                    break
+                time.sleep(0.02)
+        assert stream.status()["deadletter_depth"] == 1
+        stream.abandon()
+
+        # dead-letter state survives the daemon restart (re-read from disk)
+        resumed = Stream(StreamSpec(**spec_kw), str(tmp_path / "store"))
+        resumed.start()
+        listing = resumed.deadletter_list()
+        assert listing["depth"] == 1 and listing["deadletter"][0]["seq"] == 2
+        reply = resumed.deadletter_requeue(2)
+        assert reply["ok"] and reply["requeued"] == 2, reply
+        assert reply["as_seq"] == resumed.status()["next_seq"] - 1
+        assert resumed.deadletter_list()["depth"] == 0
+        drained = resumed.drain()
+        assert drained["ok"]
+        ref = resolve_target(_ACC)
+        for batch in batches[:3]:
+            ref.update(*decode_batch(batch))
+        assert drained["results"] == float(ref.compute())
+        assert resumed.dropped == 0
+
+    def test_purge_latches_dropped_and_requeue_of_missing_seq_is_not_found(self, tmp_path):
+        stream = _start(
+            tmp_path,
+            name="purged",
+            target="torchmetrics_tpu.serve.factories:checked_binary_accuracy",
+            poison_threshold=1,
+            backoff_base_s=0.01,
+        )
+        batches, _, _ = _wire_batches()
+        assert stream.offer(0, batches[0])["ok"]
+        assert stream.offer(1, self._POISON)["ok"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if stream.status()["deadletter_depth"] == 1:
+                break
+            time.sleep(0.02)
+        assert stream.deadletter_requeue(99)["error"]["code"] == "not_found"
+        assert stream.deadletter_requeue("1")["error"]["code"] == "bad_request"
+        reply = stream.deadletter_purge(1)
+        assert reply["ok"] and reply["purged"] == 1 and reply["depth"] == 0
+        assert stream.dropped == 1  # acked, never applied, now unrecoverable
+        assert stream.deadletter_purge(1)["error"]["code"] == "not_found"
+        assert stream.drain()["ok"]
+
+
+class TestDegradation:
+    def test_disk_fault_degrades_to_memory_only_then_recovers(self, tmp_path):
+        """ENOSPC on snapshot writes: retries, then the store detaches and
+        the stream keeps serving (health degraded, durability gauge 0); the
+        recovery probe re-enables durability once the disk heals, and a
+        kill-and-resume from the post-recovery snapshot still matches."""
+        stream = _start(tmp_path, name="flaky", snapshot_every_n=1)
+        batches, _, _ = _wire_batches(n_batches=12, n=96)
+        fault = faults.Fault("fail", "store.write.enospc", after=2, count=1000)
+        with faults.inject(fault):
+            degraded = False
+            for seq in range(6):
+                assert stream.offer(seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                status = stream.status()
+                if not status["durable"] and status["pending"] == 0:
+                    degraded = True
+                    break
+                time.sleep(0.02)
+            assert degraded, "ENOSPC never degraded the stream"
+            assert status["state"] == "serving"  # still serving, memory-only
+            assert status["write_failures"] >= 1
+            assert stream.health_code() == 2
+            assert stream.gauges()["serve.flaky.durability"] == 0.0
+        # the disk "heals" (faults cleared); keep feeding until the recovery
+        # probe lands a snapshot and durability flips back on
+        recovered = False
+        deadline = time.monotonic() + 30
+        seq = 6
+        while time.monotonic() < deadline:
+            if seq < len(batches):
+                assert stream.offer(seq, batches[seq], block=True, deadline_s=30.0)["ok"]
+                seq += 1
+            if stream.status()["durable"]:
+                recovered = True
+                break
+            time.sleep(0.1)
+        assert recovered, "durability never recovered after the disk healed"
+        assert stream.health_code() == 0
+        reply = stream.flush()
+        assert reply["ok"] and reply["durable"]
+        stream.abandon()
+        # kill-and-resume: the post-recovery snapshot is genuinely durable
+        resumed = Stream(stream.spec, stream.store_dir)
+        start = resumed.start()
+        assert start >= 6, f"recovered snapshot should cover the outage, resumed at {start}"
+        resumed.abandon()
+
+    def test_deadletter_write_fault_keeps_quarantine_in_memory(self, tmp_path):
+        """ENOSPC on the deadletter.jsonl rewrite: the quarantine stays in
+        memory (durability gauge drops), the stream keeps serving, and the
+        file lands once the disk recovers."""
+        stream = _start(
+            tmp_path,
+            name="dlflaky",
+            target="torchmetrics_tpu.serve.factories:checked_binary_accuracy",
+            poison_threshold=1,
+            backoff_base_s=0.01,
+        )
+        batches, _, _ = _wire_batches()
+        assert stream.offer(0, batches[0])["ok"]
+        with faults.inject(faults.Fault("fail", "deadletter.write", count=1000)):
+            assert stream.offer(1, TestDeadletter._POISON)["ok"]
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                status = stream.status()
+                # `durable` drops only once the persist RETRIES exhaust, a
+                # beat after the quarantine record itself appears
+                if status["deadletter_depth"] == 1 and not status["durable"]:
+                    break
+                time.sleep(0.02)
+            assert status["deadletter_depth"] == 1 and not status["durable"]
+        # disk heals: the next applied batch's recovery probe persists it
+        deadline = time.monotonic() + 30
+        seq = 2
+        while time.monotonic() < deadline:
+            assert stream.offer(seq, batches[seq % len(batches)], block=True, deadline_s=30.0)["ok"]
+            seq += 1
+            if stream.status()["durable"]:
+                break
+            time.sleep(0.1)
+        assert stream.status()["durable"], "deadletter.jsonl never re-persisted"
+        with open(stream.deadletter_path) as fh:
+            assert [json.loads(line)["seq"] for line in fh if line.strip()] == [1]
+        assert stream.drain()["ok"]
 
 
 class TestOps:

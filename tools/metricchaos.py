@@ -1,0 +1,418 @@
+#!/usr/bin/env python
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""metricchaos — chaos-soak harness for the metricserve self-healing plane.
+
+Runs a LIVE ``metricserve`` daemon (a real subprocess, real HTTP control
+plane) under a seeded fault schedule and asserts the self-healing
+invariants end to end::
+
+    # deterministic short soak (tier-1; < 30 s)
+    python tools/metricchaos.py --workdir /tmp/chaos --mode short
+
+    # seeded randomized long soak (the slow drill)
+    python tools/metricchaos.py --workdir /tmp/chaos --mode long --seed 7 --rounds 3
+
+The short soak is two legs:
+
+- **main leg** — one stream fed a schedule mixing a transient worker crash
+  (supervised restart + retained replay), a deterministically poisonous
+  batch (quarantined to ``deadletter.jsonl`` after ``poison_threshold``
+  consecutive kills, cursor skips past it), and a persistent snapshot-write
+  ENOSPC (stream degrades to in-memory-only; ``/healthz`` flips
+  ``degraded``), finished with a daemon **SIGKILL** + fault-free restart +
+  client replay + drain.
+- **circuit leg** — a stream whose worker dies more times than its restart
+  budget parks with the circuit breaker open (``/healthz`` ``stalled``);
+  ``ctl revive`` half-opens it, the probe incarnation succeeds, and the
+  drain completes.
+
+Invariants asserted every leg:
+
+1. zero dropped batches outside the quarantine (``dropped == 0``; a purge
+   is the only sanctioned drop),
+2. drained results are BITWISE equal to an uninterrupted reference run over
+   the same batches minus exactly the quarantined seqs,
+3. the poison batch sits in ``deadletter.jsonl`` with its error and attempt
+   count,
+4. ``/healthz`` reflects ``degraded`` / ``stalled`` / ``ok`` at the right
+   times.
+
+The long soak replays the same leg logic ``--rounds`` times with
+seed-derived randomized parameters (crash timing, poison position, ENOSPC
+window, kill point) — randomness picks the schedule, every schedule is
+still deterministic inside the daemon (``TM_TPU_FAULTS`` is hit-counted,
+never coin-flipped), so any failing round reproduces from its printed
+parameters.
+
+This tool never imports jax (or torchmetrics_tpu): the daemon subprocess
+pays that import, the harness speaks plain HTTP — it runs anywhere
+``metricserve ctl`` runs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SERVE = os.path.join(_REPO_ROOT, "tools", "metricserve.py")
+_CHECKED = "torchmetrics_tpu.serve.factories:checked_binary_accuracy"
+
+
+class ChaosFailure(AssertionError):
+    """An invariant the soak asserts did not hold."""
+
+
+def _check(cond, message: str) -> None:
+    if not cond:
+        raise ChaosFailure(message)
+
+
+# ----------------------------------------------------------------- daemon
+
+
+class Daemon:
+    """One metricserve subprocess + its parsed ready line."""
+
+    def __init__(self, base_dir: str, env_faults: str = "", timeout_s: float = 120.0) -> None:
+        self.base_dir = base_dir
+        env = dict(os.environ)
+        if env_faults:
+            env["TM_TPU_FAULTS"] = env_faults
+        else:
+            env.pop("TM_TPU_FAULTS", None)
+        self.proc = subprocess.Popen(
+            [sys.executable, _SERVE, "serve", "--base-dir", base_dir, "--no-socket"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env=env,
+            text=True,
+        )
+        deadline = time.monotonic() + timeout_s
+        line = ""
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if line.strip():
+                break
+            if self.proc.poll() is not None:
+                raise ChaosFailure(f"daemon died before its ready line (rc {self.proc.returncode})")
+        ready = json.loads(line)
+        _check(ready.get("ok"), f"daemon ready line not ok: {ready}")
+        self.host, self.port = ready["http"]
+
+    def http(self, method: str, path: str, body=None):
+        data = None if body is None else json.dumps({"v": 1, **body}).encode()
+        req = urllib.request.Request(f"http://{self.host}:{self.port}{path}", data=data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    def healthz(self) -> str:
+        _, body = self.http("GET", "/healthz")
+        return body.get("state", "?")
+
+    def stream_status(self, name: str):
+        _, body = self.http("GET", f"/v1/streams/{name}")
+        return body
+
+    def sigkill(self) -> None:
+        """The drill: no drain, no atexit — only the durable footprint survives."""
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def sigterm(self) -> None:
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+    def ensure_dead(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+
+def _wait(predicate, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.05)
+    raise ChaosFailure(f"timed out after {timeout_s:g}s waiting for {what}")
+
+
+def _ingest(daemon: Daemon, name: str, seq: int, batch, timeout_s: float = 60.0):
+    """HTTP ingest with backpressure retries — the client half of the
+    exactly-once protocol."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        code, reply = daemon.http("POST", f"/v1/streams/{name}/ingest", {"seq": seq, "batch": batch})
+        if reply.get("ok"):
+            return reply
+        err = reply.get("error", {})
+        if err.get("code") == "backpressure" and time.monotonic() < deadline:
+            time.sleep(float(err.get("retry_after_s", 0.05)))
+            continue
+        raise ChaosFailure(f"ingest seq {seq} into {name} failed: {code} {reply}")
+
+
+# ---------------------------------------------------------------- batches
+
+
+def make_batches(n_batches: int, per_batch: int, seed: int):
+    """Seeded wire batches for a binary-accuracy stream — stdlib random only
+    (the harness must run where numpy may not exist)."""
+    rng = random.Random(seed)
+    batches = []
+    for _ in range(n_batches):
+        preds = [round(rng.random(), 6) for _ in range(per_batch)]
+        target = [rng.randint(0, 1) for _ in range(per_batch)]
+        batches.append([preds, target])
+    return batches
+
+
+POISON = [[0.5, 0.5, 0.5, 0.5], [7, 7, 7, 7]]  # clean avals, values outside {0, 1}
+
+
+def _reference_results(workdir: str, batches, seed_tag: str):
+    """The uninterrupted run: a fault-free daemon fed the same (non-poison)
+    batches, drained cleanly — the bitwise truth the chaos leg must match."""
+    base = os.path.join(workdir, f"ref-{seed_tag}")
+    shutil.rmtree(base, ignore_errors=True)
+    daemon = Daemon(base)
+    try:
+        _, reply = daemon.http("POST", "/v1/streams", {
+            "name": "soak", "target": _CHECKED, "snapshot_every_n": 2, "use_feed": False,
+        })
+        _check(reply.get("ok"), f"reference create failed: {reply}")
+        for seq, batch in enumerate(batches):
+            _ingest(daemon, "soak", seq, batch)
+        _, reply = daemon.http("POST", "/v1/streams/soak/drain")
+        _check(reply.get("ok"), f"reference drain failed: {reply}")
+        return reply["results"]
+    finally:
+        daemon.sigterm()
+
+
+# ------------------------------------------------------------------- legs
+
+
+def run_main_leg(workdir: str, seed: int, n_batches: int = 10, crash_after: int = 3,
+                 enospc_after: int = 1, poison_at: int = 6, kill_after: int | None = None):
+    """Transient crash + poison batch + persistent ENOSPC + SIGKILL +
+    fault-free restart + replay + drain; returns the leg's summary dict."""
+    batches = make_batches(n_batches, per_batch=4, seed=seed)
+    lines = list(batches)
+    lines[poison_at] = POISON  # line k is ALWAYS seq k — poison takes a slot
+
+    faults = (
+        f"fail:serve.worker.crash:after={crash_after}:count=1"
+        f";fail:store.write.enospc:after={enospc_after}:count=100000"
+    )
+    base = os.path.join(workdir, f"main-{seed}")
+    shutil.rmtree(base, ignore_errors=True)
+    daemon = Daemon(base, env_faults=faults)
+    observed = {"degraded": False}
+    try:
+        _, reply = daemon.http("POST", "/v1/streams", {
+            "name": "soak", "target": _CHECKED, "snapshot_every_n": 2, "use_feed": False,
+            "poison_threshold": 2, "backoff_base_s": 0.01, "max_restarts": 50,
+        })
+        _check(reply.get("ok"), f"create failed: {reply}")
+        _check(daemon.healthz() == "ok", "healthz should start ok")
+
+        stop_at = len(lines) if kill_after is None else kill_after
+        for seq in range(stop_at):
+            _ingest(daemon, "soak", seq, lines[seq])
+
+        # heal: every acked seq applied or quarantined, quarantine depth 1
+        def healed():
+            status = daemon.stream_status("soak")
+            if observed["degraded"] is False and not status.get("durable", True):
+                observed["degraded"] = True
+            return (
+                status.get("state") == "serving"
+                and status.get("pending") == 0
+                and status.get("deadletter_depth") == 1
+                and status.get("restarts", 0) >= 1
+            ) and status
+        status = _wait(healed, 90.0, "supervised heal + quarantine")
+        _check(status["dropped"] == 0, f"healing dropped batches: {status}")
+
+        # the ENOSPC schedule is persistent: the stream must have degraded
+        _wait(lambda: not daemon.stream_status("soak").get("durable", True), 30.0,
+              "durability to drop under ENOSPC")
+        observed["degraded"] = True
+        _check(daemon.healthz() == "degraded",
+               f"healthz should be degraded under ENOSPC, got {daemon.healthz()}")
+
+        # the quarantine record is durable and carries the evidence
+        _, listing = daemon.http("GET", "/v1/streams/soak/deadletter")
+        _check(listing.get("ok") and listing["depth"] == 1, f"deadletter listing: {listing}")
+        record = listing["deadletter"][0]
+        _check(record["seq"] == poison_at, f"wrong quarantined seq: {record}")
+        _check("expected only the following values" in record["error"],
+               f"quarantine lost its error: {record}")
+        _check(record["attempts"] >= 2, f"quarantine lost its attempts: {record}")
+        dl_path = os.path.join(base, "streams", "soak", "deadletter.jsonl")
+        with open(dl_path) as fh:
+            on_disk = [json.loads(line) for line in fh if line.strip()]
+        _check([r["seq"] for r in on_disk] == [poison_at], f"deadletter.jsonl: {on_disk}")
+
+        resumed_from = status["cursor"]
+        daemon.sigkill()
+    except BaseException:
+        daemon.ensure_dead()
+        raise
+
+    # fault-free restart: spec + store + quarantine re-read from disk; the
+    # client replays exactly the suffix the daemon asks for
+    daemon = Daemon(base)
+    try:
+        status = daemon.stream_status("soak")
+        _check(status.get("ok", True) and status.get("state") == "serving",
+               f"restart did not resume the stream: {status}")
+        next_seq = int(status["next_seq"])
+        _check(next_seq <= len(lines), f"restart over-resumed: {status}")
+        _check(status["deadletter_depth"] == 1, f"quarantine lost across SIGKILL: {status}")
+        for seq in range(next_seq, len(lines)):
+            _ingest(daemon, "soak", seq, lines[seq])
+        _, reply = daemon.http("POST", "/v1/streams/soak/drain")
+        _check(reply.get("ok"), f"post-restart drain failed: {reply}")
+        _check(reply["cursor"] == len(lines), f"drain cursor: {reply}")
+        status = daemon.stream_status("soak")
+        _check(status["dropped"] == 0, f"non-quarantined batches dropped: {status}")
+        _check(daemon.healthz() == "ok", f"healthz should settle ok, got {daemon.healthz()}")
+        got = reply["results"]
+    finally:
+        daemon.sigterm()
+
+    want = _reference_results(
+        os.path.dirname(base), [b for i, b in enumerate(lines) if i != poison_at], f"main-{seed}"
+    )
+    _check(got == want, f"results diverged from the uninterrupted reference: {got} != {want}")
+    return {
+        "leg": "main", "seed": seed, "results": got, "quarantined": [poison_at],
+        "resumed_from": resumed_from, "degraded_observed": observed["degraded"],
+    }
+
+
+def run_circuit_leg(workdir: str, seed: int, n_batches: int = 6):
+    """Exhaust the restart budget → circuit open + /healthz stalled → revive
+    half-opens → probe succeeds → drain parity."""
+    batches = make_batches(n_batches, per_batch=4, seed=seed + 1)
+    base = os.path.join(workdir, f"circuit-{seed}")
+    shutil.rmtree(base, ignore_errors=True)
+    # the first 3 apply attempts die; budget is 2 restarts → the 3rd failure
+    # parks the circuit with the fault NOT yet exhausted... after revive the
+    # 4th attempt is fault-free and the probe incarnation heals
+    daemon = Daemon(base, env_faults="fail:serve.worker.crash:count=3")
+    try:
+        _, reply = daemon.http("POST", "/v1/streams", {
+            "name": "breaker", "target": _CHECKED, "snapshot_every_n": 2, "use_feed": False,
+            "max_restarts": 2, "poison_threshold": 5, "backoff_base_s": 0.01,
+        })
+        _check(reply.get("ok"), f"create failed: {reply}")
+        for seq, batch in enumerate(batches):
+            _ingest(daemon, "breaker", seq, batch)
+
+        def parked():
+            status = daemon.stream_status("breaker")
+            return status.get("state") == "failed" and status.get("circuit") == "open" and status
+        status = _wait(parked, 60.0, "circuit to open after the restart budget")
+        _check(status["dropped"] == 0, f"parking dropped batches: {status}")
+        _check(daemon.healthz() == "stalled", f"healthz should be stalled, got {daemon.healthz()}")
+        code, refused = daemon.http(
+            "POST", "/v1/streams/breaker/ingest", {"seq": status["next_seq"], "batch": batches[0]}
+        )
+        _check(refused.get("error", {}).get("code") == "failed" and "revive" in refused["error"]["message"],
+               f"parked ingest should point at revive: {refused}")
+
+        _, reply = daemon.http("POST", "/v1/streams/breaker/revive")
+        _check(reply.get("ok") and reply.get("revived"), f"revive failed: {reply}")
+
+        def closed():
+            s = daemon.stream_status("breaker")
+            return s.get("state") == "serving" and s.get("circuit") == "closed" and s.get("pending") == 0
+        _wait(closed, 60.0, "the revived probe incarnation to close the circuit")
+        _check(daemon.healthz() == "ok", f"healthz should recover ok, got {daemon.healthz()}")
+
+        _, reply = daemon.http("POST", "/v1/streams/breaker/drain")
+        _check(reply.get("ok") and reply["cursor"] == len(batches), f"drain failed: {reply}")
+        status = daemon.stream_status("breaker")
+        _check(status["dropped"] == 0 and status["restarts"] >= 2, f"final status: {status}")
+        got = reply["results"]
+    finally:
+        daemon.sigterm()
+
+    want = _reference_results(os.path.dirname(base), batches, f"circuit-{seed}")
+    _check(got == want, f"circuit-leg results diverged: {got} != {want}")
+    return {"leg": "circuit", "seed": seed, "results": got, "restarts": status["restarts"]}
+
+
+# ------------------------------------------------------------------- main
+
+
+def run_short(workdir: str, seed: int):
+    return [run_main_leg(workdir, seed), run_circuit_leg(workdir, seed)]
+
+
+def run_long(workdir: str, seed: int, rounds: int):
+    """Seeded randomized soak: each round draws its own fault schedule from
+    the master seed and must uphold the same invariants."""
+    rng = random.Random(seed)
+    reports = []
+    for round_no in range(rounds):
+        n_batches = rng.randint(8, 16)
+        params = {
+            "seed": rng.randint(0, 2**31 - 1),
+            "n_batches": n_batches,
+            "crash_after": rng.randint(1, n_batches - 2),
+            "enospc_after": rng.randint(1, 3),
+            "poison_at": rng.randint(1, n_batches - 2),
+            "kill_after": rng.choice([None, n_batches - 1, n_batches]),
+        }
+        print(json.dumps({"round": round_no, "params": params}), flush=True)
+        reports.append(run_main_leg(workdir, **params))
+        if round_no % 2 == 1:
+            reports.append(run_circuit_leg(workdir, seed=params["seed"]))
+    return reports
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="metricchaos", description=__doc__.split("\n\n")[0])
+    parser.add_argument("--workdir", required=True, help="scratch root for daemon base dirs")
+    parser.add_argument("--mode", choices=("short", "long"), default="short")
+    parser.add_argument("--seed", type=int, default=11)
+    parser.add_argument("--rounds", type=int, default=3, help="long-mode rounds")
+    args = parser.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    try:
+        if args.mode == "short":
+            reports = run_short(args.workdir, args.seed)
+        else:
+            reports = run_long(args.workdir, args.seed, args.rounds)
+    except ChaosFailure as err:
+        print(json.dumps({"ok": False, "invariant": str(err)}), flush=True)
+        return 1
+    print(json.dumps({"ok": True, "mode": args.mode, "legs": reports}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

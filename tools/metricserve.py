@@ -21,6 +21,12 @@ Usage::
     python tools/metricserve.py ctl --http ... drain m1-val
     python tools/metricserve.py ctl --http ... delete m1-val
 
+    # repair verbs (the self-healing plane)
+    python tools/metricserve.py ctl --http ... revive m1-val
+    python tools/metricserve.py ctl --http ... deadletter m1-val list
+    python tools/metricserve.py ctl --http ... deadletter m1-val requeue --seq 7
+    python tools/metricserve.py ctl --http ... deadletter m1-val purge --seq 7
+
 ``serve`` starts a :class:`torchmetrics_tpu.serve.ServeDaemon` over
 ``--base-dir``, restores every stream whose ``spec.json`` survives there
 (restart = resume from the snapshot cursor), prints ONE ready line of JSON
@@ -157,6 +163,14 @@ class _Client:
                 return self.request(
                     "POST", f"/v1/streams/{name}/ingest", {"seq": obj["seq"], "batch": obj["batch"]}
                 )
+            if verb == "deadletter":
+                if obj.get("action", "list") == "list":
+                    return self.request("GET", f"/v1/streams/{name}/deadletter")
+                return self.request(
+                    "POST",
+                    f"/v1/streams/{name}/deadletter",
+                    {"action": obj["action"], "seq": obj.get("seq")},
+                )
             return self.request("POST", f"/v1/streams/{name}/{verb}")
         return self.frame(obj)
 
@@ -200,7 +214,12 @@ def _cmd_ctl(args) -> int:
         return _emit(reply, args.json)
     if args.verb == "replay":
         return _cmd_replay(client, args)
-    if args.verb in ("flush", "drain", "delete"):
+    if args.verb == "deadletter":
+        reply = client.op(
+            {"op": "deadletter", "stream": args.stream, "action": args.action, "seq": args.seq}
+        )
+        return _emit(reply, args.json)
+    if args.verb in ("flush", "drain", "delete", "revive"):
         return _emit(client.op({"op": args.verb, "stream": args.stream}), args.json)
     raise SystemExit(f"unknown ctl verb {args.verb!r}")
 
@@ -208,29 +227,65 @@ def _cmd_ctl(args) -> int:
 def _cmd_replay(client, args) -> int:
     """Stream stdin's newline-JSON batches from the daemon's ``next_seq``:
     line k of the input is ALWAYS seq k, so replaying the same file after a
-    crash skips (as duplicates) everything already persisted."""
+    crash skips (as duplicates) everything already persisted.
+
+    Backpressure is retried with jittered exponential backoff — the server's
+    ``retry_after_s`` is the floor, the delay doubles per consecutive retry
+    (capped at 2s), and jitter desynchronizes replaying clients so they don't
+    re-stampede a recovering stream in lockstep. A batch that stays
+    backpressured past ``--max-retry-s`` cumulative waiting fails loudly with
+    the seq it stalled on."""
+    import random
+    import time
+
     status = client.op({"op": "status", "stream": args.stream})
     if not status.get("ok"):
         return _emit(status, args.json)
     next_seq = int(status["next_seq"])
-    sent = acked = 0
+    max_retry_s = float(getattr(args, "max_retry_s", 60.0))
+    sent = acked = retries = 0
     for k, line in enumerate(sys.stdin):
         line = line.strip()
         if not line:
             continue
         if k < next_seq:
             continue  # already persisted server-side — skip without a round-trip
-        reply = client.op({"op": "ingest", "stream": args.stream, "seq": k, "batch": json.loads(line)})
+        batch = json.loads(line)
+        reply = client.op({"op": "ingest", "stream": args.stream, "seq": k, "batch": batch})
         sent += 1
+        waited = 0.0
+        attempt = 0
         while not reply.get("ok") and reply.get("error", {}).get("code") == "backpressure":
-            import time
-
-            time.sleep(float(reply["error"].get("retry_after_s", 0.05)))
-            reply = client.op({"op": "ingest", "stream": args.stream, "seq": k, "batch": json.loads(line)})
+            floor = float(reply["error"].get("retry_after_s", 0.05))
+            delay = min(2.0, max(floor, floor * (2 ** attempt)))
+            delay += random.uniform(0.0, delay / 2)
+            if waited + delay > max_retry_s:
+                print(
+                    f"error [backpressure]: seq {k} still backpressured after"
+                    f" {waited:.1f}s of retries (--max-retry-s {max_retry_s:g})",
+                    file=sys.stderr,
+                )
+                return 1
+            time.sleep(delay)
+            waited += delay
+            attempt += 1
+            retries += 1
+            reply = client.op({"op": "ingest", "stream": args.stream, "seq": k, "batch": batch})
         if not reply.get("ok"):
             return _emit(reply, args.json)
         acked += 1
-    print(json.dumps({"ok": True, "stream": args.stream, "skipped": next_seq, "sent": sent, "acked": acked}))
+    print(
+        json.dumps(
+            {
+                "ok": True,
+                "stream": args.stream,
+                "skipped": next_seq,
+                "sent": sent,
+                "acked": acked,
+                "retries": retries,
+            }
+        )
+    )
     return 0
 
 
@@ -274,12 +329,29 @@ def main(argv=None) -> int:
 
     rp = ctl_sub.add_parser("replay", help="stream stdin JSONL batches from the daemon's next_seq")
     rp.add_argument("stream")
+    rp.add_argument(
+        "--max-retry-s",
+        type=float,
+        default=60.0,
+        dest="max_retry_s",
+        help="give up on a batch after this much cumulative backpressure waiting (default 60)",
+    )
 
-    for verb in ("flush", "drain", "delete"):
-        v = ctl_sub.add_parser(verb)
+    dl = ctl_sub.add_parser("deadletter", help="poison-batch quarantine: list/requeue/purge")
+    dl.add_argument("stream")
+    dl.add_argument("action", choices=("list", "requeue", "purge"))
+    dl.add_argument("--seq", type=int, default=None, help="record to requeue/purge")
+
+    for verb in ("flush", "drain", "delete", "revive"):
+        v = ctl_sub.add_parser(
+            verb, help="half-open a parked stream's circuit breaker" if verb == "revive" else None
+        )
         v.add_argument("stream")
 
-    for verb_parser in (st, cr, ing, rp, *(ctl_sub.choices[v] for v in ("flush", "drain", "delete"))):
+    for verb_parser in (
+        st, cr, ing, rp, dl,
+        *(ctl_sub.choices[v] for v in ("flush", "drain", "delete", "revive")),
+    ):
         verb_parser.add_argument("--json", action="store_true", help="print raw wire envelopes")
 
     ctl.set_defaults(fn=_cmd_ctl)

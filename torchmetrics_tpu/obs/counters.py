@@ -66,6 +66,21 @@ name                                incremented when
 ``serve.costs_errors``              a per-stream drain-time ``costs.json``
                                     emission failed (I/O; a drain never fails
                                     over its own attribution)
+``serve.worker_crashes``            a stream's worker thread died (any cause);
+                                    the supervisor decides restart vs park
+``serve.worker_restarts``           the supervisor restarted a crashed worker
+                                    (backoff + snapshot-restore + retained-
+                                    buffer replay — exactly-once preserved)
+``serve.circuit_open``              a stream exhausted its restart budget and
+                                    parked with the circuit breaker open
+                                    (``ctl revive`` half-opens it)
+``serve.deadletter``                a poison batch (``poison_threshold``
+                                    consecutive crashes on the same seq) was
+                                    quarantined to ``deadletter.jsonl``
+``store.write_failures``            a snapshot or dead-letter write hit
+                                    ENOSPC/EIO; after the retries the stream
+                                    degrades to in-memory-only until the
+                                    recovery probe lands a write
 ==================================  ==============================================
 
 Increment sites sit behind the same ``trace.ENABLED`` flag as spans, so the

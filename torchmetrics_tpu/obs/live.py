@@ -132,6 +132,12 @@ def sample_probes() -> Dict[str, float]:
 _SEVERITY = {"ok": 0, "stalling": 1, "degraded": 2, "stalled": 3}
 _SEVERITY_NAME = {code: name for name, code in _SEVERITY.items()}
 
+#: numeric gauge codes → names for the serve plane's per-stream gauges
+#: (mirrors serve.stream.STATE_CODES / CIRCUIT_CODES without importing the
+#: serve package — this module stays dependency-light for the ctl plane)
+_STREAM_STATE_NAME = {0: "starting", 1: "serving", 2: "draining", 3: "drained", 4: "failed"}
+_CIRCUIT_NAME = {0: "closed", 1: "half_open", 2: "open"}
+
 _SERVE_HEALTH_RE = re.compile(r"^serve\.(?P<stream>[^.]+)\.health_state$")
 
 
@@ -612,6 +618,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
         "snap_age_s", "snap_bytes", "state_bytes", "occup", "margin_s", "behind_s", "flags",
     )
     rows = [header]
+    stream_rows: List[Tuple[str, ...]] = []
     n_stale = 0
     states: Dict[str, int] = {}
     for status in statuses:
@@ -660,7 +667,34 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
             "-" if behind_s is None else f"{behind_s:.1f}",
             ",".join(flags),
         ))
+        for stream, detail in sorted(group_stream_gauges(gauges).items()):
+            health_code = max(0, min(int(detail.get("health_state", 0)), 3))
+            stream_rows.append((
+                rank,
+                stream,
+                _SEVERITY_NAME[health_code],
+                _STREAM_STATE_NAME.get(int(detail.get("state", 0)), "?"),
+                _fmt_num(detail.get("cursor")),
+                _fmt_num(detail.get("pending")),
+                _fmt_num(detail.get("queue_depth")),
+                _fmt_num(detail.get("restarts")),
+                _CIRCUIT_NAME.get(int(detail.get("circuit_state", 0)), "?"),
+                _fmt_num(detail.get("deadletter_depth")),
+                # durability gauge: 1.0 = snapshots land on disk, 0 = the
+                # stream degraded to in-memory-only (or its dead-letter file
+                # is behind) — the "is my state durable" column
+                "-" if detail.get("durability") is None
+                else ("yes" if detail["durability"] else "NO"),
+                _fmt_num(detail.get("dropped")),
+            ))
     lines = _render_table(rows)
+    if stream_rows:
+        stream_header = (
+            "rank", "stream", "health", "state", "cursor", "pending", "queue",
+            "restarts", "circuit", "deadletter", "durable", "dropped",
+        )
+        lines.append("")
+        lines.extend(_render_table([stream_header, *stream_rows]))
     summary = ", ".join(f"{n} {state}" for state, n in sorted(states.items()))
     lines.append("")
     lines.append(f"{len(statuses)} rank(s): {summary}" + (f"; {n_stale} STALE (> {stale_after_s:.1f}s behind)" if n_stale else ""))
@@ -721,5 +755,7 @@ def format_watch_json(statuses: List[Dict[str, Any]], stale_after_s: float = 10.
                 "health": _SEVERITY_NAME[code],
             }
             stream_row.update(sorted(detail.items()))
+            if "circuit_state" in detail:
+                stream_row["circuit"] = _CIRCUIT_NAME.get(int(detail["circuit_state"]), "?")
             lines.append(json.dumps(stream_row, separators=(",", ":")))
     return "\n".join(lines)

@@ -973,7 +973,10 @@ class SlicedPlan:
                     f"sliced checkpoint leaf {name!r} has shape {got.shape}/{got.dtype},"
                     f" expected {tuple(want.shape)}/{want.dtype}"
                 )
-            return jnp.asarray(got)
+            # jnp.array, not asarray: on CPU asarray can ALIAS the numpy
+            # buffer zero-copy, and the next donated step would overwrite
+            # memory jax does not own while replica broadcasts still read it
+            return jnp.array(got)
 
         fresh = {"members": {}, "table": None, "_update_count": None}
         try:

@@ -190,11 +190,14 @@ def _load_checkpoint(metric: Any, checkpoint: Dict[str, Any], strict: bool = Tru
     from torchmetrics_tpu.sketch.registry import is_sketch_state
 
     def _to_device(v: Any) -> Any:
+        # jnp.array, not asarray: asarray can alias the deserialized numpy
+        # buffer zero-copy on CPU, and a later donated step would overwrite
+        # memory jax does not own (nondeterministic state corruption)
         if isinstance(v, list):
-            return [jnp.asarray(x) for x in v]
+            return [jnp.array(x) for x in v]
         if is_sketch_state(v):  # validation already reconstructed the pytree
-            return jax.tree_util.tree_map(jnp.asarray, v)
-        return jnp.asarray(v)
+            return jax.tree_util.tree_map(jnp.array, v)
+        return jnp.array(v)
 
     for m, validated, count, counters in staged:
         tree = {name: _to_device(v) for name, v in validated.items()}

@@ -51,7 +51,24 @@ point                      kinds                  fires
 ``serve.drain``            fail, delay, preempt   at the top of ``Stream.drain`` — a daemon killed
                                                   mid-drain must restart from the last snapshot
                                                   with no double count
+``serve.worker.crash``     fail, delay, preempt   in the stream worker immediately before a batch
+                                                  is applied — the supervisor must restart the
+                                                  worker and replay the retained batch;
+                                                  ``count >= poison_threshold`` turns the same
+                                                  batch into a dead-letter quarantine drill
+``store.write.enospc``     fail                   in ``CheckpointStore.save`` just before the
+                                                  atomic write — surfaces as ``OSError(ENOSPC)``,
+                                                  the disk-exhaustion degradation drill
+``deadletter.write``       fail                   before a stream's ``deadletter.jsonl`` rewrite —
+                                                  surfaces as ``OSError(ENOSPC)``; quarantine must
+                                                  stay in memory and re-persist when disk recovers
 =========================  =====================  ==================================
+
+Every point above is registered in :data:`KNOWN_POINTS`;
+:func:`install_from_env` rejects a ``TM_TPU_FAULTS`` entry naming anything
+else, so a typo'd chaos schedule fails loudly instead of silently never
+firing. In-process :func:`inject` accepts arbitrary points (tests plant
+private ones).
 
 Faults are scoped with the :func:`inject` context manager (in-process tests)
 or installed from the ``TM_TPU_FAULTS`` environment variable (subprocess
@@ -75,6 +92,32 @@ from dataclasses import dataclass, field
 from typing import Iterator, List, Optional
 
 _KINDS = ("fail", "delay", "corrupt", "truncate", "preempt")
+
+#: every injection point wired into the codebase (the docstring table above).
+#: ``install_from_env`` validates against this set; ``inject``/``install``
+#: deliberately do not, so tests can plant private points.
+KNOWN_POINTS = frozenset(
+    {
+        "sync.attempt",
+        "sync.state_gather",
+        "sync.state_apply",
+        "sync.sketch_state",
+        "gather_arrays.pre",
+        "gather_bytes.pre",
+        "gather_bytes.payload",
+        "update.preempt",
+        "runner.preempt",
+        "store.write.torn",
+        "store.write.enospc",
+        "store.payload",
+        "feed.stage",
+        "serve.accept",
+        "serve.ingest",
+        "serve.drain",
+        "serve.worker.crash",
+        "deadletter.write",
+    }
+)
 
 
 class FaultInjected(RuntimeError):
@@ -213,7 +256,12 @@ def corrupt_index(point: str, n: int) -> Optional[int]:
 
 
 def install_from_env(value: Optional[str] = None) -> List[Fault]:
-    """Parse ``TM_TPU_FAULTS`` (or ``value``) and install the faults it names."""
+    """Parse ``TM_TPU_FAULTS`` (or ``value``) and install the faults it names.
+
+    Entries naming a point outside :data:`KNOWN_POINTS` raise ``ValueError``
+    listing the valid points: a fault that can never fire is a chaos test
+    silently testing nothing.
+    """
     spec = os.environ.get("TM_TPU_FAULTS", "") if value is None else value
     faults: List[Fault] = []
     for item in filter(None, (part.strip() for part in spec.split(";"))):
@@ -221,6 +269,11 @@ def install_from_env(value: Optional[str] = None) -> List[Fault]:
         if len(fields) < 2:
             raise ValueError(f"malformed TM_TPU_FAULTS entry {item!r}: expected 'kind:point[:key=value]*'")
         kind, point, kwargs = fields[0], fields[1], {}
+        if point not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown TM_TPU_FAULTS point {point!r} in {item!r} — it would never fire;"
+                f" known points: {', '.join(sorted(KNOWN_POINTS))}"
+            )
         for opt in fields[2:]:
             key, _, val = opt.partition("=")
             if key not in ("rank", "after", "count", "arg"):

@@ -727,6 +727,17 @@ class StreamingEvaluator:
         cadence snapshots, fault points) — the service's per-ingest step."""
         self._step_impl(batch)
 
+    def serve_skip(self) -> None:
+        """Advance the cursor past ONE batch WITHOUT applying it — the serve
+        plane's poison-batch escape hatch. The skipped seq still moves the
+        durable watermark (window rotation + cadence snapshot run as if the
+        batch had been applied), so a restore after the skip does not ask the
+        client to replay the quarantined batch."""
+        self.cursor += 1
+        if self.window_ring is not None:
+            self.window_ring.observe(self.cursor)
+        self._maybe_snapshot()
+
     def serve_close(self) -> Any:
         """Final snapshot + compute, then release the live probes. The
         returned value is :meth:`~SlicedPlan.compute_all` for plan targets,

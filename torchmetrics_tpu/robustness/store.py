@@ -31,6 +31,7 @@ Inspect a store without a Python process that can import jax with
 """
 from __future__ import annotations
 
+import errno
 import os
 import pickle
 import warnings
@@ -163,6 +164,12 @@ class CheckpointStore:
                 with open(path + ".tmp-torn", "wb") as fh:
                     fh.write(data)
                 raise
+            # disk-exhaustion drill: a full filesystem fails the write with
+            # ENOSPC before anything lands — the degradation path's trigger
+            try:
+                faults.fire("store.write.enospc")
+            except faults.FaultInjected as err:
+                raise OSError(errno.ENOSPC, f"injected disk exhaustion: {err}") from None
         _fmt.atomic_write(path, data)
         manifest["snapshots"].append({"step": step, "file": name, "crc32": crc, "bytes": len(data)})
         if manifest["fingerprint"] is None:

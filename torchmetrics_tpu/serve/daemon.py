@@ -14,9 +14,11 @@ Stream`\\ s under one base directory::
 and exposes two planes:
 
 - **control** — localhost HTTP (``/v1/streams`` CRUD + ingest/flush/drain,
-  ``/healthz`` and ``/metrics`` riding the PR-7 publisher; health is the
-  WORST stream via the ``serve.<name>.health_state`` gauges), port 0 by
-  default so concurrent daemons never collide;
+  plus the repair verbs ``revive`` — half-open a parked stream's circuit
+  breaker — and ``deadletter`` list/requeue/purge for the poison-batch
+  quarantine; ``/healthz`` and ``/metrics`` riding the PR-7 publisher;
+  health is the WORST stream via the ``serve.<name>.health_state`` gauges),
+  port 0 by default so concurrent daemons never collide;
 - **ingest** — a newline-JSON unix-socket fast path (one wire frame per
   line, blocking-with-deadline backpressure instead of HTTP 429 retries).
 
@@ -215,6 +217,28 @@ class ServeDaemon:
             self._emit_costs(name)
         return result
 
+    def revive_stream(self, name: str) -> Dict[str, Any]:
+        """Half-open a parked (circuit-open) stream and retry — the operator
+        verb behind ``ctl revive``."""
+        stream = self._get(name)
+        if stream is None:
+            return wire.error("not_found", f"no stream named {name!r}")
+        return stream.revive()
+
+    def deadletter(self, name: str, action: str = "list", seq: Any = None) -> Dict[str, Any]:
+        """Quarantine management: ``list`` the records, ``requeue`` one back
+        through the exactly-once path, or ``purge`` it for good."""
+        stream = self._get(name)
+        if stream is None:
+            return wire.error("not_found", f"no stream named {name!r}")
+        if action == "list":
+            return stream.deadletter_list()
+        if action == "requeue":
+            return stream.deadletter_requeue(seq)
+        if action == "purge":
+            return stream.deadletter_purge(seq)
+        return wire.error("bad_request", f"unknown deadletter action {action!r} (list|requeue|purge)")
+
     def delete_stream(self, name: str) -> Dict[str, Any]:
         with self._lock:
             stream = self._streams.pop(name, None)
@@ -350,6 +374,13 @@ class ServeDaemon:
                     self._send_json(daemon.flush(name))
                 elif self.command == "POST" and action == "drain":
                     self._send_json(daemon.drain_stream(name))
+                elif self.command == "POST" and action == "revive":
+                    self._send_json(daemon.revive_stream(name))
+                elif self.command == "GET" and action == "deadletter":
+                    self._send_json(daemon.deadletter(name, "list"))
+                elif self.command == "POST" and action == "deadletter":
+                    body = self._body()
+                    self._send_json(daemon.deadletter(name, body.get("action", "list"), body.get("seq")))
                 else:
                     self._send_json(wire.error("bad_request", f"{self.command} {self.path} not supported"))
 
@@ -423,6 +454,10 @@ class ServeDaemon:
             return self.drain_stream(name)
         if op == "delete":
             return self.delete_stream(name)
+        if op == "revive":
+            return self.revive_stream(name)
+        if op == "deadletter":
+            return self.deadletter(name, frame.get("action", "list"), frame.get("seq"))
         return wire.error("bad_request", f"unknown op {op!r}")
 
 
@@ -434,6 +469,7 @@ _ERROR_HTTP_STATUS = {
     "exists": 409,
     "draining": 503,
     "failed": 500,
+    "bad_payload": 400,
     "bad_request": 400,
     "unsupported_version": 400,
 }

@@ -18,6 +18,7 @@ __all__ = [
     "accuracy",
     "binary_accuracy",
     "binary_average_precision",
+    "checked_binary_accuracy",
     "collection",
     "quantile",
     "sliced_accuracy",
@@ -37,6 +38,16 @@ def binary_accuracy(threshold: float = 0.5) -> Any:
     from torchmetrics_tpu.classification import BinaryAccuracy
 
     return BinaryAccuracy(threshold=threshold, validate_args=False)
+
+
+def checked_binary_accuracy(threshold: float = 0.5) -> Any:
+    """Binary accuracy WITH host-side argument validation: a target value
+    outside ``{0, 1}`` raises in the worker. Shape/dtype-clean batches with
+    bad values pass wire admission and kill the apply — the deterministic
+    poison batch the dead-letter quarantine drills against."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    return BinaryAccuracy(threshold=threshold, validate_args=True)
 
 
 def binary_average_precision() -> Any:

@@ -25,7 +25,10 @@ Error codes are machine-switchable (:data:`ERROR_CODES`): ``backpressure``
 (queue full — retry after ``retry_after_s``), ``bad_seq`` (gap: the body
 carries ``expected`` so the client can rewind its replay), ``not_found``,
 ``exists``, ``draining`` (daemon is shutting down, nothing new is admitted),
-``failed`` (the stream's worker died — the body carries the cause),
+``failed`` (the stream's worker died or its circuit breaker is open — the
+body carries the cause), ``bad_payload`` (the batch decodes but its
+part count / dtype / trailing shape disagree with the stream's
+first-accepted batch — the body carries ``expected`` and ``got``),
 ``bad_request`` and ``unsupported_version``.
 
 Batches on the wire are JSON lists of (nested) number lists — one entry per
@@ -63,6 +66,7 @@ ERROR_CODES = (
     "exists",
     "draining",
     "failed",
+    "bad_payload",
     "bad_request",
     "unsupported_version",
 )
