@@ -94,13 +94,11 @@ def test_verify_empty_and_broken_manifest(tmp_path, capsys):
     assert "BROKEN" in capsys.readouterr().out
 
 
-def test_verify_standalone_does_not_import_jax(populated_store, tmp_path):
-    """The CI gate: metricdoctor must verify a store on a machine (or in a
-    shell) that cannot import jax — same pattern as metricscope summary."""
-    poison = tmp_path / "poison"
-    poison.mkdir()
-    (poison / "jax.py").write_text("raise ImportError('metricdoctor must not import jax')\n")
-    env = dict(os.environ, PYTHONPATH=str(poison))
+def test_verify_and_list_via_subprocess(populated_store):
+    """metricdoctor verifies a real store through the by-path entry point.
+    (The cannot-import-jax property is gated statically by ML010 plus one
+    poisoned smoke in lint/test_jaxfree_surfaces.py.)"""
+    env = dict(os.environ)
     for argv, needle in (
         (["verify", populated_store.directory], "OK — 3 snapshot(s) verified"),
         (["list", populated_store.directory], "newest step 6"),

@@ -1,0 +1,28 @@
+# Clean twin of the ml009 fixtures: `jnp.array` COPIES at the trust
+# boundary, so the installed/donated values own their storage.
+# PINNED: no rule may fire here.
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _to_device(v: Any) -> Any:
+    if isinstance(v, list):
+        return [jnp.array(x) for x in v]
+    return jnp.array(v)
+
+
+def restore(metric: Any, payload: Dict[str, Any]) -> None:
+    tree = {name: _to_device(v) for name, v in payload.items()}
+    metric._install_state_tree(tree)
+
+
+def step(state, batch):
+    return state + batch.sum()
+
+
+def run(raw_buffer, batch):
+    state = jnp.array(raw_buffer)
+    jitted = jax.jit(step, donate_argnums=0)
+    return jitted(state, batch)

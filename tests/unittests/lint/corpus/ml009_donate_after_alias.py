@@ -1,0 +1,16 @@
+# Synthetic donate-after-alias: the jitted step donates its first argument,
+# but that argument is a zero-copy view of a deserialized numpy buffer —
+# donation frees/overwrites storage jax does not own.
+# PINNED: ML009 must fire here (and nothing else may).
+import jax
+import jax.numpy as jnp
+
+
+def step(state, batch):
+    return state + batch.sum()
+
+
+def run(raw_buffer, batch):
+    state = jnp.asarray(raw_buffer)
+    jitted = jax.jit(step, donate_argnums=0)
+    return jitted(state, batch)

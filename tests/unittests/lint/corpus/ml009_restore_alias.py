@@ -1,0 +1,25 @@
+# The reverted PR-12 checkpoint-restore bug, distilled: `jnp.asarray` can
+# zero-copy alias the numpy buffer the deserializer produced, `_to_device`
+# carries the view through a dict comprehension into `_install_state_tree`,
+# and the next donated step overwrites memory jax does not own.
+# PINNED: ML009 must fire here (and nothing else may).
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _to_device(v: Any) -> Any:
+    if isinstance(v, list):
+        return [jnp.asarray(x) for x in v]
+    return jnp.asarray(v)
+
+
+def restore(metric: Any, payload: Dict[str, Any]) -> None:
+    tree = {name: _to_device(v) for name, v in payload.items()}
+    metric._install_state_tree(tree)
+
+
+def restore_via_tree_map(metric: Any, payload: Dict[str, Any]) -> None:
+    tree = jax.tree_util.tree_map(jnp.asarray, payload)
+    metric._install_state_tree(tree)

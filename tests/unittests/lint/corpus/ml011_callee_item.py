@@ -1,0 +1,17 @@
+# Host-sync hidden one call away: the jit entry point itself is clean, but
+# its helper coerces the traced value with `.item()` — under jit this raises
+# ConcretizationTypeError, and per-file ML002 cannot see it because the
+# helper alone has no jit context.
+# PINNED: ML011 must fire here (and nothing else may).
+import jax
+import jax.numpy as jnp
+
+
+def _normalize(v):
+    scale = v.sum().item()
+    return v / scale
+
+
+@jax.jit
+def entry(x):
+    return _normalize(jnp.abs(x))

@@ -1,0 +1,25 @@
+# Clean twin of ml011_callee_item: the helper stays in jnp-space (no host
+# coercion), and the `.item()` that does exist is fenced behind a static
+# argument, which jit treats as a python value.
+# PINNED: no rule may fire here.
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _normalize(v):
+    scale = v.sum()
+    return v / scale
+
+
+@jax.jit
+def entry(x):
+    return _normalize(jnp.abs(x))
+
+
+@partial(jax.jit, static_argnames=("verbose",))
+def entry_with_static(x, verbose=False):
+    if verbose:
+        pass
+    return _normalize(x)

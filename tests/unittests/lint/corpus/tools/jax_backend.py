@@ -1,0 +1,7 @@
+# Innocent-looking helper that transitively poisons any CLI importing it:
+# the jax import here is module-level, so it executes at import time.
+import jax  # noqa: F401
+
+
+def summarize(values):
+    return jax.numpy.asarray(values).sum()

@@ -1,0 +1,25 @@
+# Clean twin of ml010_fake_cli: the heavy backend is loaded BY PATH inside
+# main() (the deliberate import-graph break every real jax-free CLI uses), so
+# the module-level closure never reaches jax.
+# PINNED: no rule may fire here.
+import importlib.util
+import os
+import sys
+
+
+def _load_backend():
+    path = os.path.join(os.path.dirname(__file__), "jax_backend.py")
+    spec = importlib.util.spec_from_file_location("jax_backend", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def main(argv) -> int:
+    backend = _load_backend()
+    print(backend.summarize([float(a) for a in argv]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

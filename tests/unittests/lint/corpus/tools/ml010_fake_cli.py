@@ -1,0 +1,16 @@
+# A main-guarded CLI that claims to be jax-free but reaches jax through its
+# module-level import closure (via jax_backend) — the class of regression the
+# poisoned-jax subprocess smokes used to catch one CLI at a time.
+# PINNED: ML010 must fire here (and nothing else may).
+import sys
+
+import jax_backend
+
+
+def main(argv) -> int:
+    print(jax_backend.summarize([float(a) for a in argv]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,137 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Pin the ML009-ML012 dataflow rules to the fixture corpus: every bad
+fixture fires EXACTLY its rule, every clean twin stays quiet, and the
+``--diff``/``explain`` CLI surfaces work. The corpus is linted with the
+corpus directory as the lint root so the ``serve/``/``tools/`` path gates
+apply (see ``corpus/README.md``)."""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+_CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "corpus")
+_CLI = os.path.join(_REPO_ROOT, "tools", "metriclint.py")
+
+
+def _load_lint():
+    pkg_dir = os.path.join(_REPO_ROOT, "torchmetrics_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "metriclint_corpus_test", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module  # the package's relative imports need it
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def corpus_violations():
+    lint = _load_lint()
+    return lint.lint_paths([_CORPUS], root=_CORPUS)
+
+
+def _rules_for(violations, rel):
+    return {v.rule for v in violations if v.path == rel}
+
+
+# every pinned (fixture, rule) pair; clean twins pin the empty set
+_PINS = [
+    ("ml009_restore_alias.py", {"ML009"}),
+    ("ml009_donate_after_alias.py", {"ML009"}),
+    ("ml009_clean.py", set()),
+    ("ml011_callee_item.py", {"ML011"}),
+    ("ml011_clean.py", set()),
+    ("serve/ml012_sleep_under_lock.py", {"ML012"}),
+    ("serve/ml012_clean.py", set()),
+    ("tools/ml010_fake_cli.py", {"ML010"}),
+    ("tools/ml010_clean_cli.py", set()),
+    ("tools/jax_backend.py", set()),  # direct jax import = deliberate, exempt
+]
+
+
+@pytest.mark.parametrize(("rel", "expected"), _PINS, ids=[p[0] for p in _PINS])
+def test_fixture_fires_exactly_its_rule(corpus_violations, rel, expected):
+    assert _rules_for(corpus_violations, rel) == expected
+
+
+def test_restore_alias_fixture_is_the_pr12_bug(corpus_violations):
+    """The reverted checkpoint-restore corruption must be findable: asarray
+    aliasing the deserialized payload, carried through a dict comprehension
+    (and a tree_map callback) into ``_install_state_tree``."""
+    hits = [v for v in corpus_violations if v.path == "ml009_restore_alias.py"]
+    assert {v.scope for v in hits} == {"restore", "restore_via_tree_map"}
+    assert all("_install_state_tree" in v.message for v in hits)
+
+
+def test_donate_fixture_names_the_donating_call(corpus_violations):
+    (hit,) = [v for v in corpus_violations if v.path == "ml009_donate_after_alias.py"]
+    assert "donate" in hit.message
+
+
+def test_ml011_anchors_in_the_callee_and_names_the_entry(corpus_violations):
+    (hit,) = [v for v in corpus_violations if v.path == "ml011_callee_item.py"]
+    assert hit.scope == "_normalize"  # the callee, not the jit entry
+    assert "`entry`" in hit.message
+
+
+def test_ml012_flags_both_blocking_ops(corpus_violations):
+    hits = [v for v in corpus_violations if v.path == "serve/ml012_sleep_under_lock.py"]
+    reasons = " | ".join(v.message for v in hits)
+    assert len(hits) == 2
+    assert "time.sleep" in reasons and "open" in reasons
+
+
+def test_ml010_renders_the_import_chain(corpus_violations):
+    (hit,) = [v for v in corpus_violations if v.path == "tools/ml010_fake_cli.py"]
+    assert "jax_backend" in hit.message  # the hop that breaks the contract
+    assert hit.scope == "import-closure"
+
+
+def test_explain_verb_covers_every_rule():
+    lint = _load_lint()
+    assert set(lint.EXPLANATIONS) == set(lint.RULES)
+    out = subprocess.run(
+        [sys.executable, _CLI, "explain", "ML009"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert out.returncode == 0
+    assert "ML009" in out.stdout and "jnp.array" in out.stdout
+    bad = subprocess.run(
+        [sys.executable, _CLI, "explain", "ML999"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert bad.returncode == 2
+
+
+def test_diff_mode_reports_only_changed_files():
+    """--diff lints only the changed set but keeps the graphs package-wide;
+    against HEAD with a pristine tree it must exit clean."""
+    out = subprocess.run(
+        [sys.executable, _CLI, "--diff", "HEAD", "--format", "json"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    if "no lintable files changed" in out.stdout:
+        assert out.returncode == 0
+        return
+    assert out.returncode in (0, 1), out.stderr
+    payload = json.loads(out.stdout)
+    changed = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD", "--"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    ).stdout.split()
+    for violation in payload["new"]:
+        assert violation["path"] in changed
+
+
+def test_diff_mode_refuses_to_write_default_baseline():
+    out = subprocess.run(
+        [sys.executable, _CLI, "--diff", "HEAD", "--write-baseline"],
+        capture_output=True, text=True, cwd=_REPO_ROOT,
+    )
+    assert out.returncode == 2 or "no lintable files changed" in out.stdout

@@ -1,0 +1,93 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""The jax-free surface contract, gated statically with one retained smoke.
+
+ML010 proves from the module-level import closure that no jax-free CLI
+surface can reach jax; ONE poisoned-jax subprocess smoke per surface then
+confirms the static verdict against the real interpreter (import hooks,
+conditional imports and the like). This replaces the per-subcommand
+poisoned-jax boilerplate that used to be duplicated across the metricscope /
+metricdoctor / metricserve / metricchaos test files — the functional
+tests there still exercise real artifacts, just without re-proving the
+import property each time."""
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+# every surface the contract covers: (repo-relative path, smoke argv or None
+# when the surface is a library module with no executable entry)
+_SURFACES = [
+    ("tools/metricscope.py", ["--help"]),
+    ("tools/metricdoctor.py", ["--help"]),
+    ("tools/metricserve.py", ["--help"]),
+    ("tools/metricchaos.py", ["--help"]),
+    ("torchmetrics_tpu/serve/wire.py", None),
+]
+
+
+def _load_lint():
+    pkg_dir = os.path.join(_REPO_ROOT, "torchmetrics_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "metriclint_surfaces_test", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def surface_verdicts():
+    """rel path -> list of ML010 violations, linted with package-wide graphs."""
+    lint = _load_lint()
+    violations = lint.lint_paths(
+        [os.path.join(_REPO_ROOT, rel) for rel, _ in _SURFACES],
+        root=_REPO_ROOT,
+        graph_paths=[os.path.join(_REPO_ROOT, "torchmetrics_tpu"), os.path.join(_REPO_ROOT, "tools")],
+    )
+    return {
+        rel: [v for v in violations if v.path == rel and v.rule == "ML010"]
+        for rel, _ in _SURFACES
+    }
+
+
+@pytest.mark.parametrize(("rel", "smoke"), _SURFACES, ids=[s[0] for s in _SURFACES])
+def test_static_verdict_and_subprocess_smoke_agree(surface_verdicts, rel, smoke, tmp_path):
+    """ML010 must hold the surface jax-unreachable, and the one retained
+    subprocess smoke must agree: the surface runs with jax poisoned."""
+    assert surface_verdicts[rel] == [], "\n".join(v.render() for v in surface_verdicts[rel])
+    if smoke is None:
+        return
+    poison = tmp_path / "poison"
+    poison.mkdir()
+    (poison / "jax.py").write_text(f"raise ImportError('{rel} must not import jax')\n")
+    result = subprocess.run(
+        [sys.executable, os.path.join(_REPO_ROOT, rel), *smoke],
+        capture_output=True, text=True, timeout=60, cwd=_REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=str(poison)),
+    )
+    assert result.returncode == 0, result.stderr
+
+
+def test_ml010_is_not_vacuous():
+    """The static gate only counts if these files actually qualify as
+    surfaces — a predicate regression that silently exempts them would turn
+    the whole contract green forever."""
+    lint = _load_lint()
+    graph_mod = sys.modules["metriclint_surfaces_test.graph"]
+    dataflow_mod = sys.modules["metriclint_surfaces_test.dataflow"]
+    trees = {}
+    modules = graph_mod.ModuleSet(_REPO_ROOT, trees)
+    importgraph = graph_mod.ImportGraph(modules)
+    for rel, _ in _SURFACES:
+        tree = modules.tree(rel)
+        assert tree is not None, rel
+        assert dataflow_mod.is_jaxfree_surface(rel, tree, importgraph), (
+            f"{rel} no longer qualifies as a jax-free surface — ML010 is not checking it"
+        )

@@ -161,11 +161,11 @@ def _write_status_file(directory, rank, epoch_ns, batches=6):
         json.dump(payload, fh)
 
 
-def test_watch_once_standalone_does_not_import_jax(tmp_path):
-    """ISSUE 7 satellite: inspecting live status must never import jax — a
-    poisoned jax on PYTHONPATH crashes any import, and ``watch --once`` still
-    renders both ranks and flags the frozen one as STALE."""
-    env = _poisoned_env(tmp_path)
+def test_watch_once_renders_stale_ranks(tmp_path):
+    """ISSUE 7 satellite: ``watch --once`` renders both ranks and flags the
+    frozen one as STALE. (The never-imports-jax property is gated statically
+    by ML010 plus one poisoned smoke in lint/test_jaxfree_surfaces.py.)"""
+    env = dict(os.environ)
     status_dir = tmp_path / "status"
     status_dir.mkdir()
     now = 1_000_000_000_000_000_000
@@ -257,11 +257,11 @@ def test_diff_standalone_gates_regressions(tmp_path):
     assert result.returncode == 0, result.stdout
 
 
-def test_top_standalone_does_not_import_jax(tmp_path):
+def test_top_reads_both_artifact_shapes(tmp_path):
     """ISSUE 8 satellite: ``top`` reads both artifact shapes — a trace file
-    (ledger rebuilt from events + the embedded counter line) and a costs.json
-    — without ever importing jax."""
-    env = _poisoned_env(tmp_path)
+    (ledger rebuilt from events + the embedded counter line) and a costs.json.
+    (Jax-freeness is gated by ML010 + lint/test_jaxfree_surfaces.py.)"""
+    env = dict(os.environ)
     trace_path = str(tmp_path / "t.jsonl")
     compile_span = {
         "type": "span", "name": "sharded.compile", "ts": 10, "dur": 2_000_000, "tid": 1, "depth": 0,
@@ -387,19 +387,16 @@ def test_bench_append_warns_on_missing_fingerprint(tmp_path):
     assert result.returncode == 2 and "no provenance fingerprint" in result.stdout
 
 
-def test_summary_standalone_does_not_import_jax(tmp_path):
+def test_summary_loads_obs_from_files(tmp_path):
     """The summary/chrome subcommands load obs from its files — a trace can be
-    inspected on a machine (or in a shell) without paying the jax import."""
+    inspected without the live runtime. (Jax-freeness is gated by ML010 +
+    lint/test_jaxfree_surfaces.py.)"""
     path = str(tmp_path / "tiny.trace.jsonl")
     with open(path, "w") as fh:
         fh.write(json.dumps({"type": "span", "name": "metric.update", "ts": 0, "dur": 1000000,
                              "tid": 1, "depth": 0, "args": {"metric": "Accuracy", "n": 1}}) + "\n")
         fh.write(json.dumps({"type": "counters", "counters": {"sharded.cache.hit": 2}, "gauges": {}}) + "\n")
-    # a poisoned jax module on PYTHONPATH turns any jax import into a crash
-    poison = tmp_path / "poison"
-    poison.mkdir()
-    (poison / "jax.py").write_text("raise ImportError('metricscope summary must not import jax')\n")
-    env = dict(os.environ, PYTHONPATH=str(poison))
+    env = dict(os.environ)
     result = subprocess.run(
         [sys.executable, "-c", "import runpy, sys; sys.argv=[sys.argv[1]]+sys.argv[2:];"
          " runpy.run_path(sys.argv[0], run_name='__main__')", CLI_PATH, "summary", path],

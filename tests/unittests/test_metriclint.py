@@ -20,6 +20,8 @@ from torchmetrics_tpu.lint import (
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 PACKAGE = os.path.join(REPO_ROOT, "torchmetrics_tpu")
+TOOLS = os.path.join(REPO_ROOT, "tools")
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lint", "corpus")
 BASELINE = os.path.join(REPO_ROOT, "tools", "metriclint_baseline.json")
 
 _SEEDED_BAD_METRIC = '''
@@ -85,7 +87,7 @@ def seeded_sliced(n_cohorts):
 
 
 def test_package_is_clean_against_committed_baseline():
-    violations = lint_paths([PACKAGE], root=REPO_ROOT)
+    violations = lint_paths([PACKAGE, TOOLS], root=REPO_ROOT)
     baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
     new, _stale = diff_against_baseline(violations, baseline)
     assert not new, "new metriclint violations (fix or suppress with a reason):\n" + "\n".join(
@@ -96,10 +98,23 @@ def test_package_is_clean_against_committed_baseline():
 def test_committed_baseline_entries_still_exist():
     """A stale baseline hides future regressions at the same fingerprint —
     keep it ratcheted down."""
-    violations = lint_paths([PACKAGE], root=REPO_ROOT)
+    violations = lint_paths([PACKAGE, TOOLS], root=REPO_ROOT)
     baseline = load_baseline(BASELINE) if os.path.exists(BASELINE) else {}
     _new, stale = diff_against_baseline(violations, baseline)
     assert not stale, f"stale baseline entries, run tools/metriclint.py --write-baseline: {stale}"
+
+
+def test_package_wide_run_stays_under_runtime_budget():
+    """Lint-runtime ratchet: the package-wide run (import graph + call graph
+    + all 12 rules over torchmetrics_tpu/ and tools/) must stay cheap enough
+    to sit in tier-1 and pre-commit hooks. The budget is ~4x the current
+    cost — it catches accidentally-quadratic analyses, not CI jitter."""
+    import time
+
+    start = time.monotonic()
+    lint_paths([PACKAGE, TOOLS], root=REPO_ROOT)
+    elapsed = time.monotonic() - start
+    assert elapsed < 30.0, f"package-wide metriclint took {elapsed:.1f}s (budget 30s)"
 
 
 @pytest.fixture()
@@ -110,8 +125,14 @@ def seeded_file(tmp_path):
 
 
 def test_every_rule_fires_on_seeded_violations(seeded_file, tmp_path):
+    """Every rule must demonstrably fire somewhere, or a silently-broken
+    linter greens the build: ML001-ML008 on the seeded in-line fixture,
+    the dataflow rules ML009-ML012 on the committed corpus (they need the
+    ``serve/``/``tools/`` path gates and cross-file graphs the corpus
+    provides — see tests/unittests/lint/)."""
     violations = lint_paths([seeded_file], root=str(tmp_path))
     fired = {v.rule for v in violations}
+    fired |= {v.rule for v in lint_paths([CORPUS], root=CORPUS)}
     assert fired == set(RULES), f"rules that did not fire: {set(RULES) - fired}"
 
 

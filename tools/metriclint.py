@@ -5,10 +5,17 @@
 
 Usage::
 
-    python tools/metriclint.py torchmetrics_tpu/            # ratchet vs baseline
+    python tools/metriclint.py                              # ratchet vs baseline
     python tools/metriclint.py --format json some_file.py   # machine output
     python tools/metriclint.py --no-baseline torchmetrics_tpu/   # full report
     python tools/metriclint.py --write-baseline             # regenerate ratchet
+    python tools/metriclint.py --diff main                  # changed files only
+    python tools/metriclint.py explain ML009                # rule rationale + fix
+
+The default scope is ``torchmetrics_tpu/`` plus ``tools/``. With ``--diff
+<git-ref>`` only files changed since the ref are REPORTED on, but the import
+and call graphs are still built over the full default scope, so cross-file
+rules (ML009-ML012) stay sound on a partial report set.
 
 Exit status: 0 when no violations above the baseline, 1 otherwise (with
 ``--no-baseline``: 1 when any violation at all), 2 on usage errors.
@@ -22,10 +29,13 @@ import argparse
 import importlib.util
 import json
 import os
+import subprocess
 import sys
+import textwrap
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "tools", "metriclint_baseline.json")
+_DEFAULT_SCOPE = ("torchmetrics_tpu", "tools")
 
 
 def _load_lint_module():
@@ -42,27 +52,84 @@ def _load_lint_module():
     return module
 
 
+def _explain(lint, rule: str) -> int:
+    rule = rule.upper()
+    if rule not in lint.RULES:
+        known = ", ".join(sorted(lint.RULES))
+        print(f"metriclint: unknown rule {rule!r} (known: {known})", file=sys.stderr)
+        return 2
+    print(f"{rule}: {lint.RULES[rule]}")
+    print()
+    print(textwrap.dedent(lint.EXPLANATIONS[rule]).strip())
+    return 0
+
+
+def _changed_files(ref: str):
+    """Paths changed since ``ref`` (committed + worktree), repo-relative."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", ref, "--"],
+        cwd=_REPO_ROOT, capture_output=True, text=True,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(out.stderr.strip() or f"git diff {ref} failed")
+    return [line.strip() for line in out.stdout.splitlines() if line.strip()]
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "explain":
+        lint = _load_lint_module()
+        if len(argv) != 2:
+            print("usage: metriclint explain ML0xx", file=sys.stderr)
+            return 2
+        return _explain(lint, argv[1])
+
     parser = argparse.ArgumentParser(prog="metriclint", description=__doc__.splitlines()[0])
-    parser.add_argument("paths", nargs="*", default=None, help="files/dirs to lint (default: torchmetrics_tpu/)")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files/dirs to lint (default: torchmetrics_tpu/ and tools/)")
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--baseline", default=_DEFAULT_BASELINE, help="ratchet baseline JSON (default: tools/metriclint_baseline.json)")
     parser.add_argument("--no-baseline", action="store_true", help="ignore the baseline; report and fail on every violation")
     parser.add_argument("--write-baseline", action="store_true", help="regenerate the baseline from the current violations and exit 0")
+    parser.add_argument("--diff", metavar="GIT_REF", default=None,
+                        help="report only on files changed since GIT_REF; the import/call"
+                             " graphs are still built over the full default scope")
     args = parser.parse_args(argv)
 
     lint = _load_lint_module()
-    paths = args.paths or [os.path.join(_REPO_ROOT, "torchmetrics_tpu")]
-    violations = lint.lint_paths(paths, root=_REPO_ROOT)
+    default_paths = [os.path.join(_REPO_ROOT, d) for d in _DEFAULT_SCOPE]
 
-    explicit_partial_scope = args.paths and [
+    if args.diff is not None:
+        if args.paths:
+            print("metriclint: --diff and explicit paths are mutually exclusive", file=sys.stderr)
+            return 2
+        try:
+            changed = _changed_files(args.diff)
+        except RuntimeError as err:
+            print(f"metriclint: {err}", file=sys.stderr)
+            return 2
+        scope_prefixes = tuple(d + os.sep for d in _DEFAULT_SCOPE)
+        paths = [
+            os.path.join(_REPO_ROOT, rel) for rel in changed
+            if rel.endswith(".py") and rel.startswith(scope_prefixes)
+            and os.path.exists(os.path.join(_REPO_ROOT, rel))
+        ]
+        if not paths:
+            print(f"metriclint: no lintable files changed since {args.diff}")
+            return 0
+        violations = lint.lint_paths(paths, root=_REPO_ROOT, graph_paths=default_paths)
+    else:
+        paths = args.paths or default_paths
+        violations = lint.lint_paths(paths, root=_REPO_ROOT)
+
+    explicit_partial_scope = bool(args.diff) or (args.paths and sorted(
         os.path.normpath(os.path.abspath(p)) for p in args.paths
-    ] != [os.path.join(_REPO_ROOT, "torchmetrics_tpu")]
+    ) != sorted(default_paths))
     if args.write_baseline and explicit_partial_scope and os.path.abspath(args.baseline) == _DEFAULT_BASELINE:
         # a partial-scope run must not clobber the package-wide ratchet
         print(
-            "metriclint: refusing to overwrite the package-wide baseline from an explicit"
-            " path list — rerun without paths, or pass --baseline <file> for a scoped one",
+            "metriclint: refusing to overwrite the package-wide baseline from a partial"
+            " scope — rerun without paths/--diff, or pass --baseline <file> for a scoped one",
             file=sys.stderr,
         )
         return 2
@@ -77,6 +144,9 @@ def main(argv=None) -> int:
     if not args.no_baseline and os.path.exists(args.baseline):
         baseline = lint.load_baseline(args.baseline)
     new, stale = lint.diff_against_baseline(violations, baseline)
+    if explicit_partial_scope:
+        # unreported files' baseline entries are not actually stale
+        stale = {}
 
     if args.format == "json":
         print(json.dumps({

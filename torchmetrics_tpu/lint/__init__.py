@@ -25,6 +25,26 @@ Rules
   metrics) constructed inline in a ``MetricCollection`` — the fused
   evaluation plane (``MetricCollection.fused()``) will refuse them; the rule
   and the runtime ``fusion_report`` apply the same predicate.
+- **ML008** sliced-plane contract at ``SlicedPlan``/``.sliced()`` sites:
+  static int table sizing, integer cohort keys — the runtime predicates
+  (``slice_table_size_reason``/``slice_key_reason``) applied statically.
+- **ML009** donation/alias safety: values built by aliasing constructors
+  (``jnp.asarray``/``frombuffer`` of a pre-existing buffer) must not flow
+  into state installs or donated calls — copy with ``jnp.array`` at the
+  trust boundary (the PR-12 restore-corruption bug class).
+- **ML010** jax-free import closure: main-guarded ``tools/`` CLIs and
+  ``serve/wire.py`` must not reach jax through module-level imports; by-path
+  loads are recognized as intentional breaks.
+- **ML011** transitive host-sync: the ML002/ML004 predicates walked through
+  the call graph from jit entry points into their callees.
+- **ML012** serve-plane lock discipline: no blocking ops under a declared
+  lock in ``serve/``/``obs/live.py``; counters mutate under the lock that
+  guards their readers.
+
+ML009-ML012 ride two package-wide structures built once per run (see
+``graph.py``/``dataflow.py``): a module-level import graph and a call graph.
+``lint_paths(..., graph_paths=...)`` keeps them package-wide when only a
+subset of files is being reported on (the CLI ``--diff`` mode).
 
 Suppress a finding with ``# metriclint: disable=ML00x -- reason`` on the
 offending line (or the line above); whole files opt out of one rule with
@@ -42,4 +62,4 @@ from .engine import (  # noqa: F401
     load_baseline,
     summarize,
 )
-from .rules import RULES  # noqa: F401
+from .rules import EXPLANATIONS, RULES  # noqa: F401

@@ -20,6 +20,8 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from . import dataflow
+from .graph import CallGraph, ImportGraph, ModuleSet
 from .rules import ClassIndex, Violation, check_file
 
 _SUPPRESS_RE = re.compile(r"#\s*metriclint:\s*disable=([A-Z0-9_,\s]+?)(?:\s*--|$)")
@@ -83,17 +85,31 @@ def _parse_suppressions(source: str, tree: ast.Module) -> Tuple[Dict[int, set], 
     return per_line, file_wide
 
 
-def lint_paths(paths: Sequence[str], root: Optional[str] = None) -> List[Violation]:
+def lint_paths(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    graph_paths: Optional[Sequence[str]] = None,
+) -> List[Violation]:
     """Run every rule over ``paths`` (files or directories), honouring
-    suppression comments. Paths in the result are relative to ``root``."""
+    suppression comments. Paths in the result are relative to ``root``.
+
+    ``graph_paths`` widens the ANALYSIS scope without widening the REPORT
+    scope: the class index, import graph and call graph are built over
+    ``paths`` plus ``graph_paths``, but violations are only reported for
+    files in ``paths`` — the ``--diff`` contract (lint the changed files,
+    keep the cross-file rules sound)."""
     root = os.path.abspath(root or os.getcwd())
     # dedup by absolute path: overlapping inputs (dir + file inside it) must
     # not register a file's classes twice, or violations double-count
     files = list(dict.fromkeys(_iter_py_files([os.path.abspath(p) for p in paths])))
+    graph_files = list(
+        dict.fromkeys(_iter_py_files([os.path.abspath(p) for p in graph_paths or []]))
+    )
     sources: Dict[str, str] = {}
     trees: Dict[str, ast.Module] = {}
+    report_rels: List[str] = []
     index = ClassIndex()
-    for fname in files:
+    for fname in dict.fromkeys(files + graph_files):
         try:
             with open(fname, "r", encoding="utf-8") as fh:
                 source = fh.read()
@@ -104,19 +120,43 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None) -> List[Violati
         sources[rel] = source
         trees[rel] = tree
         index.add_file(rel, tree)
+        if fname in set(files):
+            report_rels.append(rel)
     index.finalize()
 
+    # cross-file structures, built ONCE over the full analysis scope; the
+    # module set lazily parses files outside it (a tools CLI importing a
+    # package module resolves even when only the CLI is being linted)
+    modules = ModuleSet(root, trees)
+    importgraph = ImportGraph(modules)
+    callgraph = CallGraph(modules, trees)
+
+    report_set = set(report_rels)
     violations: List[Violation] = []
-    for rel, tree in trees.items():
-        per_line, file_wide = _parse_suppressions(sources[rel], tree)
-        for violation in check_file(rel, tree, index):
-            if violation.rule in file_wide:
-                continue
-            if violation.rule in per_line.get(violation.line, set()):
-                continue
-            violations.append(violation)
-    violations.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
-    return violations
+    for rel in report_rels:
+        tree = trees[rel]
+        violations.extend(check_file(rel, tree, index))
+        violations.extend(dataflow.check_ml010(rel, tree, importgraph))
+        violations.extend(dataflow.check_ml012(rel, tree))
+    # graph-global rules: computed over everything, reported for the report set
+    violations.extend(v for v in dataflow.check_ml009(callgraph) if v.path in report_set)
+    violations.extend(v for v in dataflow.check_ml011(callgraph, index) if v.path in report_set)
+
+    kept: List[Violation] = []
+    suppressions: Dict[str, Tuple[Dict[int, set], set]] = {}
+    for violation in violations:
+        if violation.path not in suppressions:
+            suppressions[violation.path] = _parse_suppressions(
+                sources[violation.path], trees[violation.path]
+            )
+        per_line, file_wide = suppressions[violation.path]
+        if violation.rule in file_wide:
+            continue
+        if violation.rule in per_line.get(violation.line, set()):
+            continue
+        kept.append(violation)
+    kept.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return kept
 
 
 # ------------------------------------------------------------------ baseline

@@ -24,6 +24,110 @@ RULES: Dict[str, str] = {
     "ML006": "unbounded cat-list state on a metric claiming full_state_update=False",
     "ML007": "fusion-ineligible metric constructed inside a MetricCollection",
     "ML008": "sliced-plane contract violation at a SlicedPlan construction site",
+    "ML009": "aliased buffer (jnp.asarray/frombuffer) flows into a state install or donated call",
+    "ML010": "jax-free CLI surface reaches jax through its module-level import closure",
+    "ML011": "host-sync coercion of a traced value in a callee of a jit entry point",
+    "ML012": "serve-plane lock discipline: blocking op under a lock, or counter mutated outside it",
+}
+
+#: long-form rationale + fix pattern per rule, printed by
+#: ``tools/metriclint.py explain ML0xx``
+EXPLANATIONS: Dict[str, str] = {
+    "ML001": (
+        "Every attribute assigned in update() must be registered via add_state\n"
+        "(or declared in _host_counters). An unregistered attribute is invisible\n"
+        "to reset/snapshot/restore and leaks tracers under shard_map.\n"
+        "Fix: self.add_state(\"name\", default, dist_reduce_fx=...) in __init__,\n"
+        "or add the name to _host_counters if it is deliberate host bookkeeping."
+    ),
+    "ML002": (
+        "float()/int()/bool()/.item()/.tolist()/`if array:` on a traced array\n"
+        "raises ConcretizationTypeError / TracerBoolConversionError under jit.\n"
+        "Fix: keep the value on-device (jnp.where, lax.cond) or move the\n"
+        "coercion off the jit path (compute(), a host callback)."
+    ),
+    "ML003": (
+        "add_state contracts: dist_reduce_fx must be a valid reduction literal\n"
+        "(see _reduction_names.py), list defaults must be empty, and 'cat'\n"
+        "states must default to [] so per-batch appends keep their identity.\n"
+        "Fix: match the default's type to the reduction."
+    ),
+    "ML004": (
+        "np.* on a traced value forces a host round-trip or raises under jit\n"
+        "where a jnp equivalent exists.\n"
+        "Fix: s/np.<op>/jnp.<op>/ on the traced operand."
+    ),
+    "ML005": (
+        "Metrics stored in set/frozenset are invisible to _walk_metrics (no\n"
+        "stable order), so the deep snapshot/reset/restore silently skips them.\n"
+        "Fix: use a list, tuple, or dict."
+    ),
+    "ML006": (
+        "A dist_reduce_fx='cat' list state grows without bound, which\n"
+        "contradicts a class claiming full_state_update=False (the 'my state\n"
+        "folds cheaply' contract).\n"
+        "Fix: use a bounded sketch state (torchmetrics_tpu.sketch,\n"
+        "dist_reduce_fx='merge')."
+    ),
+    "ML007": (
+        "MetricCollection.fused() refuses members whose update cannot be traced\n"
+        "positionally (kwargs-only signatures, host-state metrics). The rule\n"
+        "flags them at the construction site with the runtime's own predicate.\n"
+        "Fix: give update() a positional batch signature, or keep the metric\n"
+        "out of fused collections."
+    ),
+    "ML008": (
+        "The slice table is a compiled-in shape: num_cells must be a static\n"
+        "positive python int (no floats, no jnp-derived sizing) and cohort keys\n"
+        "must be integer arrays (a float key is a new cohort every batch).\n"
+        "Fix: size with a static int; bucket/hash float features to ints."
+    ),
+    "ML009": (
+        "jnp.asarray / jnp.frombuffer can return a ZERO-COPY view of a foreign\n"
+        "buffer (e.g. the numpy array a checkpoint deserializer produced). If\n"
+        "that view flows into a state install (_install_state_tree,\n"
+        "load_state_tree, setattr, _defaults writes) or into a donated call\n"
+        "(donate_argnums / donate=True), the next donated step overwrites\n"
+        "memory jax does not own — nondeterministic state corruption that only\n"
+        "replay timing can catch at runtime (the PR-12 restore bug).\n"
+        "Fix: copy at the trust boundary — jnp.array(x) (or jnp.array(x,\n"
+        "copy=True)) instead of jnp.asarray(x) when the source buffer is not\n"
+        "jax-owned. Suppress with a written reason when the source is provably\n"
+        "jax-owned or the consumer never donates."
+    ),
+    "ML010": (
+        "Main-guarded CLIs under tools/ (that do not deliberately import jax\n"
+        "directly) and serve/wire.py promise to start without jax — supervisor\n"
+        "hosts cannot import it. This rule computes the transitive MODULE-LEVEL\n"
+        "import closure and fails when jax/jaxlib is reachable, replacing a pile\n"
+        "of poisoned-subprocess tests with one static pass (one subprocess smoke\n"
+        "per surface remains as the end-to-end anchor).\n"
+        "Fix: import jax-side modules lazily inside the handler that needs them,\n"
+        "or load them by file path (importlib.util.spec_from_file_location, the\n"
+        "metricscope idiom) — by-path loads create no import edge and are\n"
+        "recognized as intentional boundary breaks."
+    ),
+    "ML011": (
+        "ML002/ML004 check update()/compute()/kernels directly, but a jit entry\n"
+        "point (a @jax.jit def, or a def passed to jax.jit/shard_map) traces\n"
+        "every function it CALLS. This rule walks the call graph from those\n"
+        "entries, propagates which parameters are traced at each call site, and\n"
+        "runs the same predicates in the callees.\n"
+        "Fix: same as ML002/ML004 — keep values on-device in anything reachable\n"
+        "from a jit entry, or hoist the host coercion out of the traced call\n"
+        "tree."
+    ),
+    "ML012": (
+        "The serve plane (serve/, obs/live.py) is lock-disciplined: a blocking\n"
+        "operation (time.sleep, file I/O, atomic_write, timed queue waits,\n"
+        ".wait()/.acquire()) inside a `with <lock>:` block stalls every thread\n"
+        "contending on that lock; and a counter mutated OUTSIDE the lock that\n"
+        "guards its other accesses races its readers.\n"
+        "Fix: move blocking work outside the critical section (stage under the\n"
+        "lock, write after releasing); move counter mutations under the lock.\n"
+        "Locks that exist purely to serialize writers (not to guard readers)\n"
+        "are legitimate — suppress with a written reason."
+    ),
 }
 
 
@@ -344,13 +448,24 @@ def _root_module(node: ast.expr) -> Optional[str]:
 
 
 class Taint:
-    """Names/attributes in a function body that provably hold jax arrays."""
+    """Names/attributes in a function body that provably hold jax arrays.
 
-    def __init__(self, fn: ast.FunctionDef, self_states: Optional[Set[str]] = None) -> None:
+    ``extra_names`` pre-taints additional parameters — the call-graph rules
+    (ML011) use it to induce taint proven at a CALL SITE rather than by an
+    annotation in this function's own signature."""
+
+    def __init__(
+        self,
+        fn: ast.FunctionDef,
+        self_states: Optional[Set[str]] = None,
+        extra_names: Optional[Set[str]] = None,
+    ) -> None:
         self.self_states = self_states or set()
         self.names: Set[str] = {
             p.arg for p in _fn_params(fn) if _is_array_annotation(p.annotation)
         }
+        if extra_names:
+            self.names |= set(extra_names)
         # fixpoint over assignments; two sweeps catch the chains that occur
         # in practice (a = jnp.f(x); b = a + 1; float(b))
         for _ in range(2):

@@ -229,10 +229,14 @@ class Metric:
             )
         elif not isinstance(default, list) or default:
             if isinstance(default, (int, float)):
-                default = jnp.asarray(default, dtype=self._dtype if isinstance(default, float) else None)
+                default = jnp.array(default, dtype=self._dtype if isinstance(default, float) else None)
             if not isinstance(default, (jnp.ndarray, np.ndarray, jax.Array)):
                 raise ValueError("state variable must be an array or any empty list (where you can append arrays)")
-            default = jnp.asarray(default)
+            # `jnp.array` (not `asarray`): a zero-copy view of a caller-owned
+            # numpy buffer registered as a state default would be overwritten
+            # in place if that state is ever donated — copy at the trust
+            # boundary (ML009)
+            default = jnp.array(default)
             if getattr(default, "weak_type", False):
                 # Strengthen the dtype: a weak-typed f32 accumulator (e.g.
                 # `jnp.asarray(0.0)`) silently DEGRADES to bf16 on its first
@@ -240,7 +244,7 @@ class Metric:
                 # operand), and every later batch then accumulates in ~3
                 # decimal digits. A committed dtype makes f32 accumulation a
                 # hard boundary for low-precision inputs.
-                default = jnp.asarray(default, dtype=default.dtype)
+                default = jnp.array(default, dtype=default.dtype)
         if dist_reduce_fx is not None and not (dist_reduce_fx in _REDUCTION_MAP or callable(dist_reduce_fx)):
             # generated from the live map so the message can never drift from
             # what the runtime actually accepts (it did once, pre-"merge")
@@ -896,12 +900,16 @@ class Metric:
             name = prefix + key
             if name in state_dict:
                 value = state_dict[name]
+                # `jnp.array` (not `asarray`): on CPU `asarray` can alias the
+                # deserialized numpy buffer, and a later donated step would
+                # overwrite memory JAX does not own — the PR-12 restore
+                # corruption (ML009); copy on install
                 if isinstance(value, list):
-                    setattr(self, key, [jnp.asarray(v) for v in value])
+                    setattr(self, key, [jnp.array(v) for v in value])
                 elif is_sketch_state(value):
-                    setattr(self, key, jax.tree_util.tree_map(jnp.asarray, value))
+                    setattr(self, key, jax.tree_util.tree_map(jnp.array, value))
                 else:
-                    setattr(self, key, jnp.asarray(value))
+                    setattr(self, key, jnp.array(value))
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name!r} in state_dict")
 

@@ -88,6 +88,7 @@ class ServeDaemon:
         self._publish = bool(publish)
         self._rank = rank
         self._streams: Dict[str, Stream] = {}
+        self._creating: set = set()  # names reserved while their dir/store is built
         self._lock = threading.Lock()
         self._accepting = False
         self._owns_publisher = False
@@ -173,9 +174,14 @@ class ServeDaemon:
         except (wire.WireError, ValueError, TypeError) as err:
             return wire.error("bad_request", str(err))
         stream_dir = os.path.join(self.base_dir, "streams", spec.name)
+        # reserve the name under the lock, build the dir/store OUTSIDE it —
+        # holding _lock across the spec write and Stream.start() would stall
+        # every ingest/flush request behind this stream's disk I/O (ML012)
         with self._lock:
-            if spec.name in self._streams:
+            if spec.name in self._streams or spec.name in self._creating:
                 return wire.error("exists", f"stream {spec.name} already exists")
+            self._creating.add(spec.name)
+        try:
             os.makedirs(stream_dir, exist_ok=True)
             with open(os.path.join(stream_dir, "spec.json"), "w") as fh:
                 json.dump(spec.to_wire(), fh, separators=(",", ":"))
@@ -185,7 +191,11 @@ class ServeDaemon:
             except Exception as err:
                 shutil.rmtree(stream_dir, ignore_errors=True)
                 return wire.error("bad_request", f"stream {spec.name} failed to open: {err}")
-            self._streams[spec.name] = stream
+            with self._lock:
+                self._streams[spec.name] = stream
+        finally:
+            with self._lock:
+                self._creating.discard(spec.name)
         return wire.ok(stream=spec.name, next_seq=next_seq)
 
     def _get(self, name: str) -> Optional[Stream]:

@@ -562,9 +562,11 @@ class Stream:
 
     # ------------------------------------------------- disk-fault degradation
     def _note_write_failure(self, err: BaseException) -> None:
-        self.write_failures += 1
         _obs_counters.inc("store.write_failures")
         with self._lock:
+            # counter mutates under the same lock its readers take (ML012) —
+            # writer and recovery-probe threads both call this path
+            self.write_failures += 1
             self.last_failure = f"{type(err).__name__}: {err}"
 
     def _enter_degraded(self) -> None:
@@ -709,6 +711,10 @@ class Stream:
             delay = _DISK_RETRY_BASE_S
             for attempt in range(_DISK_RETRIES + 1):
                 try:
+                    # _dl_write_lock exists ONLY to serialize deadletter-file
+                    # writers; holding it across the write is its purpose and
+                    # no reader/ingest path ever contends on it
+                    # metriclint: disable=ML012 -- dedicated writer-serialization lock
                     self._write_deadletter()
                     self._dl_dirty = False
                     return
@@ -717,6 +723,10 @@ class Stream:
                         raise
                     self._note_write_failure(err)
                     if attempt < _DISK_RETRIES:
+                        # backoff under the dedicated writer-serialization lock
+                        # is intentional: a concurrent writer SHOULD wait out
+                        # the retry window rather than race the rewrite
+                        # metriclint: disable=ML012 -- intentional backoff under writer lock
                         time.sleep(delay)
                         delay *= 2
             self._dl_dirty = True
@@ -768,6 +778,9 @@ class Stream:
         if applying:
             culprit = int(self.evaluator.cursor)
             if culprit == self._crash_seq:
+                # _crash_seq/_crash_count are confined to the single
+                # supervisor thread; no other thread reads or writes them
+                # metriclint: disable=ML012 -- supervisor-thread-confined counter
                 self._crash_count += 1
             else:
                 self._crash_seq, self._crash_count = culprit, 1
