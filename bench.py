@@ -319,6 +319,7 @@ def main() -> None:
             bench_coco_map,
             bench_coco_map_scale,
             bench_device_telemetry,
+            bench_federated_fold,
             bench_fid50k,
             bench_fused_suite,
             bench_live_publish,
@@ -355,6 +356,9 @@ def main() -> None:
             # sustained multi-stream ingest through the metricserve daemon
             # (ISSUE 14): host+disk only, asserts zero dropped batches
             ("serve_sustained_streams", bench_serve_sustained, (), 45),
+            # two-tier fleet fold rounds over real leaf daemons (ISSUE 17):
+            # host+HTTP only, self-checks fold parity before timing
+            ("federated_fold_throughput", bench_federated_fold, (), 40),
             ("fid50k", bench_fid50k, (), 120),
             ("coco_map_scale", bench_coco_map_scale, (), 180),
             # ssim/ndcg: 64 in-program batches puts the timed region at ~1-2s;

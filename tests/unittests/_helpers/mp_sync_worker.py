@@ -66,6 +66,17 @@ parks with the circuit breaker open, a ``revive`` half-opens it and the
 probe incarnation heals, and the lockstep drains still match the
 uninterrupted single-process result bitwise on both ranks.
 
+A ninth scenario, ``federation``, exercises the two-tier fleet plane
+(ISSUE 17): each rank hosts a leaf :class:`~torchmetrics_tpu.serve.ServeDaemon`
+serving the same stream over its shard while rank 0 additionally runs a
+:class:`~torchmetrics_tpu.serve.FleetAggregator` pulling both leaves over
+HTTP (addresses exchanged through ``TM_TPU_STORE_DIR`` files); rank 1's
+daemon is torn down WITHOUT drain and restarted mid-fold — the restart's
+new epoch exports a lower watermark, so the aggregator must retain the old
+slot (prefix dedup) until the replay passes it — and the drained fleet
+aggregate equals the uninterrupted single-process reference bitwise for
+the elementwise stream and to 1e-6 for the cat stream.
+
 A fourth scenario, ``durable``, exercises preemption-safe evaluation
 (ISSUE 5): on each rank a ``StreamingEvaluator`` accumulates its shard of
 the stream into a per-rank ``CheckpointStore`` (``TM_TPU_STORE_DIR`` set by
@@ -647,6 +658,165 @@ def run_chaos_scenario(pid: int, nproc: int) -> None:
     print(f"rank {pid}: circuit-break + revive drain parity verified")
 
 
+def run_federation_scenario(pid: int, nproc: int) -> None:
+    """Two-tier fleet aggregation under the real 2-process group (ISSUE 17):
+    every rank is a leaf, rank 0 is also the aggregator. Rank 1's leaf dies
+    drainlessly and replays mid-fold; the fleet aggregate must dedup the
+    replayed prefix through the epoch/watermark protocol and match the
+    uninterrupted single-process reference."""
+    import os
+    import time
+
+    import numpy as np
+
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryAveragePrecision
+    from torchmetrics_tpu.serve import FleetAggregator, ServeDaemon
+
+    share = os.environ["TM_TPU_STORE_DIR"]
+    base = os.path.join(share, f"rank{pid}")
+
+    def _signal(name: str) -> None:
+        tmp = os.path.join(share, f".{name}.tmp")
+        with open(tmp, "w") as fh:
+            fh.write("1")
+        os.replace(tmp, os.path.join(share, name))
+
+    def _await(name: str, timeout_s: float = 120.0) -> None:
+        deadline = time.monotonic() + timeout_s
+        path = os.path.join(share, name)
+        while time.monotonic() < deadline:
+            if os.path.exists(path):
+                return
+            time.sleep(0.05)
+        raise AssertionError(f"rank {pid}: timed out waiting for barrier {name!r}")
+
+    rng = np.random.RandomState(42)  # identical on both ranks
+    n_total = 96
+    preds = rng.rand(n_total).astype(np.float32)
+    target = rng.randint(0, 2, n_total)
+    bounds = [0, 60, n_total]  # uneven shards
+    lo, hi = bounds[pid], bounds[pid + 1]
+    n_batches = 6
+    half = n_batches // 2
+    wire = [
+        [p.tolist(), t.tolist()]
+        for p, t in zip(np.array_split(preds[lo:hi], n_batches), np.array_split(target[lo:hi], n_batches))
+    ]
+    specs = {
+        "acc": {"name": "acc", "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                "snapshot_every_n": 2, "use_feed": False},
+        "ap": {"name": "ap", "target": "torchmetrics_tpu.serve.factories:binary_average_precision",
+               "snapshot_every_n": 2, "use_feed": False},
+    }
+
+    def boot(http=":0"):
+        d = ServeDaemon(base, http=http, publish=False).start()
+        for sname in sorted(specs):
+            reply = d.create_stream(specs[sname])
+            assert reply["ok"] or reply["error"]["code"] == "exists", reply
+        return d
+
+    def ingest(d, start, stop):
+        for sname in sorted(specs):
+            for seq in range(start, stop):
+                reply = d.ingest(sname, seq, wire[seq])
+                while not reply.get("ok") and reply.get("error", {}).get("code") == "backpressure":
+                    time.sleep(0.01)
+                    reply = d.ingest(sname, seq, wire[seq])
+                assert reply.get("ok"), reply
+            assert d.flush(sname)["ok"]
+
+    daemon = boot()
+    host, port = daemon.http_address()
+    with open(os.path.join(share, f"addr.rank{pid}"), "w") as fh:
+        fh.write(f"http://{host}:{port}")
+
+    agg = None
+    if pid == 0:
+        for peer in range(nproc):
+            _await(f"addr.rank{peer}")
+        agg = FleetAggregator(os.path.join(share, "agg"), pull_interval_s=0.2, publish=False)
+        agg.start()
+        for peer in range(nproc):
+            url = open(os.path.join(share, f"addr.rank{peer}")).read()
+            assert agg.add_leaf(f"rank{peer}", url)["ok"]
+
+    ingest(daemon, 0, half)
+
+    def _watermarks(status, stream):
+        return [
+            status["leaves"][f"rank{peer}"].get("streams", {}).get(stream, {}).get("watermark", -1)
+            for peer in range(nproc)
+        ]
+
+    if pid == 0:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            status = agg.fleet_status()
+            if all(w >= half for s in specs for w in _watermarks(status, s)):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"first-half watermarks never arrived: {agg.fleet_status()}")
+        _signal("half_folded")
+
+    if pid == 1:
+        # the mid-fold death: drainless teardown (a SIGKILL's durable
+        # footprint), restart AT THE REGISTERED ADDRESS with a fresh epoch,
+        # and replay from the snapshot cursor — the replayed prefix reaches
+        # the aggregator with a LOWER watermark under the new epoch and must
+        # be deduped against the retained slot, never double-counted
+        _await("half_folded")
+        daemon.shutdown(drain=False)
+        daemon = boot(http=f"{host}:{port}")
+        next_seqs = {s["name"]: int(s["next_seq"]) for s in daemon.status()["streams"]}
+        assert all(v <= half for v in next_seqs.values()), f"over-resumed: {next_seqs}"
+        for sname in sorted(specs):
+            for seq in range(next_seqs[sname], n_batches):
+                assert daemon.ingest(sname, seq, wire[seq])["ok"]
+            assert daemon.flush(sname)["ok"]
+    else:
+        ingest(daemon, half, n_batches)
+
+    if pid == 0:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            status = agg.fleet_status()
+            if all(w == n_batches for s in specs for w in _watermarks(status, s)) and all(
+                status["leaves"][f"rank{peer}"]["state"] == "fresh" for peer in range(nproc)
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"fleet never converged: {agg.fleet_status()}")
+
+        result = agg.aggregate()
+        assert result["coverage"] == 1.0, result
+        assert not result["errors"], result
+
+        # the uninterrupted single-process truth, fed in sorted-leaf order
+        acc_ref = BinaryAccuracy(distributed_available_fn=lambda: False)
+        ap_ref = BinaryAveragePrecision(distributed_available_fn=lambda: False)
+        for peer in range(nproc):
+            plo, phi = bounds[peer], bounds[peer + 1]
+            acc_ref.update(preds[plo:phi], target[plo:phi])
+            ap_ref.update(preds[plo:phi], target[plo:phi])
+        want_acc, want_ap = float(acc_ref.compute()), float(ap_ref.compute())
+        got_acc = result["streams"]["acc"]["value"]
+        got_ap = result["streams"]["ap"]["value"]
+        assert got_acc == want_acc, f"fleet elementwise fold: {got_acc} != {want_acc}"
+        assert abs(got_ap - want_ap) < 1e-6, f"fleet cat fold: {got_ap} != {want_ap}"
+        health = agg.health()
+        assert health["state"] == "ok" and health["coverage"] == 1.0, health
+        agg.shutdown()
+        _signal("fleet_verified")
+    else:
+        _await("fleet_verified")
+
+    daemon.shutdown(drain=False)
+    print(f"rank {pid}: federation fold parity verified")
+
+
 def main() -> None:
     pid, nproc, coord = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
     scenario = sys.argv[4] if len(sys.argv) > 4 else "full"
@@ -672,6 +842,9 @@ def main() -> None:
         return
     if scenario == "chaos":
         run_chaos_scenario(pid, nproc)
+        return
+    if scenario == "federation":
+        run_federation_scenario(pid, nproc)
         return
     assert scenario == "full", f"unknown scenario {scenario!r}"
 

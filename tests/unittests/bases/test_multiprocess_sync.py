@@ -215,6 +215,26 @@ def test_two_process_circuit_break_and_revive(tmp_path):
         assert f"rank {pid}: circuit-break + revive drain parity verified" in out, out
 
 
+@pytest.mark.timeout(300)
+def test_two_process_federated_fleet(tmp_path):
+    """The two-tier fleet plane under a REAL 2-process group (ISSUE 17
+    satellite): each rank hosts a leaf daemon, rank 0 additionally runs the
+    fleet aggregator pulling both leaves over HTTP; rank 1's leaf is torn
+    down drainlessly and restarted mid-fold so its replayed prefix arrives
+    under a fresh epoch with a LOWER watermark — the aggregator must dedup
+    it against the retained slot — and the converged fleet aggregate matches
+    the uninterrupted single-process reference (bitwise for the elementwise
+    stream, 1e-6 for the cat stream) at full coverage."""
+    results = _run_workers(
+        "federation",
+        timeout=240,
+        extra_env={"TM_TPU_STORE_DIR": str(tmp_path)},
+    )
+    for pid, (p, out) in enumerate(results):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: federation fold parity verified" in out, out
+
+
 @pytest.mark.timeout(240)
 def test_two_process_injected_faults():
     """The robustness layer under REAL injected faults across the group: a

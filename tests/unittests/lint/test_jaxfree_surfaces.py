@@ -19,14 +19,23 @@ import pytest
 
 _REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
 
-# every surface the contract covers: (repo-relative path, smoke argv or None
+# every surface the contract covers: (repo-relative path, list of smoke argvs
+# — several when distinct subcommand planes must each stay jax-free — or None
 # when the surface is a library module with no executable entry)
 _SURFACES = [
-    ("tools/metricscope.py", ["--help"]),
-    ("tools/metricdoctor.py", ["--help"]),
-    ("tools/metricserve.py", ["--help"]),
-    ("tools/metricchaos.py", ["--help"]),
+    ("tools/metricscope.py", [["--help"]]),
+    ("tools/metricdoctor.py", [["--help"]]),
+    # the fleet ctl verbs (status/add/remove/aggregate/health) are the ops
+    # plane a fleet operator drives from jax-less hosts, same as ctl
+    ("tools/metricserve.py", [["--help"], ["fleet", "--help"]]),
+    ("tools/metricchaos.py", [["--help"]]),
     ("torchmetrics_tpu/serve/wire.py", None),
+]
+
+_SMOKES = [
+    (rel, argv)
+    for rel, smokes in _SURFACES
+    for argv in (smokes or [None])
 ]
 
 
@@ -57,7 +66,10 @@ def surface_verdicts():
     }
 
 
-@pytest.mark.parametrize(("rel", "smoke"), _SURFACES, ids=[s[0] for s in _SURFACES])
+@pytest.mark.parametrize(
+    ("rel", "smoke"), _SMOKES,
+    ids=[f"{rel}:{' '.join(argv)}" if argv else rel for rel, argv in _SMOKES],
+)
 def test_static_verdict_and_subprocess_smoke_agree(surface_verdicts, rel, smoke, tmp_path):
     """ML010 must hold the surface jax-unreachable, and the one retained
     subprocess smoke must agree: the surface runs with jax poisoned."""

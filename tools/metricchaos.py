@@ -13,6 +13,9 @@ invariants end to end::
     # seeded randomized long soak (the slow drill)
     python tools/metricchaos.py --workdir /tmp/chaos --mode long --seed 7 --rounds 3
 
+    # federation drill: leaf fleet + aggregator under kills and corruption
+    python tools/metricchaos.py --workdir /tmp/chaos --mode fleet
+
 The short soak is two legs:
 
 - **main leg** — one stream fed a schedule mixing a transient worker crash
@@ -37,6 +40,14 @@ Invariants asserted every leg:
    count,
 4. ``/healthz`` reflects ``degraded`` / ``stalled`` / ``ok`` at the right
    times.
+
+The **fleet mode** runs the federation drill: N real leaf daemons plus one
+corrupt HTTP stub under a ``fleet serve`` aggregator; a leaf is SIGKILLed
+and restarted mid-fold (its replayed prefix must dedup through the
+epoch/watermark protocol), the aggregator is SIGKILLed and must resume its
+slots from the fold store, the stub stays quarantined, ``/healthz``
+degrades with a coverage reason — and the final fleet aggregate is BITWISE
+equal to a single uninterrupted daemon fed every leaf's batches.
 
 The long soak replays the same leg logic ``--rounds`` times with
 seed-derived randomized parameters (crash timing, poison position, ENOSPC
@@ -83,7 +94,8 @@ def _check(cond, message: str) -> None:
 class Daemon:
     """One metricserve subprocess + its parsed ready line."""
 
-    def __init__(self, base_dir: str, env_faults: str = "", timeout_s: float = 120.0) -> None:
+    def __init__(self, base_dir: str, env_faults: str = "", timeout_s: float = 120.0,
+                 port: int = 0) -> None:
         self.base_dir = base_dir
         env = dict(os.environ)
         if env_faults:
@@ -91,7 +103,8 @@ class Daemon:
         else:
             env.pop("TM_TPU_FAULTS", None)
         self.proc = subprocess.Popen(
-            [sys.executable, _SERVE, "serve", "--base-dir", base_dir, "--no-socket"],
+            [sys.executable, _SERVE, "serve", "--base-dir", base_dir, "--no-socket",
+             "--port", str(port)],
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
             env=env,
@@ -165,7 +178,11 @@ def _ingest(daemon: Daemon, name: str, seq: int, batch, timeout_s: float = 60.0)
             return reply
         err = reply.get("error", {})
         if err.get("code") == "backpressure" and time.monotonic() < deadline:
-            time.sleep(float(err.get("retry_after_s", 0.05)))
+            # jitter on the server's floor: concurrent harness clients (the
+            # fleet mode runs several) must not re-stampede a recovering
+            # stream in lockstep — the same policy as `ctl replay`
+            floor = float(err.get("retry_after_s", 0.05))
+            time.sleep(floor + random.uniform(0.0, floor))
             continue
         raise ChaosFailure(f"ingest seq {seq} into {name} failed: {code} {reply}")
 
@@ -364,11 +381,256 @@ def run_circuit_leg(workdir: str, seed: int, n_batches: int = 6):
     return {"leg": "circuit", "seed": seed, "results": got, "restarts": status["restarts"]}
 
 
+# ------------------------------------------------------------------- fleet
+
+
+class FleetProc:
+    """One fleet-aggregator subprocess (``metricserve fleet serve``) + its
+    parsed ready line."""
+
+    def __init__(self, base_dir: str, leaves=None, pull_interval_s: float = 0.2,
+                 timeout_s: float = 120.0) -> None:
+        self.base_dir = base_dir
+        cmd = [sys.executable, _SERVE, "fleet", "serve", "--base-dir", base_dir,
+               "--pull-interval-s", str(pull_interval_s)]
+        for name, url in sorted((leaves or {}).items()):
+            cmd += ["--leaf", f"{name}={url}"]
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        deadline = time.monotonic() + timeout_s
+        line = ""
+        while time.monotonic() < deadline:
+            line = self.proc.stdout.readline()
+            if line.strip():
+                break
+            if self.proc.poll() is not None:
+                raise ChaosFailure(f"aggregator died before its ready line (rc {self.proc.returncode})")
+        ready = json.loads(line)
+        _check(ready.get("ok"), f"aggregator ready line not ok: {ready}")
+        self.host, self.port = ready["http"]
+        self.epoch = ready.get("epoch")
+
+    def http(self, method: str, path: str, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(f"http://{self.host}:{self.port}{path}", data=data, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    def fleet_status(self):
+        _, body = self.http("GET", "/v1/fleet")
+        return body
+
+    def leaf_state(self, name: str) -> str:
+        return self.fleet_status().get("leaves", {}).get(name, {}).get("state", "?")
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+    def sigterm(self) -> None:
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            self.proc.wait(timeout=120)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+    def ensure_dead(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=30)
+
+
+def _start_corrupt_leaf():
+    """An in-thread HTTP stub that answers ``/v1/state`` with a structurally
+    valid export whose checkpoint carries a FOREIGN fingerprint — the
+    validate-ALL-then-apply ladder must reject it and the aggregator must
+    quarantine the leaf (naming it) without half-folding anything."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    export = {
+        "v": 1, "ok": True, "epoch": "stub-epoch", "streams": {"soak": {
+            "v": 1, "ok": True, "stream": "soak", "watermark": 3, "kind": "metric",
+            "fingerprint": "deadbeefdeadbeef", "windowed": False,
+            "spec": {"target": _CHECKED, "kwargs": {}},
+            "state": {"payload_version": 1, "cursor": 3, "kind": "metric", "checkpoint": {
+                "format_version": 1, "class": "BinaryAccuracy", "fingerprint": "deadbeefdeadbeef",
+                "metrics": {"": {"fingerprint": "deadbeefdeadbeef", "update_count": 3, "state": {
+                    "tp": {"__nd__": "int32", "shape": [], "data": 4},
+                    "fp": {"__nd__": "int32", "shape": [], "data": 2},
+                    "tn": {"__nd__": "int32", "shape": [], "data": 5},
+                    "fn": {"__nd__": "int32", "shape": [], "data": 1},
+                }, "host_counters": {}}},
+            }},
+        }},
+    }
+
+    class _Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            body = json.dumps(export).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever, daemon=True, name="corrupt-leaf")
+    thread.start()
+    host, port = server.server_address[:2]
+    return server, f"http://{host}:{port}"
+
+
+def run_fleet_leg(workdir: str, seed: int, n_leaves: int = 3, n_batches: int = 8):
+    """The federation drill: N real leaves + one corrupt stub leaf under one
+    aggregator; a leaf is SIGKILLed and restarted mid-fold (replayed prefix
+    must dedup via the epoch/watermark protocol), the aggregator itself is
+    SIGKILLed and resumes from its fold store, and the drained fleet
+    aggregate must equal the single-daemon reference bitwise while
+    ``/healthz`` degrades with a coverage reason for the quarantined stub."""
+    batches = make_batches(n_batches * n_leaves, per_batch=4, seed=seed)
+    names = [f"leaf{i}" for i in range(n_leaves)]
+    per_leaf = {name: batches[i * n_batches:(i + 1) * n_batches] for i, name in enumerate(names)}
+    half = n_batches // 2
+    victim = names[min(1, n_leaves - 1)]
+
+    # a restarted leaf must come back at its REGISTERED address (the
+    # aggregator's registry is the source of truth, like any real fleet),
+    # so every leaf gets a pinned port it rebinds across its restart
+    import socket as _socket
+    ports = {}
+    for name in names:
+        with _socket.socket() as sock:
+            sock.bind(("127.0.0.1", 0))
+            ports[name] = sock.getsockname()[1]
+
+    leaves = {}
+    stub_server = agg = None
+    bases = {}
+    try:
+        for name in names:
+            base = os.path.join(workdir, f"fleet-{name}-{seed}")
+            shutil.rmtree(base, ignore_errors=True)
+            bases[name] = base
+            daemon = Daemon(base, port=ports[name])
+            _, reply = daemon.http("POST", "/v1/streams", {
+                "name": "soak", "target": _CHECKED, "snapshot_every_n": 2, "use_feed": False,
+            })
+            _check(reply.get("ok"), f"fleet leaf {name} create failed: {reply}")
+            leaves[name] = daemon
+        stub_server, stub_url = _start_corrupt_leaf()
+
+        agg_base = os.path.join(workdir, f"fleet-agg-{seed}")
+        shutil.rmtree(agg_base, ignore_errors=True)
+        urls = {name: f"http://{d.host}:{d.port}" for name, d in leaves.items()}
+        urls["corrupt"] = stub_url
+        agg = FleetProc(agg_base, leaves=urls)
+
+        # first half everywhere, flushed — the fold is live from here on
+        for name in sorted(leaves):
+            for seq in range(half):
+                _ingest(leaves[name], "soak", seq, per_leaf[name][seq])
+            leaves[name].http("POST", "/v1/streams/soak/flush")
+
+        def _watermark(status_body, leaf):
+            return status_body.get("leaves", {}).get(leaf, {}).get("streams", {}).get(
+                "soak", {}).get("watermark", -1)
+
+        _wait(lambda: all(_watermark(agg.fleet_status(), n) >= half for n in names),
+              90.0, "the aggregator to pull every leaf's first half")
+        _wait(lambda: agg.leaf_state("corrupt") == "quarantined", 60.0,
+              "the corrupt stub to be quarantined")
+
+        # SIGKILL one leaf MID-FOLD; the aggregator must classify it
+        # unreachable while its last slot keeps contributing
+        leaves[victim].sigkill()
+        _wait(lambda: agg.leaf_state(victim) == "unreachable", 60.0,
+              f"{victim} to be classified unreachable")
+        _, health = agg.http("GET", "/healthz")
+        _check(health.get("state") == "degraded", f"fleet health should be degraded: {health}")
+        _check("coverage" in str(health.get("reason")),
+               f"degraded reason should carry the coverage: {health}")
+
+        # restart the victim (restore from snapshot) and replay its suffix —
+        # the replayed prefix must dedup against the retained higher-watermark
+        # slot of the old epoch, never double-count
+        leaves[victim] = Daemon(bases[victim], port=ports[victim])
+        status = leaves[victim].stream_status("soak")
+        next_seq = int(status["next_seq"])
+        _check(next_seq <= half, f"restart over-resumed {victim}: {status}")
+        for name in sorted(leaves):
+            start = next_seq if name == victim else half
+            for seq in range(start, n_batches):
+                _ingest(leaves[name], "soak", seq, per_leaf[name][seq])
+            leaves[name].http("POST", "/v1/streams/soak/flush")
+
+        # SIGKILL the aggregator mid-fold; the restart must resume its slots
+        # and registry from disk instead of re-pulling history
+        pre_kill = agg.fleet_status()
+        _check(pre_kill.get("fold_seq", 0) >= 1, f"no fold state persisted before the kill: {pre_kill}")
+        agg.sigkill()
+        agg = FleetProc(agg_base)  # registry comes from leaves.json, slots from the fold store
+        resumed = agg.fleet_status()
+        _check(set(resumed.get("leaves", {})) == set(urls),
+               f"aggregator restart lost the registry: {sorted(resumed.get('leaves', {}))}")
+
+        _wait(lambda: all(_watermark(agg.fleet_status(), n) == n_batches for n in names),
+              90.0, "every leaf's final watermark to reach the aggregator")
+        _wait(lambda: all(agg.leaf_state(n) == "fresh" for n in names), 60.0,
+              "every real leaf to settle fresh")
+        _wait(lambda: agg.leaf_state("corrupt") == "quarantined", 60.0,
+              "the corrupt stub to stay quarantined after the restart")
+
+        _, agg_reply = agg.http("GET", "/v1/fleet/aggregate")
+        _check(agg_reply.get("ok"), f"aggregate failed: {agg_reply}")
+        expected_coverage = n_leaves / (n_leaves + 1)
+        _check(abs(agg_reply["coverage"] - expected_coverage) < 1e-9,
+               f"coverage should be {expected_coverage}: {agg_reply['coverage']}")
+        _check(agg_reply["leaves"]["corrupt"]["state"] == "quarantined"
+               and "fingerprint" in str(agg_reply["leaves"]["corrupt"]["reason"]),
+               f"quarantine should name the defect: {agg_reply['leaves']['corrupt']}")
+        got = agg_reply["streams"]["soak"]["value"]
+
+        _, health = agg.http("GET", "/healthz")
+        _check(health.get("state") == "degraded" and "corrupt" in str(health.get("reason")),
+               f"health should stay degraded naming the quarantined leaf: {health}")
+    finally:
+        if agg is not None:
+            agg.ensure_dead()
+        if stub_server is not None:
+            stub_server.shutdown()
+            stub_server.server_close()
+        for daemon in leaves.values():
+            daemon.sigterm()
+
+    # the single-daemon truth: one stream fed every leaf's batches grouped in
+    # sorted-leaf order (the fold's deterministic concatenation order)
+    want = _reference_results(
+        workdir, [b for name in sorted(per_leaf) for b in per_leaf[name]], f"fleet-{seed}"
+    )
+    _check(got == want, f"fleet aggregate diverged from the single-daemon reference: {got} != {want}")
+    return {"leg": "fleet", "seed": seed, "aggregate": got, "coverage": expected_coverage,
+            "victim": victim, "quarantined": ["corrupt"]}
+
+
 # ------------------------------------------------------------------- main
 
 
 def run_short(workdir: str, seed: int):
     return [run_main_leg(workdir, seed), run_circuit_leg(workdir, seed)]
+
+
+def run_fleet(workdir: str, seed: int):
+    return [run_fleet_leg(workdir, seed)]
 
 
 def run_long(workdir: str, seed: int, rounds: int):
@@ -396,7 +658,7 @@ def run_long(workdir: str, seed: int, rounds: int):
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="metricchaos", description=__doc__.split("\n\n")[0])
     parser.add_argument("--workdir", required=True, help="scratch root for daemon base dirs")
-    parser.add_argument("--mode", choices=("short", "long"), default="short")
+    parser.add_argument("--mode", choices=("short", "long", "fleet"), default="short")
     parser.add_argument("--seed", type=int, default=11)
     parser.add_argument("--rounds", type=int, default=3, help="long-mode rounds")
     args = parser.parse_args(argv)
@@ -405,6 +667,8 @@ def main(argv=None) -> int:
     try:
         if args.mode == "short":
             reports = run_short(args.workdir, args.seed)
+        elif args.mode == "fleet":
+            reports = run_fleet(args.workdir, args.seed)
         else:
             reports = run_long(args.workdir, args.seed, args.rounds)
     except ChaosFailure as err:

@@ -27,6 +27,15 @@ Usage::
     python tools/metricserve.py ctl --http ... deadletter m1-val requeue --seq 7
     python tools/metricserve.py ctl --http ... deadletter m1-val purge --seq 7
 
+    # federation (two-tier fleet aggregation)
+    python tools/metricserve.py fleet serve --base-dir /tmp/fleet \\
+        --leaf leaf0=http://127.0.0.1:8801 --leaf leaf1=http://127.0.0.1:8802
+    python tools/metricserve.py fleet status --http 127.0.0.1:8900
+    python tools/metricserve.py fleet add --http ... leaf2 http://127.0.0.1:8803
+    python tools/metricserve.py fleet remove --http ... leaf2
+    python tools/metricserve.py fleet aggregate --http ...
+    python tools/metricserve.py fleet health --http ...
+
 ``serve`` starts a :class:`torchmetrics_tpu.serve.ServeDaemon` over
 ``--base-dir``, restores every stream whose ``spec.json`` survives there
 (restart = resume from the snapshot cursor), prints ONE ready line of JSON
@@ -37,7 +46,8 @@ final-compute every stream in sorted order, one last telemetry tick.
 
 ``ctl`` is the client plane: it loads ONLY the wire-schema module by file
 path, so it never imports jax (or even torchmetrics_tpu) — safe on any
-supervisor host. ``replay`` streams newline-JSON batches from stdin over the
+supervisor host. The ``fleet`` verbs other than ``fleet serve`` are equally
+jax-free: they are plain HTTP against the aggregator's control plane. ``replay`` streams newline-JSON batches from stdin over the
 unix socket, asking the daemon for the stream's ``next_seq`` first, so
 re-running the same replay after a crash sends exactly the unpersisted
 suffix (duplicates are acked, nothing double-counts).
@@ -102,6 +112,81 @@ def _cmd_serve(args) -> int:
     results = daemon.shutdown(drain=True)
     print(json.dumps({"ok": True, "drained": sorted(results)}), flush=True)
     return 0
+
+
+# ------------------------------------------------------------------- fleet
+
+
+def _fleet_request(http: str, method: str, path: str, body=None):
+    """One jax-free HTTP round-trip against the aggregator control plane."""
+    import urllib.error
+    import urllib.request
+
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(f"http://{http}{path}", data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as err:
+        return json.loads(err.read())
+
+
+def _cmd_fleet(args) -> int:
+    if args.verb == "serve":
+        sys.path.insert(0, _REPO_ROOT)
+        from torchmetrics_tpu.serve.federation import FleetAggregator
+
+        agg = FleetAggregator(
+            args.base_dir,
+            http=f"{args.host}:{args.port}",
+            pull_interval_s=args.pull_interval_s,
+            fingerprint=args.fingerprint,
+            publish=not args.no_publish,
+        ).start()
+        for pair in args.leaf or ():
+            name, sep, url = pair.partition("=")
+            if not sep:
+                print(json.dumps({"ok": False, "error": {"code": "bad_request",
+                                  "message": f"--leaf wants name=url, got {pair!r}"}}), flush=True)
+                agg.shutdown()
+                return 2
+            reply = agg.add_leaf(name, url)
+            if not reply.get("ok") and reply.get("error", {}).get("code") != "exists":
+                print(json.dumps(reply), flush=True)
+                agg.shutdown()
+                return 1
+        host, port = agg.http_address()
+        print(json.dumps({"ok": True, "http": [host, port], "epoch": agg.epoch,
+                          "leaves": agg.leaves(), "pid": os.getpid()}), flush=True)
+        stop = threading.Event()
+
+        def _graceful(signum, frame) -> None:
+            stop.set()
+
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+        stop.wait()
+        agg.shutdown()
+        print(json.dumps({"ok": True, "stopped": True}), flush=True)
+        return 0
+    if not args.http:
+        raise SystemExit("fleet ctl verbs need --http host:port")
+    if args.verb == "status":
+        return _emit(_fleet_request(args.http, "GET", "/v1/fleet"), args.json)
+    if args.verb == "aggregate":
+        return _emit(_fleet_request(args.http, "GET", "/v1/fleet/aggregate"), args.json)
+    if args.verb == "health":
+        reply = _fleet_request(args.http, "GET", "/healthz")
+        print(json.dumps(reply) if args.json else json.dumps(reply, indent=2))
+        return 0 if reply.get("state") in ("ok", "stalling") else 1
+    if args.verb == "add":
+        return _emit(
+            _fleet_request(args.http, "POST", "/v1/fleet/leaves", {"name": args.name, "url": args.url}),
+            args.json,
+        )
+    if args.verb == "remove":
+        return _emit(_fleet_request(args.http, "DELETE", f"/v1/fleet/leaves/{args.name}"), args.json)
+    raise SystemExit(f"unknown fleet verb {args.verb!r}")
 
 
 # --------------------------------------------------------------------- ctl
@@ -355,6 +440,34 @@ def main(argv=None) -> int:
         verb_parser.add_argument("--json", action="store_true", help="print raw wire envelopes")
 
     ctl.set_defaults(fn=_cmd_ctl)
+
+    fleet = sub.add_parser("fleet", help="two-tier federation: aggregator daemon + leaf registry")
+    fleet_sub = fleet.add_subparsers(dest="verb", required=True)
+
+    fserve = fleet_sub.add_parser("serve", help="run the fleet aggregator (imports jax)")
+    fserve.add_argument("--base-dir", required=True, help="durable root for leaves.json + fold store")
+    fserve.add_argument("--host", default="127.0.0.1")
+    fserve.add_argument("--port", type=int, default=0, help="control-plane port (0 = ephemeral)")
+    fserve.add_argument("--pull-interval-s", type=float, default=1.0, dest="pull_interval_s")
+    fserve.add_argument("--fingerprint", default=None,
+                        help="pin every pull to this registry fingerprint (mismatch quarantines the leaf)")
+    fserve.add_argument("--leaf", action="append", default=[], metavar="NAME=URL",
+                        help="register a leaf at startup (repeatable; already-registered names are kept)")
+    fserve.add_argument("--no-publish", action="store_true", help="do not register the fleet.* live probe")
+
+    fst = fleet_sub.add_parser("status", help="leaf registry, classification and watermarks")
+    fag = fleet_sub.add_parser("aggregate", help="fold the fleet now and print the answer")
+    fhe = fleet_sub.add_parser("health", help="worst-leaf-floored fleet health (exit 1 when degraded)")
+    fad = fleet_sub.add_parser("add", help="register a leaf daemon")
+    fad.add_argument("name")
+    fad.add_argument("url", help="leaf control-plane URL, e.g. http://127.0.0.1:8801")
+    frm = fleet_sub.add_parser("remove", help="deregister a leaf")
+    frm.add_argument("name")
+    for verb_parser in (fst, fag, fhe, fad, frm):
+        verb_parser.add_argument("--http", default=None, help="aggregator control plane host:port")
+        verb_parser.add_argument("--json", action="store_true", help="print raw wire envelopes")
+
+    fleet.set_defaults(fn=_cmd_fleet)
     args = parser.parse_args(argv)
     return args.fn(args)
 

@@ -140,6 +140,12 @@ _CIRCUIT_NAME = {0: "closed", 1: "half_open", 2: "open"}
 
 _SERVE_HEALTH_RE = re.compile(r"^serve\.(?P<stream>[^.]+)\.health_state$")
 
+#: numeric codes of the federation plane's per-leaf ``fleet.leaf.<name>.state``
+#: gauge (mirrors serve.federation.LEAF_STATE_CODES without importing it)
+_LEAF_STATE_NAME = {0: "fresh", 1: "lagging", 2: "unreachable", 3: "quarantined"}
+
+_FLEET_HEALTH_RE = re.compile(r"^fleet\.leaf\.(?P<leaf>[^.]+)\.health_state$")
+
 
 def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[str, Any]:
     """Liveness state from a counter/gauge snapshot (see the module table).
@@ -177,14 +183,28 @@ def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[st
         escalate("stalled", f"watchdog raised StallError {stalls} time(s)")
     for name, value in gauges.items():
         match = _SERVE_HEALTH_RE.match(name)
-        if match is None:
+        if match is not None:
+            code = max(0, min(int(value), 3))
+            if code:
+                escalate(
+                    _SEVERITY_NAME[code],
+                    f"stream {match.group('stream')} is {_SEVERITY_NAME[code]}",
+                )
             continue
-        code = max(0, min(int(value), 3))
-        if code:
-            escalate(
-                _SEVERITY_NAME[code],
-                f"stream {match.group('stream')} is {_SEVERITY_NAME[code]}",
-            )
+        # fleet floor (federation aggregator probe): a process hosting an
+        # aggregator is only as healthy as its sickest leaf
+        match = _FLEET_HEALTH_RE.match(name)
+        if match is not None:
+            code = max(0, min(int(value), 3))
+            if code:
+                leaf = match.group("leaf")
+                leaf_state = _LEAF_STATE_NAME.get(
+                    int(gauges.get(f"fleet.leaf.{leaf}.state", -1)), _SEVERITY_NAME[code]
+                )
+                escalate(_SEVERITY_NAME[code], f"fleet leaf {leaf} is {leaf_state}")
+    coverage = gauges.get("fleet.coverage")
+    if coverage is not None and coverage < 1.0:
+        escalate("degraded", f"fleet coverage {coverage:.2f} — the aggregate is partial")
     return {"state": state, "reason": reason, "http_status": HEALTH_HTTP_STATUS[state]}
 
 
@@ -204,6 +224,23 @@ def group_stream_gauges(gauges: Dict[str, float]) -> Dict[str, Dict[str, Any]]:
         if dot and stream and field:
             streams.setdefault(stream, {})[field] = value
     return streams
+
+
+def group_fleet_gauges(gauges: Dict[str, float]) -> Dict[str, Dict[str, Any]]:
+    """Group ``fleet.leaf.<name>.<field>`` gauges into ``{leaf: {field: v}}``
+    (the federation aggregator's probe). Fleet-global gauges
+    (``fleet.coverage``, ``fleet.leaves``, ``fleet.fold_seq``) are left out;
+    leaf names never contain dots (the aggregator enforces that at
+    ``add_leaf`` time)."""
+    fleet: Dict[str, Dict[str, Any]] = {}
+    for name, value in gauges.items():
+        if not name.startswith("fleet.leaf."):
+            continue
+        rest = name[len("fleet.leaf."):]
+        leaf, dot, field = rest.partition(".")
+        if dot and leaf and field:
+            fleet.setdefault(leaf, {})[field] = value
+    return fleet
 
 
 # ------------------------------------------------------- file-sink plumbing
@@ -345,6 +382,16 @@ class TelemetryPublisher:
                 # lifecycle gauge (serve.stream.STATE_CODES)
                 detail["health"] = _SEVERITY_NAME[code]
             health["streams"] = streams
+        fleet = group_fleet_gauges(gauges)
+        if fleet:
+            for detail in fleet.values():
+                detail["leaf_state"] = _LEAF_STATE_NAME.get(int(detail.get("state", 0)), "fresh")
+                code = max(0, min(int(detail.get("health_state", 0)), 3))
+                detail["health"] = _SEVERITY_NAME[code]
+            health["fleet"] = {
+                "coverage": gauges.get("fleet.coverage"),
+                "leaves": fleet,
+            }
         return health
 
     def render_metrics(self) -> str:
@@ -619,6 +666,7 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
     )
     rows = [header]
     stream_rows: List[Tuple[str, ...]] = []
+    fleet_rows: List[Tuple[str, ...]] = []
     n_stale = 0
     states: Dict[str, int] = {}
     for status in statuses:
@@ -687,6 +735,29 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
                 else ("yes" if detail["durability"] else "NO"),
                 _fmt_num(detail.get("dropped")),
             ))
+        fleet = group_fleet_gauges(gauges)
+        if fleet:
+            # the fleet tree: one aggregator row (coverage + totals), then
+            # one indented row per leaf under it
+            coverage = gauges.get("fleet.coverage")
+            fleet_rows.append((
+                rank,
+                "fleet",
+                "-",
+                "-" if coverage is None else "{:.0f}%".format(100.0 * coverage),
+                _fmt_num(gauges.get("fleet.leaves")),
+                _fmt_num(gauges.get("fleet.fold_seq")),
+            ))
+            for leaf, detail in sorted(fleet.items()):
+                code = max(0, min(int(detail.get("health_state", 0)), 3))
+                fleet_rows.append((
+                    rank,
+                    f"└ {leaf}",
+                    _SEVERITY_NAME[code],
+                    _LEAF_STATE_NAME.get(int(detail.get("state", 0)), "?"),
+                    _fmt_num(detail.get("streams")),
+                    "-",
+                ))
     lines = _render_table(rows)
     if stream_rows:
         stream_header = (
@@ -695,6 +766,10 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
         )
         lines.append("")
         lines.extend(_render_table([stream_header, *stream_rows]))
+    if fleet_rows:
+        fleet_header = ("rank", "fleet/leaf", "health", "state/cov", "streams", "fold_seq")
+        lines.append("")
+        lines.extend(_render_table([fleet_header, *fleet_rows]))
     summary = ", ".join(f"{n} {state}" for state, n in sorted(states.items()))
     lines.append("")
     lines.append(f"{len(statuses)} rank(s): {summary}" + (f"; {n_stale} STALE (> {stale_after_s:.1f}s behind)" if n_stale else ""))
@@ -706,7 +781,8 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
 def format_watch_json(statuses: List[Dict[str, Any]], stale_after_s: float = 10.0) -> str:
     """The ``metricscope watch --json`` output: one compact JSON object per
     line — a ``{"kind": "rank", ...}`` row per status file, followed by a
-    ``{"kind": "stream", ...}`` row per ``serve.<stream>.*`` gauge family the
+    ``{"kind": "stream", ...}`` row per ``serve.<stream>.*`` gauge family and
+    a ``{"kind": "leaf", ...}`` row per ``fleet.leaf.<name>.*`` family the
     rank publishes — so supervisors and ``metricserve ctl status`` consume
     fleet state line-by-line instead of scraping the human table. Staleness
     is the same fleet-relative ``epoch_ns`` comparison as the table."""
@@ -758,4 +834,16 @@ def format_watch_json(statuses: List[Dict[str, Any]], stale_after_s: float = 10.
             if "circuit_state" in detail:
                 stream_row["circuit"] = _CIRCUIT_NAME.get(int(detail["circuit_state"]), "?")
             lines.append(json.dumps(stream_row, separators=(",", ":")))
+        for leaf, detail in sorted(group_fleet_gauges(gauges).items()):
+            code = max(0, min(int(detail.get("health_state", 0)), 3))
+            leaf_row: Dict[str, Any] = {
+                "kind": "leaf",
+                "rank": rank,
+                "leaf": leaf,
+                "health": _SEVERITY_NAME[code],
+                "leaf_state": _LEAF_STATE_NAME.get(int(detail.get("state", 0)), "?"),
+                "coverage": gauges.get("fleet.coverage"),
+            }
+            leaf_row.update(sorted(detail.items()))
+            lines.append(json.dumps(leaf_row, separators=(",", ":")))
     return "\n".join(lines)

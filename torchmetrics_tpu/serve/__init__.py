@@ -9,15 +9,19 @@ plane. Run it with ``python tools/metricserve.py serve``; talk to it —
 without importing jax — with ``python tools/metricserve.py ctl``.
 """
 from torchmetrics_tpu.serve.daemon import ServeDaemon
+from torchmetrics_tpu.serve.federation import FleetAggregator, decode_state
 from torchmetrics_tpu.serve.stream import Stream, StreamSpec, decode_batch, resolve_target
-from torchmetrics_tpu.serve.wire import WIRE_VERSION, WireError
+from torchmetrics_tpu.serve.wire import WIRE_VERSION, WireError, encode_state
 
 __all__ = [
+    "FleetAggregator",
     "ServeDaemon",
     "Stream",
     "StreamSpec",
     "WIRE_VERSION",
     "WireError",
     "decode_batch",
+    "decode_state",
+    "encode_state",
     "resolve_target",
 ]

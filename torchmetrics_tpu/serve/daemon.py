@@ -45,6 +45,7 @@ import os
 import shutil
 import socketserver
 import threading
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.obs import attribution as _obs_attr
@@ -87,6 +88,10 @@ class ServeDaemon:
         self.socket_path = None if socket_path is None else str(socket_path)
         self._publish = bool(publish)
         self._rank = rank
+        #: per-boot nonce stamped on every state export and on ``/healthz`` —
+        #: a federation fold never mixes two boots' windows, and a restarted
+        #: leaf's replayed prefix dedups against the epoch change
+        self.epoch: Optional[str] = None
         self._streams: Dict[str, Stream] = {}
         self._creating: set = set()  # names reserved while their dir/store is built
         self._lock = threading.Lock()
@@ -99,6 +104,9 @@ class ServeDaemon:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "ServeDaemon":
+        # fresh epoch per boot — state exported before a crash can never be
+        # confused with state exported after the restart's replay
+        self.epoch = uuid.uuid4().hex[:12]
         os.makedirs(os.path.join(self.base_dir, "streams"), exist_ok=True)
         if self._publish and not _obs_live.ENABLED:
             _obs_live.enable(directory=os.path.join(self.base_dir, "status"), rank=self._rank)
@@ -263,9 +271,33 @@ class ServeDaemon:
             streams = sorted(self._streams.values(), key=lambda s: s.spec.name)
         return wire.ok(
             accepting=self._accepting,
+            epoch=self.epoch,
             rank=_obs_live._detect_rank() if self._rank is None else self._rank,
             streams=[s.status() for s in streams],
         )
+
+    # ---------------------------------------------------------------- export
+    def export_state(self, name: Optional[str] = None, fingerprint: Optional[str] = None) -> Dict[str, Any]:
+        """The ``/v1/state`` federation verb: per-stream checkpoint payloads
+        stamped with this boot's epoch and each stream's applied-seq
+        watermark. ``name`` narrows to one stream; ``fingerprint`` pins the
+        export to a registry fingerprint (mismatch → ``fingerprint_mismatch``,
+        HTTP 409 — the aggregator quarantines instead of folding a foreign
+        schema)."""
+        if name is not None:
+            stream = self._get(name)
+            if stream is None:
+                return wire.error("not_found", f"no stream named {name!r}")
+            result = stream.export(fingerprint=fingerprint)
+            if result.get("ok"):
+                result["epoch"] = self.epoch
+            return result
+        with self._lock:
+            streams = sorted(self._streams.items())
+        exports: Dict[str, Any] = {}
+        for sname, stream in streams:
+            exports[sname] = stream.export(fingerprint=fingerprint)
+        return wire.ok(epoch=self.epoch, streams=exports)
 
     def _emit_costs(self, name: str) -> None:
         """Per-stream cost ledger at a compute boundary — the attribution
@@ -317,6 +349,13 @@ class ServeDaemon:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _query(self) -> Dict[str, str]:
+                from urllib.parse import parse_qsl
+
+                if "?" not in self.path:
+                    return {}
+                return dict(parse_qsl(self.path.split("?", 1)[1]))
+
             def _body(self) -> Dict[str, Any]:
                 length = int(self.headers.get("Content-Length", 0))
                 obj = wire.decode_frame(self.rfile.read(length)) if length else {}
@@ -333,6 +372,7 @@ class ServeDaemon:
                         health = publisher.health() if publisher else _obs_live.derive_health(
                             {}, _obs_live.sample_probes()
                         )
+                        health["epoch"] = daemon.epoch
                         self._send_json(health, code=health["http_status"])
                     elif self.command == "GET" and path == "/metrics":
                         publisher = _obs_live.publisher()
@@ -345,6 +385,8 @@ class ServeDaemon:
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
+                    elif self.command == "GET" and path == "/v1/state":
+                        self._send_json(daemon.export_state(fingerprint=self._query().get("fingerprint")))
                     elif parts[:2] == ["v1", "streams"]:
                         self._streams_route(parts[2:])
                     else:
@@ -386,6 +428,8 @@ class ServeDaemon:
                     self._send_json(daemon.drain_stream(name))
                 elif self.command == "POST" and action == "revive":
                     self._send_json(daemon.revive_stream(name))
+                elif self.command == "GET" and action == "state":
+                    self._send_json(daemon.export_state(name, fingerprint=self._query().get("fingerprint")))
                 elif self.command == "GET" and action == "deadletter":
                     self._send_json(daemon.deadletter(name, "list"))
                 elif self.command == "POST" and action == "deadletter":
@@ -468,6 +512,8 @@ class ServeDaemon:
             return self.revive_stream(name)
         if op == "deadletter":
             return self.deadletter(name, frame.get("action", "list"), frame.get("seq"))
+        if op == "state":
+            return self.export_state(name, fingerprint=frame.get("fingerprint"))
         return wire.error("bad_request", f"unknown op {op!r}")
 
 
@@ -482,4 +528,5 @@ _ERROR_HTTP_STATUS = {
     "bad_payload": 400,
     "bad_request": 400,
     "unsupported_version": 400,
+    "fingerprint_mismatch": 409,
 }

@@ -891,6 +891,11 @@ class Stream:
                     "cursor": self.evaluator.cursor,
                     "durable": bool(self._durable),
                 })
+            elif op.name == "export":
+                # captured ON the worker thread, so the payload is a
+                # consistent cut: exactly the applied batches, cursor == the
+                # watermark stamped on the slice
+                op.finish(result=self.evaluator._payload())
             elif op.name == "skip":
                 cursor_before = self.evaluator.cursor
                 try:
@@ -1096,6 +1101,50 @@ class Stream:
         if op.error is not None:
             return wire.error("failed", f"flush failed: {op.error}")
         return wire.ok(stream=self.spec.name, **op.result)
+
+    def export(self, timeout_s: float = 60.0, fingerprint: Optional[str] = None) -> Dict[str, Any]:
+        """The ``/v1/state`` verb: a consistent state slice for federation.
+
+        The payload (PR-2 checkpoint format, arrays wire-encoded with their
+        dtypes) is captured on the worker thread via the op queue, so the
+        stamped ``watermark`` is exactly the applied-batch cursor of the
+        serialized state — an aggregator folding it can dedup a restarted
+        leaf's replayed prefix against it. A drained stream exports its
+        final state directly (the worker is gone; nothing mutates it).
+        ``fingerprint`` pins the export: a mismatch is the typed
+        ``fingerprint_mismatch`` error (HTTP 409) instead of a payload the
+        caller would have to reject after the fact.
+        """
+        have = self.evaluator._fingerprint()
+        if fingerprint is not None and fingerprint != have:
+            return wire.error(
+                "fingerprint_mismatch",
+                f"stream {self.spec.name} carries registry fingerprint {have},"
+                f" the export was pinned to {fingerprint}",
+                expected=fingerprint,
+                got=have,
+            )
+        with self._lock:
+            state = self.state
+        if state == "drained":
+            payload = self.evaluator._payload()
+        else:
+            op = self._submit_op("export", timeout_s)
+            if not op.done.wait(timeout_s):
+                return wire.error("failed", f"export of {self.spec.name} timed out after {timeout_s}s")
+            if op.error is not None:
+                return wire.error("failed", f"export failed: {op.error}")
+            payload = op.result
+        return wire.ok(
+            stream=self.spec.name,
+            watermark=int(payload["cursor"]),
+            kind=payload["kind"],
+            fingerprint=have,
+            windowed=self.spec.window is not None,
+            spec={"target": self.spec.target, "kwargs": self.spec.kwargs,
+                  "fused": self.spec.fused, "fused_options": self.spec.fused_options},
+            state=wire.encode_state(payload),
+        )
 
     def drain(self, timeout_s: float = 300.0) -> Dict[str, Any]:
         """Apply every admitted batch, final snapshot + compute; returns the

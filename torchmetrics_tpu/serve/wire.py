@@ -29,7 +29,10 @@ carries ``expected`` so the client can rewind its replay), ``not_found``,
 body carries the cause), ``bad_payload`` (the batch decodes but its
 part count / dtype / trailing shape disagree with the stream's
 first-accepted batch — the body carries ``expected`` and ``got``),
-``bad_request`` and ``unsupported_version``.
+``bad_request``, ``unsupported_version`` and ``fingerprint_mismatch`` (a
+state export was requested pinned to a registry fingerprint the stream does
+not carry — HTTP 409; the federation plane quarantines the leaf instead of
+folding a foreign schema).
 
 Batches on the wire are JSON lists of (nested) number lists — one entry per
 positional update argument; the server decodes them to arrays. A sliced
@@ -37,6 +40,14 @@ stream's batch leads with its integer cohort-key column(s) (the
 ``plan.update(keys, *batch)`` calling convention). JSON numbers round-trip
 binary64 exactly, so results read back from a drain compare bitwise against
 an in-process run.
+
+State payloads (the ``/v1/state`` export verb) carry arrays through
+:func:`encode_state` — a ``{"__nd__": dtype, "shape": [...], "data": ...}``
+marker per array — because a bare ``tolist()`` erases the dtype and the
+strict restore ladder on the aggregator side rightly refuses a float64 tree
+for a float32 metric. Encoding is duck-typed (``dtype``/``shape``/
+``tolist``) so this module stays stdlib-only; decoding needs numpy and lives
+in :mod:`torchmetrics_tpu.serve.federation`.
 """
 from __future__ import annotations
 
@@ -46,6 +57,7 @@ from typing import Any, Dict
 __all__ = [
     "WIRE_VERSION",
     "ERROR_CODES",
+    "ND_KEY",
     "WireError",
     "ok",
     "error",
@@ -53,6 +65,7 @@ __all__ = [
     "decode_frame",
     "check_version",
     "to_jsonable",
+    "encode_state",
 ]
 
 #: bump when a frame/body field changes meaning; the daemon rejects other
@@ -69,7 +82,11 @@ ERROR_CODES = (
     "bad_payload",
     "bad_request",
     "unsupported_version",
+    "fingerprint_mismatch",
 )
+
+#: marker key for a dtype-preserving array in a state payload
+ND_KEY = "__nd__"
 
 
 class WireError(ValueError):
@@ -131,4 +148,37 @@ def to_jsonable(value: Any) -> Any:
             return repr(value)
     if isinstance(value, (int, float, bool, str)) or value is None:
         return value
+    return repr(value)
+
+
+def encode_state(value: Any) -> Any:
+    """A checkpoint/state tree → JSON with dtype-preserving array markers.
+
+    Arrays (anything with ``dtype``/``shape``/``tolist`` — numpy and jax
+    alike, duck-typed so this module never imports either) become
+    ``{"__nd__": "<dtype>", "shape": [...], "data": <nested lists>}``;
+    0-d arrays and numpy scalars ride the same marker with ``"shape": []``.
+    Everything else passes through :func:`to_jsonable` semantics. The
+    decoder (``federation.decode_state``) rebuilds exact-dtype ndarrays, so
+    the strict ``load_state_tree`` ladder accepts the round-trip.
+    """
+    if isinstance(value, dict):
+        return {str(k) if not isinstance(k, str) else k: encode_state(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [encode_state(v) for v in value]
+    if hasattr(value, "dtype") and hasattr(value, "shape") and hasattr(value, "tolist"):
+        return {
+            ND_KEY: str(value.dtype),
+            "shape": [int(d) for d in value.shape],
+            "data": value.tolist(),
+        }
+    if isinstance(value, bytes):
+        return {"__bytes__": value.decode("latin-1")}
+    if isinstance(value, (int, float, bool, str)) or value is None:
+        return value
+    if hasattr(value, "item"):
+        try:
+            return value.item()
+        except Exception:
+            return repr(value)
     return repr(value)
