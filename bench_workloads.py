@@ -394,6 +394,74 @@ def bench_sliced_fanout(n_batches: int = 8, repeats: int = 3) -> Dict:
     }
 
 
+DRIFT_CELLS = 1024  # cohort windows scored per compiled dispatch
+DRIFT_BATCH = 8192  # values per ingest batch spread over the cells
+DRIFT_BINS = 32  # reference/live histogram bins
+
+
+def bench_drift_cohort_windows(n_batches: int = 8, repeats: int = 3) -> Dict:
+    """``drift_cohort_windows``: the drift subsystem multiplied by the
+    sliced plane (ISSUE 18) — ONE ``DriftScore`` fanned out over a
+    1024-cell cohort table. Ingest runs the whole stream as one compiled
+    ``lax.scan`` (per-cohort live histograms in the state carry); the scored
+    dispatch is ``compute_all``: PSI + symmetric-KL + KS for all ~1024
+    cohort-windows against the pinned reference in ONE vmapped program.
+    Headline is windows/s of the scoring dispatch; ``ingest_sps`` rides the
+    record."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.drift import DriftScore
+    from torchmetrics_tpu.parallel import SlicedPlan
+
+    cells, batch, bins = DRIFT_CELLS, DRIFT_BATCH, DRIFT_BINS
+
+    @jax.jit
+    def make_stream(key):
+        kk, kv = jax.random.split(key)
+        keys = jax.random.randint(kk, (n_batches, batch), 0, cells, jnp.int32)
+        vals = 0.5 + 0.1 * jax.random.normal(kv, (n_batches, batch), jnp.float32)
+        return keys, vals
+
+    keys, vals = make_stream(jax.random.key(0))
+    rng = np.random.RandomState(0)
+    reference = rng.normal(0.5, 0.1, 65536).astype(np.float32)
+    plan = SlicedPlan(
+        DriftScore(reference=reference, bins=bins, lo=0.0, hi=1.0,
+                   distributed_available_fn=lambda: False),
+        num_cells=cells,
+    )
+
+    plan.run_scan(keys, (vals,))  # compile + warm the ingest program
+    t0 = time.perf_counter()
+    plan.run_scan(keys, (vals,))
+    np.asarray(plan.state["_update_count"])  # forced materialization bounds the timing
+    ingest_sps = n_batches * batch / (time.perf_counter() - t0)
+
+    jax.tree_util.tree_leaves(plan.compute_all())  # compile + warm the scorer
+    runs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = plan.compute_all()
+        [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(out)]
+        runs.append(cells / (time.perf_counter() - t0))
+    psi = np.asarray(jax.tree_util.tree_leaves(plan.compute_all())[0])
+    return {
+        "runs": runs,
+        "unit": "windows/s",
+        "baseline": None,
+        "ingest_sps": round(ingest_sps, 1),
+        "cells": cells,
+        "batch": batch,
+        "batches": n_batches,
+        "bins": bins,
+        # worst-cell PSI sentinel: small per-cohort windows inflate PSI (the
+        # eps floor dominates sparse bins), so this tracks determinism across
+        # runs rather than asserting "no drift"
+        "psi_max": round(float(np.max(psi)), 4),
+    }
+
+
 def bench_checkpoint_roundtrip(repeats: int = 3) -> Dict:
     """``checkpoint_roundtrip``: durable-snapshot overhead of the
     preemption-safe evaluation layer (ISSUE 5). One timed repeat drives, for

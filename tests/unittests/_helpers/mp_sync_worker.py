@@ -35,6 +35,12 @@ deterministic rank-error bound, and a fault-injected structurally-corrupt
 sketch payload raises ``SyncError`` naming the offending rank on BOTH ranks
 (with clean rollback: the metric heals and syncs once the fault clears).
 
+A ``drift`` scenario exercises the drift subsystem's merge regime (ISSUE
+18): an HLL ``Cardinality`` over overlapping uneven shards syncs to the
+UNION distinct count (idempotent register max) within the published error,
+and a ``DriftScore``'s live histogram pools across ranks so synced scores
+equal the single-process scores on the concatenated stream.
+
 A fifth scenario, ``obs``, exercises the multi-rank observability plane
 (ISSUE 6): each rank traces a replica-synced metric run and exports its own
 JSONL trace (``TM_TPU_TRACE_DIR`` set by the parent) with rank + export-epoch
@@ -266,6 +272,59 @@ def run_sketch_scenario(pid: int, nproc: int) -> None:
     assert abs(healed - float(np.quantile(data, 0.5))) <= 0.05, f"post-fault sync: {healed}"
 
     print(f"rank {pid}: all sketch merge-sync checks passed")
+
+
+def run_drift_scenario(pid: int, nproc: int) -> None:
+    """REAL 2-process merge-sync of the drift subsystem's sketches (ISSUE
+    18): an HLL ``Cardinality`` over overlapping uneven shards syncs to the
+    union distinct count within the published error, and a ``DriftScore``'s
+    live histogram pools across ranks so the synced scores equal the
+    single-process scores on the concatenated stream."""
+    import numpy as np
+
+    from torchmetrics_tpu.drift import Cardinality, DriftScore, drift_scores
+    from torchmetrics_tpu.sketch import hist_init, hist_update, hll_cardinality
+
+    rng = np.random.RandomState(42)  # identical on both ranks
+    import jax.numpy as jnp
+
+    # A) cardinality: uneven OVERLAPPING shards — the union count, not the
+    # sum, within 3x the published relative standard error (idempotent max)
+    n_distinct = 50_000
+    tags = rng.permutation(n_distinct).astype(np.int32)
+    bounds = [(0, 33_000), (25_000, n_distinct)]  # 8k-tag overlap
+    lo, hi = bounds[pid]
+    card = Cardinality(precision=12)
+    card.update(tags[lo:hi])
+    card.sync()
+    est = float(hll_cardinality(card.sketch))
+    assert int(card.sketch.count) == 33_000 + 25_000, f"merged fold count {int(card.sketch.count)}"
+    rel_err = abs(est - n_distinct) / n_distinct
+    assert rel_err <= 3 * card.error_bound(), f"union cardinality {est}: rel err {rel_err}"
+    card.unsync()
+    assert int(card.sketch.count) == hi - lo, "unsync did not restore the local sketch"
+
+    # B) DriftScore: each rank folds its shard of a drifted stream; the
+    # synced live histogram is the pooled window, so the synced scores equal
+    # the single-process scores on the concatenated stream exactly
+    ref_sample = rng.normal(0.5, 0.1, 16_384).astype(np.float32)
+    live_total = rng.normal(0.62, 0.1, 9_000).astype(np.float32)
+    lbounds = [0, 6_000, 9_000]  # uneven split
+    llo, lhi = lbounds[pid], lbounds[pid + 1]
+    ds = DriftScore(reference=ref_sample, bins=32, lo=0.0, hi=1.0, patience=1)
+    ds.update(live_total[llo:lhi])
+    ds.sync()
+    got = ds.compute()
+    reference = hist_update(hist_init(32, 0.0, 1.0), jnp.asarray(ref_sample))
+    pooled = hist_update(hist_init(32, 0.0, 1.0), jnp.asarray(live_total))
+    want = drift_scores(reference, pooled)
+    assert int(ds.live.count) == live_total.size, f"pooled window {int(ds.live.count)}"
+    for name, g, w in zip(("psi", "kl", "ks"), got, want):
+        assert abs(float(g) - float(w)) < 1e-5, f"synced {name}: {float(g)} != {float(w)}"
+    ds.unsync()
+    assert int(ds.live.count) == lhi - llo, "unsync did not restore the local window"
+
+    print(f"rank {pid}: all drift merge-sync checks passed")
 
 
 def run_durable_scenario(pid: int, nproc: int) -> None:
@@ -827,6 +886,9 @@ def main() -> None:
         return
     if scenario == "sketch":
         run_sketch_scenario(pid, nproc)
+        return
+    if scenario == "drift":
+        run_drift_scenario(pid, nproc)
         return
     if scenario == "durable":
         run_durable_scenario(pid, nproc)

@@ -86,6 +86,19 @@ def test_two_process_sketch_merge_sync():
 
 
 @pytest.mark.timeout(240)
+def test_two_process_drift_merge_sync():
+    """The drift subsystem's merge regime under a REAL 2-process group
+    (ISSUE 18 acceptance): an HLL ``Cardinality`` over overlapping uneven
+    shards syncs to the UNION distinct count within the published error
+    bound (idempotent register max — overlap never double-counts), and a
+    ``DriftScore``'s live histogram pools across ranks so the synced
+    PSI/KL/KS equal the single-process scores on the concatenated stream."""
+    for pid, (p, out) in enumerate(_run_workers("drift", timeout=180)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"rank {pid}: all drift merge-sync checks passed" in out, out
+
+
+@pytest.mark.timeout(240)
 def test_two_process_durable_resume(tmp_path):
     """Preemption-safe evaluation under a REAL 2-process group (ISSUE 5
     acceptance): each rank's ``StreamingEvaluator`` is killed at the same

@@ -3,7 +3,9 @@
 """Property suite for the bounded-memory sketch subsystem (ISSUE 4
 acceptance): merge associativity/commutativity up to numerical tolerance,
 jit shape preservation via ``jax.eval_shape``, the KLL deterministic
-rank-error bound on a 1e6-sample stream, ``Quantile``/``Median`` metric
+rank-error bound on a 1e6-sample stream, the HyperLogLog published error on
+1e6 distinct tags, the Count-Min point-query upper-bound property,
+``Quantile``/``Median`` metric
 behavior through every runtime layer (forward, merge-sync, jitted update
 loop, sharded step), and ``SpearmanCorrCoef(num_bins=...)`` agreement with
 exact Spearman while sharded ≡ replicated holds for all ``"merge"`` states."""
@@ -98,6 +100,50 @@ class TestMergeAlgebra:
         np.testing.assert_allclose(float(sk.moments_mean(left)), data.mean(), rtol=1e-5)
         np.testing.assert_allclose(float(sk.moments_variance(left, ddof=1)), data.var(ddof=1), rtol=1e-4)
 
+    def test_hll_exactly_associative_commutative_idempotent(self):
+        chunks = np.split(np.arange(9_000, dtype=np.int32), 3)
+        parts = [sk.hll_update(sk.hll_init(10), c) for c in chunks]
+        left = sk.hll_merge(sk.hll_merge(parts[0], parts[1]), parts[2])
+        right = sk.hll_merge(parts[0], sk.hll_merge(parts[1], parts[2]))
+        np.testing.assert_array_equal(np.asarray(left.registers), np.asarray(right.registers))
+        swapped = sk.hll_merge(parts[1], parts[0])
+        np.testing.assert_array_equal(
+            np.asarray(sk.hll_merge(parts[0], parts[1]).registers), np.asarray(swapped.registers)
+        )
+        # register max is idempotent: folding the same shard twice is a no-op
+        twice = sk.hll_merge(parts[0], parts[0])
+        np.testing.assert_array_equal(np.asarray(twice.registers), np.asarray(parts[0].registers))
+        assert int(left.count) == int(right.count) == 9_000
+
+    def test_hll_merge_equals_union_stream(self):
+        a_data = np.arange(5_000, dtype=np.int32)
+        b_data = np.arange(3_000, 8_000, dtype=np.int32)  # overlaps a
+        merged = sk.hll_merge(sk.hll_update(sk.hll_init(12), a_data), sk.hll_update(sk.hll_init(12), b_data))
+        union = sk.hll_update(sk.hll_init(12), np.concatenate([a_data, b_data]))
+        np.testing.assert_array_equal(np.asarray(merged.registers), np.asarray(union.registers))
+
+    def test_countmin_grid_exactly_associative_commutative(self):
+        chunks = np.split(_RNG.integers(0, 500, size=9_000).astype(np.int32), 3)
+        parts = [sk.cm_update(sk.cm_init(4, 256, k=16), c) for c in chunks]
+        left = sk.cm_merge(sk.cm_merge(parts[0], parts[1]), parts[2])
+        right = sk.cm_merge(parts[0], sk.cm_merge(parts[1], parts[2]))
+        np.testing.assert_array_equal(np.asarray(left.counts), np.asarray(right.counts))
+        swapped = sk.cm_merge(parts[1], parts[0])
+        np.testing.assert_array_equal(
+            np.asarray(sk.cm_merge(parts[0], parts[1]).counts), np.asarray(swapped.counts)
+        )
+        # the merged heavy-hitter table is deterministic under operand order
+        np.testing.assert_array_equal(
+            np.asarray(sk.cm_merge(parts[0], parts[1]).hh_keys), np.asarray(swapped.hh_keys)
+        )
+        assert int(left.count) == int(right.count) == 9_000
+
+    def test_mismatched_geometry_merges_refused(self):
+        with pytest.raises(ValueError, match="precision"):
+            sk.hll_merge(sk.hll_init(10), sk.hll_init(12))
+        with pytest.raises(ValueError, match="geometry"):
+            sk.cm_merge(sk.cm_init(4, 256), sk.cm_init(4, 512))
+
 
 # ------------------------------------------------------- jit shape preservation
 
@@ -111,6 +157,8 @@ class TestJitShapePreservation:
         ("hist", lambda: sk.hist_init(32, -3.0, 3.0), sk.hist_update, sk.hist_merge),
         ("reservoir", lambda: sk.reservoir_init(32, seed=0), sk.reservoir_update, sk.reservoir_merge),
         ("moments", lambda: sk.moments_init(()), sk.moments_update, sk.moments_merge),
+        ("hll", lambda: sk.hll_init(8), sk.hll_update, sk.hll_merge),
+        ("countmin", lambda: sk.cm_init(4, 128, k=8), sk.cm_update, sk.cm_merge),
     ]
 
     @staticmethod
@@ -170,6 +218,65 @@ def test_kll_overflow_latches_and_voids_bound():
         state = sk.kll_update(state, np.arange(4, dtype=np.float32))
     assert bool(state.overflow)
     assert np.isinf(float(sk.kll_error_bound(state)))
+
+
+# ----------------------------------------------------- HLL / Count-Min bounds
+
+
+def test_hll_cardinality_within_published_error_1e6_distinct():
+    """Acceptance: 1e6 distinct tags estimate within the published
+    ``1.04/sqrt(m)`` relative standard error (x3 for a deterministic margin),
+    with duplicates not moving the estimate (distinct, not total, count)."""
+    n = 1_000_000
+    state = sk.hll_init(12)
+    for chunk in np.split(np.arange(n, dtype=np.int32), 10):
+        state = sk.hll_update(state, chunk)
+    est = float(sk.hll_cardinality(state))
+    bound = sk.hll_error_bound(state)
+    assert bound == pytest.approx(1.04 / 64.0)  # precision 12 -> m = 4096
+    assert abs(est - n) / n <= 3 * bound, f"estimate {est} off by more than 3 sigma"
+    # re-fold half the stream: distinct count must not move (idempotent)
+    again = sk.hll_update(state, np.arange(n // 2, dtype=np.int32))
+    assert float(sk.hll_cardinality(again)) == est
+    assert int(again.count) == n + n // 2  # total-fold count still advances
+
+
+def test_hll_linear_counting_small_range_exact_ish():
+    """Small cardinalities hit the linear-counting regime and come out
+    near-exact (far tighter than the harmonic-mean bound)."""
+    for n in (10, 100, 1_000):
+        est = float(sk.hll_cardinality(sk.hll_update(sk.hll_init(12), np.arange(n, dtype=np.int32))))
+        assert abs(est - n) <= max(2.0, 0.02 * n), f"n={n}: linear-counting estimate {est}"
+
+
+def test_countmin_point_query_upper_bound_property():
+    """The CM guarantee: every point estimate >= the true count, and the
+    overestimate stays within the ``(e/width) * N`` bound for the default
+    geometry (holds w.p. ~1-e^-depth; deterministic data keeps it stable)."""
+    rng = np.random.default_rng(42)
+    data = rng.zipf(1.3, size=50_000).astype(np.int32) % 10_000
+    state = sk.cm_init(4, 1024, k=16)
+    for chunk in np.split(data, 10):
+        state = sk.cm_update(state, chunk)
+    universe = np.unique(data)
+    truth = np.bincount(data, minlength=10_000)[universe]
+    ests = np.asarray(sk.cm_point_query(state, universe))
+    assert (ests >= truth).all(), "point query fell below a true count"
+    assert float(np.max(ests - truth)) <= sk.cm_error_bound(state)
+
+
+def test_countmin_heavy_hitters_find_hot_keys():
+    """Hot keys dominate the candidate table with near-true estimates."""
+    rng = np.random.default_rng(3)
+    background = rng.integers(100, 50_000, size=20_000).astype(np.int32)
+    hot = np.repeat(np.arange(5, dtype=np.int32), 4_000)
+    data = rng.permutation(np.concatenate([background, hot])).astype(np.int32)
+    state = sk.cm_update(sk.cm_init(4, 2048, k=8), data)
+    keys, counts = sk.cm_heavy_hitters(state)
+    top5 = set(np.asarray(keys)[:5].tolist())
+    assert top5 == set(range(5))
+    for c in np.asarray(counts)[:5]:
+        assert 4_000 <= int(c) <= 4_000 + sk.cm_error_bound(state)
 
 
 # ----------------------------------------------------- Quantile/Median metrics

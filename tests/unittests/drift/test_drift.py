@@ -1,0 +1,276 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Drift subsystem suite (ISSUE 18 acceptance): PSI/symmetric-KL/KS score
+semantics including the documented empty-window and out-of-range-bin
+policies, ``DriftScore`` sustained-severity escalation and immediate
+recovery, reference pinning from raw samples and from PR-2 checkpoint
+payloads, ``Cardinality``/``HeavyHitters`` end-to-end through merge-sync,
+checkpoint round-trip, jitted compute, and ``SlicedPlan`` cohort fan-out."""
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import drift as dr
+from torchmetrics_tpu import sketch as sk
+from torchmetrics_tpu.drift.metrics import reference_from_checkpoint
+from torchmetrics_tpu.parallel.sliced import SlicedPlan
+
+_RNG = np.random.default_rng(2024)
+
+
+def _hist(data, bins=32, lo=-4.0, hi=4.0):
+    return sk.hist_update(sk.hist_init(bins, lo, hi), jnp.asarray(data, jnp.float32))
+
+
+# ------------------------------------------------------------------- scores
+
+
+class TestDriftScores:
+    def test_identical_windows_score_near_zero(self):
+        data = _RNG.normal(size=20_000).astype(np.float32)
+        ref, live = _hist(data[:10_000]), _hist(data[10_000:])
+        s = dr.drift_scores(ref, live)
+        assert 0.0 <= float(s.psi) < 0.02
+        assert float(s.kl) == pytest.approx(float(s.psi) / 2)
+        assert 0.0 <= float(s.ks) < 0.02
+
+    def test_shifted_window_scores_large(self):
+        ref = _hist(_RNG.normal(size=10_000))
+        live = _hist(_RNG.normal(loc=2.0, size=10_000))
+        s = dr.drift_scores(ref, live)
+        assert float(s.psi) > 0.25  # "action required" territory
+        assert float(s.ks) > 0.3
+
+    def test_individual_functions_match_bundle(self):
+        ref = _hist(_RNG.normal(size=5_000))
+        live = _hist(_RNG.normal(loc=0.5, size=5_000))
+        s = dr.drift_scores(ref, live)
+        assert float(dr.psi_score(ref, live)) == pytest.approx(float(s.psi))
+        assert float(dr.symmetric_kl(ref, live)) == pytest.approx(float(s.kl))
+        assert float(dr.ks_statistic(ref, live)) == pytest.approx(float(s.ks))
+
+    def test_empty_window_policy_is_zero_not_max(self):
+        """Documented contract: an empty window on EITHER side scores 0.0
+        everywhere — serving gaps must not page anyone."""
+        ref = _hist(_RNG.normal(size=1_000))
+        empty = sk.hist_init(32, -4.0, 4.0)
+        for a, b in ((ref, empty), (empty, ref), (empty, empty)):
+            s = dr.drift_scores(a, b)
+            assert float(s.psi) == float(s.kl) == float(s.ks) == 0.0
+
+    def test_out_of_range_mass_is_drift_signal(self):
+        """Mass outside [lo, hi] lands in the two virtual edge bins and
+        scores as drift instead of being silently dropped."""
+        ref = _hist(_RNG.normal(size=10_000))  # well inside [-4, 4]
+        live = _hist(_RNG.normal(loc=10.0, size=10_000))  # all above hi
+        s = dr.drift_scores(ref, live)
+        assert float(s.psi) > 1.0
+        assert float(s.ks) > 0.9  # essentially disjoint CDFs
+
+    def test_mismatched_edges_refused(self):
+        with pytest.raises(ValueError, match="identical bin edges"):
+            dr.psi_score(_hist([], bins=32), _hist([], bins=64))
+
+    def test_scores_are_jit_safe(self):
+        ref = _hist(_RNG.normal(size=2_000))
+        live = _hist(_RNG.normal(loc=1.0, size=2_000))
+        eager = dr.drift_scores(ref, live)
+        jitted = jax.jit(dr.drift_scores)(ref, live)
+        for a, b in zip(eager, jitted):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- DriftScore
+
+
+class TestDriftScoreMetric:
+    def _metric(self, **kw):
+        kw.setdefault("reference", _RNG.normal(size=20_000).astype(np.float32))
+        kw.setdefault("bins", 32)
+        kw.setdefault("lo", -4.0)
+        kw.setdefault("hi", 4.0)
+        kw.setdefault("distributed_available_fn", lambda: False)
+        return dr.DriftScore(**kw)
+
+    def test_in_distribution_stream_stays_ok(self):
+        m = self._metric(patience=1)
+        for _ in range(5):
+            m.update(_RNG.normal(size=2_000).astype(np.float32))
+        assert m.severity() == 0
+        g = m.serve_gauges()
+        assert set(g) == {"psi", "kl", "ks", "severity"}
+        assert g["psi"] < 0.1 and g["severity"] == 0.0
+
+    def test_severity_needs_patience_then_recovers_immediately(self):
+        """Sustained-only escalation: `patience` consecutive breaching
+        updates to escalate; one clean window drops it straight back."""
+        m = self._metric(patience=3, thresholds={"psi": (0.1, 0.25)})
+        drifted = _RNG.normal(loc=3.0, size=2_000).astype(np.float32)
+        m.update(drifted)
+        m.update(drifted)
+        assert m.severity() == 0  # breaching, but not yet sustained
+        m.update(drifted)
+        assert m.severity() == 2  # PSI way past critical after patience
+        m.reset()
+        m.update(_RNG.normal(size=2_000).astype(np.float32))
+        assert m.severity() == 0
+
+    def test_warn_band_maps_to_severity_one(self):
+        m = self._metric(patience=1, thresholds={"ks": (0.05, 0.9)})
+        m.update(_RNG.normal(loc=0.3, size=4_000).astype(np.float32))
+        assert m.severity() == 1  # past warn, below critical
+
+    def test_compute_returns_scores_namedtuple(self):
+        m = self._metric()
+        m.update(_RNG.normal(loc=2.0, size=4_000).astype(np.float32))
+        s = m.compute()
+        assert isinstance(s, dr.DriftScores) and float(s.psi) > 0.25
+
+    def test_reference_is_required_and_exclusive(self):
+        with pytest.raises(ValueError, match="pinned reference"):
+            dr.DriftScore()
+        with pytest.raises(ValueError, match="not both"):
+            dr.DriftScore(reference=[0.5], reference_checkpoint={"metrics": {}})
+        with pytest.raises(ValueError, match="unknown drift score"):
+            self._metric(thresholds={"mmd": 0.1})
+        with pytest.raises(ValueError, match="patience"):
+            self._metric(patience=0)
+
+    def test_reference_from_checkpoint_roundtrip(self):
+        """A PR-2 checkpoint of a histogram-bearing metric pins the
+        reference: pickle the payload, load it back, scores agree with the
+        directly-pinned reference."""
+        source = self._metric(patience=1)
+        ref_data = _RNG.normal(size=10_000).astype(np.float32)
+        source.update(ref_data)
+        payload = pickle.loads(pickle.dumps(source.save_checkpoint()))
+        ref = reference_from_checkpoint(payload, state_name="live")
+        assert isinstance(ref, sk.HistogramSketch)
+        np.testing.assert_array_equal(np.asarray(ref.counts), np.asarray(source.live.counts))
+        m = dr.DriftScore(
+            reference_checkpoint=payload,
+            reference_state="live",
+            patience=1,
+            distributed_available_fn=lambda: False,
+        )
+        m.update(ref_data)
+        assert float(m.compute().psi) < 1e-3  # live == reference by construction
+        with pytest.raises(ValueError, match="no serialized HistogramSketch"):
+            reference_from_checkpoint({"metrics": {"": {"state": {}}}})
+        with pytest.raises(ValueError, match="missing 'metrics'"):
+            reference_from_checkpoint({})
+
+    def test_checkpoint_roundtrip_preserves_live_window(self):
+        reference = _RNG.normal(size=20_000).astype(np.float32)
+        m = self._metric(reference=reference, patience=1)
+        m.update(_RNG.normal(loc=2.0, size=4_000).astype(np.float32))
+        before = float(m.compute().psi)
+        fresh = self._metric(reference=reference, patience=1)
+        fresh.load_checkpoint(pickle.loads(pickle.dumps(m.save_checkpoint())))
+        assert float(fresh.compute().psi) == pytest.approx(before)
+
+    def test_merge_sync_pools_live_windows(self):
+        """Emulated 2-rank sync: the synced live histogram is the pairwise
+        merge of both ranks' windows; unsync restores the local state."""
+        m0, m1 = self._metric(), self._metric()
+        m0.update(_RNG.normal(size=3_000).astype(np.float32))
+        m1.update(_RNG.normal(size=5_000).astype(np.float32))
+        leaves1 = iter(jax.tree_util.tree_leaves(m1.live))
+
+        def fake_gather(value, group=None):
+            return [value, next(leaves1)]
+
+        m0.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+        assert int(m0.live.count) == 8_000
+        m0.unsync()
+        assert int(m0.live.count) == 3_000
+
+    def test_sliced_plan_scores_cohorts_in_one_dispatch(self):
+        """The bench-leg shape: one DriftScore sliced over cohort cells,
+        drifted cohorts score high while in-distribution ones stay low."""
+        cells, per = 8, 2048
+        plan = SlicedPlan(self._metric(patience=1), num_cells=cells)
+        keys = np.arange(cells, dtype=np.int32)
+        vals = np.where(keys[:, None] < 4, 0.0, 3.0) + _RNG.normal(size=(cells, per)).astype(np.float32)
+        plan.run_scan([np.repeat(keys, per)], [(vals.reshape(-1),)])
+        scores = plan.compute_all()["DriftScore"]
+        psi = np.asarray(scores.psi)
+        assert psi.shape == (cells,)
+        # cells live at hashed table slots — map cohort key -> cell index
+        by_key = np.asarray([psi[plan.lookup(int(k))] for k in keys])
+        # drifted cohorts clear the "action required" bar; in-distribution
+        # ones sit an order of magnitude below them (small-window bin noise
+        # keeps them off exact zero)
+        assert (by_key[4:] > 0.25).all()
+        assert by_key[:4].max() * 10 < by_key[4:].min()
+
+
+# ------------------------------------------------- Cardinality / HeavyHitters
+
+
+class TestCardinality:
+    def test_estimate_within_published_bound(self):
+        m = dr.Cardinality(precision=12, distributed_available_fn=lambda: False)
+        n = 100_000
+        for chunk in np.split(np.arange(n, dtype=np.int32), 4):
+            m.update(chunk)
+        est = float(m.compute())
+        assert abs(est - n) / n <= 3 * m.error_bound()
+        assert m.serve_gauges()["cardinality"] == pytest.approx(est)
+
+    def test_duplicates_do_not_inflate(self):
+        m = dr.Cardinality(precision=10, distributed_available_fn=lambda: False)
+        tags = np.arange(500, dtype=np.int32)
+        m.update(tags)
+        first = float(m.compute())
+        m.update(tags)  # same tags again
+        assert float(m.compute()) == first
+
+    def test_merge_sync_counts_union_distinct(self):
+        """2-rank emulation: overlapping shards sync to the union distinct
+        count, not the sum — the idempotent-merge guarantee."""
+        m0 = dr.Cardinality(precision=12, distributed_available_fn=lambda: False)
+        m1 = dr.Cardinality(precision=12, distributed_available_fn=lambda: False)
+        m0.update(np.arange(0, 6_000, dtype=np.int32))
+        m1.update(np.arange(4_000, 10_000, dtype=np.int32))  # 2k overlap
+        leaves1 = iter(jax.tree_util.tree_leaves(m1.sketch))
+
+        def fake_gather(value, group=None):
+            return [value, next(leaves1)]
+
+        m0.sync(dist_sync_fn=fake_gather, distributed_available=lambda: True)
+        # read the synced sketch directly (compute() would try to re-sync)
+        est = float(sk.hll_cardinality(m0.sketch))
+        assert abs(est - 10_000) / 10_000 <= 3 * m0.error_bound()
+        m0.unsync()
+        assert int(m0.sketch.count) == 6_000  # local state rolled back
+
+    def test_checkpoint_roundtrip(self):
+        m = dr.Cardinality(precision=10, distributed_available_fn=lambda: False)
+        m.update(np.arange(5_000, dtype=np.int32))
+        fresh = dr.Cardinality(precision=10, distributed_available_fn=lambda: False)
+        fresh.load_checkpoint(pickle.loads(pickle.dumps(m.save_checkpoint())))
+        assert float(fresh.compute()) == float(m.compute())
+
+
+class TestHeavyHitters:
+    def test_hot_keys_surface_with_upper_bound_counts(self):
+        m = dr.HeavyHitters(depth=4, width=2048, k=8, distributed_available_fn=lambda: False)
+        rng = np.random.default_rng(5)
+        hot = np.repeat(np.arange(3, dtype=np.int32), 2_000)
+        noise = rng.integers(10, 30_000, size=10_000).astype(np.int32)
+        m.update(rng.permutation(np.concatenate([hot, noise])))
+        keys, counts = m.compute()
+        assert set(np.asarray(keys)[:3].tolist()) == {0, 1, 2}
+        assert (np.asarray(m.count_of(np.arange(3, dtype=np.int32))) >= 2_000).all()
+
+    def test_checkpoint_roundtrip(self):
+        m = dr.HeavyHitters(depth=4, width=512, k=8, distributed_available_fn=lambda: False)
+        m.update(np.repeat(np.arange(4, dtype=np.int32), 100))
+        fresh = dr.HeavyHitters(depth=4, width=512, k=8, distributed_available_fn=lambda: False)
+        fresh.load_checkpoint(pickle.loads(pickle.dumps(m.save_checkpoint())))
+        np.testing.assert_array_equal(np.asarray(fresh.compute()[1]), np.asarray(m.compute()[1]))

@@ -186,6 +186,31 @@ def test_derive_health_table():
     assert (flap["state"], flap["http_status"]) == ("degraded", 503)
 
 
+def test_derive_health_drift_severity_floor():
+    """The drift subsystem's severity ladder (ISSUE 18): a published
+    ``drift.<stream>.severity`` gauge floors health — 1 (warn) to a visible
+    200 "stalling", 2 (critical) to a 503 "degraded" naming the stream and
+    its PSI — and recovery un-floors on the next derive (gauges are read
+    fresh per call, nothing latches)."""
+    gauges = {"drift.scores.severity": 0.0, "drift.scores.psi": 0.02}
+    assert live.derive_health({}, gauges)["state"] == "ok"
+    gauges.update({"drift.scores.severity": 1.0, "drift.scores.psi": 0.17})
+    warn = live.derive_health({}, gauges)
+    assert (warn["state"], warn["http_status"]) == ("stalling", 200)
+    assert "scores" in warn["reason"] and "drift" in warn["reason"]
+    gauges.update({"drift.scores.severity": 2.0, "drift.scores.psi": 3.2})
+    crit = live.derive_health({}, gauges)
+    assert (crit["state"], crit["http_status"]) == ("degraded", 503)
+    assert "psi 3.2" in crit["reason"]
+    gauges.update({"drift.scores.severity": 0.0, "drift.scores.psi": 0.01})
+    assert live.derive_health({}, gauges)["state"] == "ok"
+    # drift floors COMBINE with the other escalations: worst one wins
+    both = live.derive_health(
+        {"metric.sync.degrade": 1}, {"drift.scores.severity": 1.0, "drift.scores.psi": 0.2}
+    )
+    assert (both["state"], both["http_status"]) == ("degraded", 503)
+
+
 # ----------------------------------------------------------- publisher core
 
 
@@ -456,6 +481,55 @@ def test_watch_table_surfaces_stream_supervision_columns(tmp_path):
     assert acc["deadletter_depth"] == 1.0 and acc["durability"] == 0.0
     assert acc["health"] == "stalled"
     assert stream_rows["f1"]["circuit"] == "closed"
+
+
+def test_watch_table_fleet_tree_groups_leaves_under_aggregator(tmp_path):
+    """The fleet tree view (ISSUE 18 satellite): one aggregator row carrying
+    coverage and the lagging/quarantined tallies, each leaf grouped under it
+    as an indented ``└`` row with its lagging/quarantined flags; ``--json``
+    emits the same hierarchy as a ``fleet`` row followed by ``leaf`` rows."""
+    _write_status(str(tmp_path), 0, time.time_ns())
+    path = tmp_path / live.status_filename(0)
+    payload = json.loads(path.read_text())
+    payload["gauges"].update({
+        "fleet.coverage": 0.75, "fleet.leaves": 3.0, "fleet.fold_seq": 42.0,
+        "fleet.leaf.east.state": 0.0, "fleet.leaf.east.health_state": 0.0,
+        "fleet.leaf.east.streams": 2.0,
+        "fleet.leaf.west.state": 1.0, "fleet.leaf.west.health_state": 1.0,
+        "fleet.leaf.west.streams": 2.0,
+        "fleet.leaf.south.state": 3.0, "fleet.leaf.south.health_state": 3.0,
+        "fleet.leaf.south.streams": 1.0,
+    })
+    path.write_text(json.dumps(payload))
+    statuses = live.read_status_dir(str(tmp_path))
+
+    table = live.format_watch_table(statuses, stale_after_s=10.0)
+    for column in ("fleet/leaf", "state/cov", "lagging", "quarantined", "fold_seq"):
+        assert column in table, table
+    lines = table.splitlines()
+    agg_idx, agg = next((i, ln.split()) for i, ln in enumerate(lines) if ln.split()[1:2] == ["fleet"])
+    # aggregator row: worst-leaf health, coverage %, leaves/lagging/quarantined
+    # tallies, total streams, fold_seq
+    assert agg[2:9] == ["stalled", "75%", "3", "1", "1", "5", "42"]
+    leaf_rows = {ln.split()[2]: ln.split() for ln in lines if ln.split()[1:2] == ["└"]}
+    assert set(leaf_rows) == {"east", "west", "south"}
+    # leaves render grouped DIRECTLY under their aggregator row
+    assert all(ln.split()[1] == "└" for ln in lines[agg_idx + 1 : agg_idx + 4])
+    assert leaf_rows["east"][3:5] == ["ok", "fresh"]
+    assert leaf_rows["west"][3:5] == ["stalling", "lagging"] and "yes" in leaf_rows["west"]
+    assert leaf_rows["south"][3:5] == ["stalled", "quarantined"] and "yes" in leaf_rows["south"]
+
+    rows = [json.loads(ln) for ln in live.format_watch_json(statuses).splitlines()]
+    fleet_row = next(r for r in rows if r["kind"] == "fleet")
+    assert fleet_row["coverage"] == 0.75 and fleet_row["leaves"] == 3.0
+    assert fleet_row["lagging"] == 1 and fleet_row["quarantined"] == 1
+    assert fleet_row["streams"] == 5 and fleet_row["fold_seq"] == 42.0
+    leaf_json = {r["leaf"]: r for r in rows if r["kind"] == "leaf"}
+    assert leaf_json["west"]["leaf_state"] == "lagging"
+    assert leaf_json["south"]["leaf_state"] == "quarantined"
+    # hierarchy: the fleet row precedes its leaf rows, all after the rank row
+    kinds = [r["kind"] for r in rows]
+    assert kinds.index("fleet") < kinds.index("leaf")
 
 
 # ------------------------------------------------------------------- diff

@@ -219,6 +219,53 @@ def test_watch_json_emits_rank_and_stream_rows(tmp_path):
     assert all(r.get("stream") != "streams" for r in rows)
 
 
+def test_watch_fleet_tree_renders_without_jax(tmp_path):
+    """ISSUE 18 satellite: a federation aggregator's ``fleet.*`` gauge family
+    renders as a tree — aggregator row plus ``└`` leaf rows with coverage,
+    lagging, and quarantined columns — in both the table and ``--json``
+    watch modes, still under a poisoned jax on PYTHONPATH."""
+    env = _poisoned_env(tmp_path)
+    status_dir = tmp_path / "status"
+    status_dir.mkdir()
+    now = 1_000_000_000_000_000_000
+    _write_status_file(str(status_dir), 0, now)
+    path = status_dir / "status.rank0.json"
+    payload = json.loads(path.read_text())
+    payload["gauges"].update({
+        "fleet.coverage": 0.5, "fleet.leaves": 2.0, "fleet.fold_seq": 9.0,
+        "fleet.leaf.edge-a.state": 0.0, "fleet.leaf.edge-a.health_state": 0.0,
+        "fleet.leaf.edge-a.streams": 3.0,
+        "fleet.leaf.edge-b.state": 3.0, "fleet.leaf.edge-b.health_state": 3.0,
+        "fleet.leaf.edge-b.streams": 1.0,
+    })
+    path.write_text(json.dumps(payload))
+
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "watch", "--once", "--stale-after", "2.0", str(status_dir)],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    assert "fleet/leaf" in result.stdout and "quarantined" in result.stdout
+    lines = result.stdout.splitlines()
+    (agg,) = [ln for ln in lines if ln.split()[1:2] == ["fleet"]]
+    assert "50%" in agg
+    leaf_lines = {ln.split()[2]: ln for ln in lines if ln.split()[1:2] == ["└"]}
+    assert set(leaf_lines) == {"edge-a", "edge-b"}
+    assert "quarantined" in leaf_lines["edge-b"] and "fresh" in leaf_lines["edge-a"]
+
+    result = subprocess.run(
+        [sys.executable, CLI_PATH, "watch", "--json", "--once", "--stale-after", "2.0", str(status_dir)],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert result.returncode == 0, result.stderr
+    rows = [json.loads(ln) for ln in result.stdout.splitlines() if ln.strip()]
+    (fleet_row,) = [r for r in rows if r["kind"] == "fleet"]
+    assert fleet_row["coverage"] == 0.5 and fleet_row["quarantined"] == 1
+    leaves = {r["leaf"]: r for r in rows if r["kind"] == "leaf"}
+    assert leaves["edge-b"]["leaf_state"] == "quarantined"
+    assert leaves["edge-a"]["leaf_state"] == "fresh"
+
+
 def _write_span_trace(path, dur_scale=1.0):
     events = [
         {"type": "span", "name": "metric.update", "ts": i * 1000, "dur": int(1_000_000 * dur_scale),

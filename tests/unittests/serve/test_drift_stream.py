@@ -1,0 +1,126 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Drift detection as serve-plane operational health (ISSUE 18 acceptance):
+a served ``drift`` stream publishes ``drift.<stream>.{psi,kl,ks,severity}``
+gauges on ``/metrics``, sustained PSI past critical floors ``/healthz`` to
+degraded (HTTP 503) through the PR-12 severity machinery, and a recovered
+stream un-floors it; ``cardinality`` rides the same factory path."""
+from __future__ import annotations
+
+import time
+import urllib.request
+
+import numpy as np
+
+from torchmetrics_tpu.serve import ServeDaemon
+
+from tests.unittests.serve.test_daemon import _http
+
+_REF = np.random.RandomState(7).normal(0.5, 0.1, 8192).astype(np.float32)
+
+
+def _drift_spec(name="scores", patience=2):
+    return {
+        "name": name,
+        "target": "torchmetrics_tpu.serve.factories:drift",
+        "kwargs": {
+            "reference": [float(v) for v in _REF],
+            "bins": 32,
+            "lo": 0.0,
+            "hi": 1.0,
+            "patience": patience,
+            "thresholds": {"psi": [0.1, 0.25]},
+        },
+        "use_feed": False,
+    }
+
+
+def _ingest_window(daemon, name, seq, rng, loc, n=512):
+    vals = rng.normal(loc, 0.1, n).astype(np.float32)
+    reply = daemon.ingest(name, seq, [vals.tolist()], block=True, deadline_s=30.0)
+    assert reply.get("ok"), reply
+    return seq + 1
+
+
+def _healthz_settles(daemon, want_code, want_state, timeout_s=30.0):
+    """Poll /healthz until it reports ``(want_code, want_state)`` — ingest
+    acks can land a beat before the worker's gauge refresh reaches the HTTP
+    thread's probe cache."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        code, body, _ = _http(daemon, "GET", "/healthz")
+        if (code == want_code and body.get("state") == want_state) or time.monotonic() > deadline:
+            return code, body
+
+
+class TestDriftStream:
+    def test_sustained_drift_floors_healthz_and_recovers(self, tmp_path):
+        """The acceptance walk: in-distribution 200 ok -> sustained drifted
+        windows 503 degraded naming the stream -> recovery back to 200."""
+        daemon = ServeDaemon(str(tmp_path), publish=True).start()
+        rng = np.random.RandomState(21)
+        try:
+            code, body, _ = _http(daemon, "POST", "/v1/streams", _drift_spec(patience=2))
+            assert code == 200 and body["ok"], body
+
+            seq = 0
+            for _ in range(3):
+                seq = _ingest_window(daemon, "scores", seq, rng, loc=0.5)
+            code, body = _healthz_settles(daemon, 200, "ok")
+            assert code == 200 and body["state"] == "ok"
+
+            # one drifted window is NOT enough (patience=2): no paging on a
+            # transient spike
+            seq = _ingest_window(daemon, "scores", seq, rng, loc=0.9)
+            code, body, _ = _http(daemon, "GET", "/healthz")
+            assert code == 200
+
+            seq = _ingest_window(daemon, "scores", seq, rng, loc=0.9)
+            code, body = _healthz_settles(daemon, 503, "degraded")
+            assert code == 503 and body["state"] == "degraded"
+            assert "scores" in body["reason"] and "drift" in body["reason"]
+            assert "psi" in body["reason"]
+
+            # recovery: flood with in-distribution windows until the live
+            # histogram re-centers — the severity gauge drops the moment the
+            # scores do, and /healthz un-floors on the next probe
+            for _ in range(90):
+                seq = _ingest_window(daemon, "scores", seq, rng, loc=0.5, n=2048)
+            code, body = _healthz_settles(daemon, 200, "ok")
+            assert code == 200 and body["state"] == "ok"
+        finally:
+            daemon.shutdown(drain=False)
+
+    def test_metrics_scrape_exposes_drift_gauges(self, tmp_path):
+        daemon = ServeDaemon(str(tmp_path), publish=True).start()
+        rng = np.random.RandomState(22)
+        try:
+            code, body, _ = _http(daemon, "POST", "/v1/streams", _drift_spec())
+            assert code == 200 and body["ok"], body
+            _ingest_window(daemon, "scores", 0, rng, loc=0.5)
+            host, port = daemon.http_address()
+            with urllib.request.urlopen(f"http://{host}:{port}/metrics", timeout=30) as resp:
+                text = resp.read().decode()
+            for gauge in ("psi", "kl", "ks", "severity"):
+                assert f"drift.scores.{gauge}" in text or f"drift_scores_{gauge}" in text.replace(".", "_")
+        finally:
+            daemon.shutdown(drain=False)
+
+    def test_cardinality_stream_serves_distinct_count_gauge(self, tmp_path):
+        daemon = ServeDaemon(str(tmp_path), publish=True).start()
+        try:
+            code, body, _ = _http(daemon, "POST", "/v1/streams", {
+                "name": "uniq",
+                "target": "torchmetrics_tpu.serve.factories:cardinality",
+                "kwargs": {"precision": 12},
+                "use_feed": False,
+            })
+            assert code == 200 and body["ok"], body
+            tags = np.arange(5_000, dtype=np.int32)
+            assert daemon.ingest("uniq", 0, [tags.tolist()], block=True, deadline_s=30.0)["ok"]
+            reply = daemon.drain_stream("uniq")
+            assert reply["ok"]
+            est = float(np.asarray(reply["results"]))
+            assert abs(est - 5_000) / 5_000 <= 0.05  # precision 12 ~ 1.6% sigma
+        finally:
+            daemon.shutdown(drain=False)

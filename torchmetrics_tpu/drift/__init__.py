@@ -1,0 +1,39 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Data-drift detection over mergeable sketches (ARCHITECTURE.md §18).
+
+The family the reference library never had: distribution-shift scores
+between a **pinned reference** :class:`~torchmetrics_tpu.sketch.HistogramSketch`
+and a **live window**, plus distinct-count and heavy-hitter monitors over the
+:mod:`~torchmetrics_tpu.sketch.hll` / :mod:`~torchmetrics_tpu.sketch.countmin`
+sketches. Every state is an ordinary ``dist_reduce_fx="merge"`` sketch, so
+the whole family syncs, shards, windows (``WindowRing``), fans out per cohort
+(``SlicedPlan``), checkpoints, and serves without new machinery.
+
+Deployment is the point: ``serve/factories.py`` exposes ``drift`` /
+``cardinality`` / ``heavy_hitters`` stream targets, :class:`DriftScore`
+publishes ``drift.<stream>.{psi,kl,ks,severity}`` gauges on the daemon's
+``/metrics``, and a sustained threshold breach floors ``/healthz`` exactly
+like circuit/durability states — drift as an operational health state.
+"""
+from torchmetrics_tpu.drift.metrics import Cardinality, DriftScore, HeavyHitters
+from torchmetrics_tpu.drift.scores import (
+    DRIFT_SEVERITY_STATES,
+    DriftScores,
+    drift_scores,
+    ks_statistic,
+    psi_score,
+    symmetric_kl,
+)
+
+__all__ = [
+    "Cardinality",
+    "DRIFT_SEVERITY_STATES",
+    "DriftScore",
+    "DriftScores",
+    "HeavyHitters",
+    "drift_scores",
+    "ks_statistic",
+    "psi_score",
+    "symmetric_kl",
+]

@@ -1,0 +1,264 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Drift, cardinality, and heavy-hitter metrics over mergeable sketches.
+
+All three are ordinary :class:`~torchmetrics_tpu.metric.Metric` subclasses
+whose only states are ``dist_reduce_fx="merge"`` sketches, so every existing
+regime — replica ``sync()``, sharded ``mesh_reduce_tree`` folds,
+``WindowRing`` windows, ``SlicedPlan`` cohort fan-out, checkpoint/restore,
+serve snapshots — applies without new state kinds.
+
+:class:`DriftScore` additionally publishes host-side **serve gauges**
+(``psi``/``kl``/``ks``/``severity``): eager updates refresh a cached float
+dict that :meth:`serve_gauges` returns without touching the device, so the
+daemon's ``/metrics`` thread can read it concurrently with the worker (the
+cache is swapped atomically under the GIL). Traced updates (fused/sliced
+plans) skip the cache — scores are still available via ``compute``.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.drift.scores import DriftScores, drift_scores
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.sketch.countmin import cm_heavy_hitters, cm_init, cm_point_query, cm_update
+from torchmetrics_tpu.sketch.histogram import HistogramSketch, hist_init, hist_update
+from torchmetrics_tpu.sketch.hll import hll_cardinality, hll_error_bound, hll_init, hll_update
+
+Array = jax.Array
+
+#: default thresholds: the industry PSI operating points (warn at "moderate
+#: shift", critical at "action required")
+DEFAULT_THRESHOLDS: Dict[str, Tuple[float, float]] = {"psi": (0.1, 0.25)}
+
+
+def reference_from_checkpoint(
+    checkpoint: Mapping[str, Any],
+    metric_path: Optional[str] = None,
+    state_name: Optional[str] = None,
+) -> HistogramSketch:
+    """Extract a pinned reference histogram from a PR-2 checkpoint payload.
+
+    ``checkpoint`` is the plain dict written by ``save_checkpoint`` (what
+    ``CheckpointStore`` persists and the fleet's ``/v1/state`` exports):
+    sketch states are stored as ``{"__sketch__": class, "leaves": {...}}``
+    payloads. The first serialized ``HistogramSketch`` found is decoded —
+    narrow the search with ``metric_path`` (the checkpoint's metric-walk key,
+    ``""`` for a bare metric) and/or ``state_name``. Leaves are installed via
+    ``jnp.array`` (a copy — restored buffers must never alias, ML009).
+    """
+    metrics = checkpoint.get("metrics")
+    if not isinstance(metrics, Mapping):
+        raise ValueError("not a checkpoint payload: missing 'metrics' dict")
+    paths = [metric_path] if metric_path is not None else sorted(metrics)
+    for path in paths:
+        entry = metrics.get(path)
+        if not isinstance(entry, Mapping):
+            continue
+        state = entry.get("state", {})
+        names = [state_name] if state_name is not None else sorted(state)
+        for name in names:
+            payload = state.get(name)
+            if isinstance(payload, Mapping) and payload.get("__sketch__") == HistogramSketch.__name__:
+                leaves = payload["leaves"]
+                return HistogramSketch(*[jnp.array(leaves[f]) for f in HistogramSketch._fields])
+    raise ValueError(
+        f"no serialized HistogramSketch state found (metric_path={metric_path!r},"
+        f" state_name={state_name!r}) — is this a histogram-bearing checkpoint?"
+    )
+
+
+def _empty_like(reference: HistogramSketch) -> HistogramSketch:
+    """A zeroed live histogram sharing the reference's bin edges exactly."""
+    return HistogramSketch(
+        edges=jnp.array(reference.edges),
+        counts=jnp.zeros_like(reference.counts),
+        low=jnp.asarray(0, jnp.int32),
+        high=jnp.asarray(0, jnp.int32),
+        count=jnp.asarray(0, jnp.int32),
+    )
+
+
+class DriftScore(Metric):
+    """PSI / symmetric-KL / KS drift of a live stream against a pinned
+    reference distribution.
+
+    The **reference** is a constructor constant (a :class:`HistogramSketch`,
+    a raw sample array binned at init, or a PR-2 checkpoint payload via
+    ``reference_checkpoint`` / :func:`reference_from_checkpoint`) — it never
+    syncs, never resets, and is reconstructed from kwargs on serve restore.
+    The only registered state is the **live** histogram (``merge``), so the
+    metric windows, shards, slices, and checkpoints like any sketch metric.
+
+    ``thresholds`` maps score names (``"psi"``/``"kl"``/``"ks"``) to a
+    ``(warn, critical)`` pair (or a single critical float). After
+    ``patience`` *consecutive* scored updates breach a threshold the
+    published severity escalates (0 ok / 1 warn / 2 critical) — and drops
+    back the moment scores recover, so a transient spike never pages and a
+    recovered stream un-floors ``/healthz`` immediately.
+
+    Args:
+        reference: pinned reference — ``HistogramSketch`` or sample array.
+        bins, lo, hi: histogram geometry when ``reference`` is a raw sample
+            (ignored when it is already a sketch).
+        eps: probability floor for the PSI/KL bins.
+        thresholds: score-name -> (warn, critical) map; default PSI 0.1/0.25.
+        patience: consecutive breaching updates before severity escalates.
+        reference_checkpoint: PR-2 checkpoint payload to load the reference
+            from (with optional ``reference_path``/``reference_state``).
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    # NOTE: the patience run (`_breach_run`) is deliberately NOT a declared
+    # host counter — host counters make a metric fusion/slice-ineligible
+    # (ML007), and the run is pure gauge bookkeeping: after a restore the
+    # drift simply has to re-sustain `patience` updates before flooring
+    # /healthz again, which is the conservative behavior anyway.
+
+    def __init__(
+        self,
+        reference: Optional[Union[HistogramSketch, Array, Sequence[float]]] = None,
+        bins: int = 64,
+        lo: float = 0.0,
+        hi: float = 1.0,
+        eps: float = 1e-6,
+        thresholds: Optional[Mapping[str, Union[float, Tuple[float, float]]]] = None,
+        patience: int = 3,
+        reference_checkpoint: Optional[Mapping[str, Any]] = None,
+        reference_path: Optional[str] = None,
+        reference_state: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if reference_checkpoint is not None:
+            if reference is not None:
+                raise ValueError("pass either `reference` or `reference_checkpoint`, not both")
+            reference = reference_from_checkpoint(reference_checkpoint, reference_path, reference_state)
+        if reference is None:
+            raise ValueError("DriftScore needs a pinned reference (sketch, sample array, or checkpoint)")
+        if not isinstance(reference, HistogramSketch):
+            reference = hist_update(hist_init(bins, lo, hi), jnp.asarray(reference, jnp.float32))
+        self.reference = reference
+        self.eps = float(eps)
+        if patience < 1:
+            raise ValueError(f"need patience >= 1, got {patience}")
+        self.patience = int(patience)
+        self.thresholds: Dict[str, Tuple[float, float]] = {}
+        for name, bound in dict(DEFAULT_THRESHOLDS if thresholds is None else thresholds).items():
+            if name not in ("psi", "kl", "ks"):
+                raise ValueError(f"unknown drift score {name!r} in thresholds (want psi/kl/ks)")
+            warn, crit = (bound if isinstance(bound, (tuple, list)) else (bound, bound))
+            self.thresholds[name] = (float(warn), float(crit))
+        self.add_state("live", default=_empty_like(reference), dist_reduce_fx="merge")
+        self._breach_run = 0
+        self._gauge_cache: Dict[str, float] = {"psi": 0.0, "kl": 0.0, "ks": 0.0, "severity": 0.0}
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Fold a batch into the live histogram; refresh serve gauges when
+        running eagerly (traced updates skip the host cache)."""
+        self.live = hist_update(self.live, jnp.asarray(value, jnp.float32))
+        if not isinstance(self.live.count, jax.core.Tracer):
+            self._refresh_gauges()
+
+    def compute(self) -> DriftScores:
+        """The three scores of the live window vs the reference (jit-safe)."""
+        return drift_scores(self.reference, self.live, self.eps)
+
+    def _raw_severity(self, scores: Mapping[str, float]) -> int:
+        sev = 0
+        for name, (warn, crit) in self.thresholds.items():
+            v = scores[name]
+            if v >= crit:
+                sev = max(sev, 2)
+            elif v >= warn:
+                sev = max(sev, 1)
+        return sev
+
+    def _refresh_gauges(self) -> None:
+        s = self.compute()
+        scores = {"psi": float(s.psi), "kl": float(s.kl), "ks": float(s.ks)}
+        raw = self._raw_severity(scores)
+        self._breach_run = self._breach_run + 1 if raw > 0 else 0
+        # severity is sustained-only: it needs `patience` consecutive
+        # breaching updates to escalate, but recovers immediately
+        scores["severity"] = float(raw if self._breach_run >= self.patience else 0)
+        self._gauge_cache = scores
+
+    def severity(self) -> int:
+        """Current published severity (0 ok / 1 warn / 2 critical)."""
+        return int(self._gauge_cache["severity"])
+
+    def serve_gauges(self) -> Dict[str, float]:
+        """Host-cached gauges for the serve plane (``drift.<stream>.*``)."""
+        return dict(self._gauge_cache)
+
+    def reset(self) -> None:
+        super().reset()
+        self._breach_run = 0
+        self._gauge_cache = {"psi": 0.0, "kl": 0.0, "ks": 0.0, "severity": 0.0}
+
+
+class Cardinality(Metric):
+    """Approximate distinct count via HyperLogLog — the "how many unique
+    users/items did this stream see" monitor, in ``2**precision * 4`` bytes
+    of mergeable state with relative error ``1.04/sqrt(2**precision)``."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, precision: int = 12, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.precision = int(precision)
+        self.add_state("sketch", default=hll_init(self.precision), dist_reduce_fx="merge")
+        self._gauge_cache: Dict[str, float] = {"cardinality": 0.0}
+
+    def update(self, value: Array) -> None:
+        self.sketch = hll_update(self.sketch, value)
+        if not isinstance(self.sketch.count, jax.core.Tracer):
+            self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        self._gauge_cache = {"cardinality": float(hll_cardinality(self.sketch))}
+
+    def compute(self) -> Array:
+        """Bias-corrected distinct-count estimate (jit-safe)."""
+        return hll_cardinality(self.sketch)
+
+    def error_bound(self) -> float:
+        """Relative standard error of :meth:`compute` (``1.04/sqrt(m)``)."""
+        return hll_error_bound(self.sketch)
+
+    def serve_gauges(self) -> Dict[str, float]:
+        return dict(self._gauge_cache)
+
+
+class HeavyHitters(Metric):
+    """Top-``k`` most frequent tags via Count-Min + candidate table — label
+    skew / hot-key detection over an unbounded stream in fixed memory."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, depth: int = 4, width: int = 1024, k: int = 32, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.depth, self.width, self.k = int(depth), int(width), int(k)
+        self.add_state("sketch", default=cm_init(self.depth, self.width, self.k), dist_reduce_fx="merge")
+
+    def update(self, value: Array) -> None:
+        self.sketch = cm_update(self.sketch, value)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """``(keys, counts)`` sorted by count desc (count 0 = empty slot)."""
+        return cm_heavy_hitters(self.sketch)
+
+    def count_of(self, value: Array) -> Array:
+        """Point estimate(s) for specific tag(s) — never below the truth."""
+        return cm_point_query(self.sketch, value)

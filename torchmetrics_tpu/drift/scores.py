@@ -1,0 +1,118 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Distribution-shift scores between two fixed-bin histograms.
+
+All three scores compare a *reference* :class:`HistogramSketch` (pinned at
+deployment time) against a *live* one (the current window) sharing the same
+bin edges. They are pure jnp on the two count vectors — jit-safe, so they run
+inside traced ``compute`` (``SlicedPlan.compute_all`` scores every cohort
+cell in one dispatch).
+
+Binning policy:
+
+- The comparison runs over ``bins + 2`` cells: the histogram's in-range bins
+  plus the ``low``/``high`` out-of-range tallies as two virtual edge bins —
+  mass that leaves the reference range is exactly the drift signal a fixed
+  range would otherwise silently drop.
+- PSI and symmetric KL divide by bin mass, so both probability vectors are
+  floored at ``eps`` and renormalized first (the standard PSI practice for
+  empty bins); ``eps`` shifts scores by at most ``O((bins+2) * eps)``. The
+  KS statistic needs no floor (no division) and uses the raw proportions.
+
+Empty-window policy (documented contract): if EITHER side has folded zero
+values, every score is ``0.0`` — an empty window is "no evidence of drift",
+not "maximal drift", because serving gaps (deploy restarts, quiet hours)
+must not page anyone. The caller can distinguish "empty" from "agrees" by
+checking ``sketch.count``.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.histogram import HistogramSketch
+
+Array = jax.Array
+
+#: severity ladder published by ``DriftScore.serve_gauges`` and consumed by
+#: ``obs.live.derive_health``: 0 never floors health, 1 floors to
+#: "stalling" (HTTP 200, visible), 2 floors to "degraded" (HTTP 503).
+DRIFT_SEVERITY_STATES = ("ok", "warn", "critical")
+
+
+class DriftScores(NamedTuple):
+    """The three shift scores as 0-d float arrays."""
+
+    psi: Array
+    kl: Array
+    ks: Array
+
+
+def _check_edges(reference: HistogramSketch, live: HistogramSketch) -> None:
+    if reference.edges.shape != live.edges.shape:
+        raise ValueError(
+            "drift scores need histograms with identical bin edges:"
+            f" {reference.edges.shape} vs {live.edges.shape}"
+        )
+
+
+def _raw_proportions(state: HistogramSketch) -> Array:
+    """(bins+2,) proportion vector ``[low, counts..., high] / count``."""
+    cells = jnp.concatenate([state.low[None], state.counts, state.high[None]]).astype(jnp.float32)
+    return cells / jnp.maximum(state.count, 1).astype(jnp.float32)
+
+
+def _floored_proportions(state: HistogramSketch, eps: float) -> Array:
+    p = jnp.maximum(_raw_proportions(state), eps)
+    return p / jnp.sum(p)
+
+
+def _both_nonempty(reference: HistogramSketch, live: HistogramSketch) -> Array:
+    return (reference.count > 0) & (live.count > 0)
+
+
+def psi_score(reference: HistogramSketch, live: HistogramSketch, eps: float = 1e-6) -> Array:
+    """Population Stability Index ``sum((p_live - p_ref) * ln(p_live/p_ref))``
+    (the Jeffreys divergence). Common operating points: < 0.1 stable,
+    0.1-0.25 moderate shift, > 0.25 action required."""
+    _check_edges(reference, live)
+    p = _floored_proportions(live, eps)
+    q = _floored_proportions(reference, eps)
+    score = jnp.sum((p - q) * jnp.log(p / q))
+    return jnp.where(_both_nonempty(reference, live), score, 0.0)
+
+
+def symmetric_kl(reference: HistogramSketch, live: HistogramSketch, eps: float = 1e-6) -> Array:
+    """Symmetrized KL divergence ``(KL(live||ref) + KL(ref||live)) / 2``
+    (== PSI / 2 on the same floored bins; reported separately because drift
+    thresholds in the wild are quoted against either convention)."""
+    return 0.5 * psi_score(reference, live, eps)
+
+
+def ks_statistic(reference: HistogramSketch, live: HistogramSketch) -> Array:
+    """Kolmogorov-Smirnov statistic ``max |CDF_ref - CDF_live|`` evaluated at
+    the bin edges (the exact KS of the binned distributions; a lower bound on
+    the KS of the underlying continuous ones)."""
+    _check_edges(reference, live)
+    p = jnp.cumsum(_raw_proportions(live))
+    q = jnp.cumsum(_raw_proportions(reference))
+    score = jnp.max(jnp.abs(p - q))
+    return jnp.where(_both_nonempty(reference, live), score, 0.0)
+
+
+def drift_scores(reference: HistogramSketch, live: HistogramSketch, eps: float = 1e-6) -> DriftScores:
+    """All three scores in one call (shared proportion work)."""
+    _check_edges(reference, live)
+    nonempty = _both_nonempty(reference, live)
+    p = _floored_proportions(live, eps)
+    q = _floored_proportions(reference, eps)
+    psi = jnp.sum((p - q) * jnp.log(p / q))
+    ks = jnp.max(jnp.abs(jnp.cumsum(_raw_proportions(live)) - jnp.cumsum(_raw_proportions(reference))))
+    zero = jnp.asarray(0.0, jnp.float32)
+    return DriftScores(
+        psi=jnp.where(nonempty, psi, zero),
+        kl=jnp.where(nonempty, 0.5 * psi, zero),
+        ks=jnp.where(nonempty, ks, zero),
+    )

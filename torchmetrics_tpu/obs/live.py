@@ -146,6 +146,17 @@ _LEAF_STATE_NAME = {0: "fresh", 1: "lagging", 2: "unreachable", 3: "quarantined"
 
 _FLEET_HEALTH_RE = re.compile(r"^fleet\.leaf\.(?P<leaf>[^.]+)\.health_state$")
 
+#: the drift subsystem's per-stream severity gauge (0 ok / 1 warn /
+#: 2 critical — drift.DRIFT_SEVERITY_STATES): warn floors health at
+#: "stalling" (visible, still 200), critical at "degraded" (503) — a stream
+#: scoring off-distribution is operationally equivalent to one serving from
+#: a degraded store. Severity is computed with patience/recovery by the
+#: metric, so this floor un-floors as soon as the live window returns.
+_DRIFT_HEALTH_RE = re.compile(r"^drift\.(?P<stream>[^.]+)\.severity$")
+
+#: drift severity code → the health state it floors to
+_DRIFT_SEVERITY_HEALTH = {1: "stalling", 2: "degraded"}
+
 
 def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[str, Any]:
     """Liveness state from a counter/gauge snapshot (see the module table).
@@ -190,6 +201,16 @@ def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[st
                     _SEVERITY_NAME[code],
                     f"stream {match.group('stream')} is {_SEVERITY_NAME[code]}",
                 )
+            continue
+        # drift floor: sustained distribution shift on a served stream is an
+        # operational health state (warn -> stalling, critical -> degraded)
+        match = _DRIFT_HEALTH_RE.match(name)
+        if match is not None:
+            floor = _DRIFT_SEVERITY_HEALTH.get(max(0, min(int(value), 2)))
+            if floor is not None:
+                psi = gauges.get(f"drift.{match.group('stream')}.psi")
+                why = f"stream {match.group('stream')} is drifting"
+                escalate(floor, why if psi is None else f"{why} (psi {psi:.3f})")
             continue
         # fleet floor (federation aggregator probe): a process hosting an
         # aggregator is only as healthy as its sickest leaf
@@ -737,24 +758,33 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
             ))
         fleet = group_fleet_gauges(gauges)
         if fleet:
-            # the fleet tree: one aggregator row (coverage + totals), then
-            # one indented row per leaf under it
+            # the fleet tree: one aggregator row (coverage + leaf-state
+            # tallies), then one indented row per leaf grouped under it
             coverage = gauges.get("fleet.coverage")
+            leaf_states = {leaf: int(detail.get("state", 0)) for leaf, detail in fleet.items()}
+            worst = max((int(d.get("health_state", 0)) for d in fleet.values()), default=0)
             fleet_rows.append((
                 rank,
                 "fleet",
-                "-",
+                _SEVERITY_NAME[max(0, min(worst, 3))],
                 "-" if coverage is None else "{:.0f}%".format(100.0 * coverage),
-                _fmt_num(gauges.get("fleet.leaves")),
+                _fmt_num(gauges.get("fleet.leaves", len(fleet))),
+                _fmt_num(sum(1 for s in leaf_states.values() if s == 1)),
+                _fmt_num(sum(1 for s in leaf_states.values() if s == 3)),
+                _fmt_num(sum(int(d.get("streams", 0)) for d in fleet.values())),
                 _fmt_num(gauges.get("fleet.fold_seq")),
             ))
             for leaf, detail in sorted(fleet.items()):
                 code = max(0, min(int(detail.get("health_state", 0)), 3))
+                state_code = int(detail.get("state", 0))
                 fleet_rows.append((
                     rank,
                     f"└ {leaf}",
                     _SEVERITY_NAME[code],
-                    _LEAF_STATE_NAME.get(int(detail.get("state", 0)), "?"),
+                    _LEAF_STATE_NAME.get(state_code, "?"),
+                    "-",
+                    "yes" if state_code == 1 else "-",
+                    "yes" if state_code == 3 else "-",
                     _fmt_num(detail.get("streams")),
                     "-",
                 ))
@@ -767,7 +797,10 @@ def format_watch_table(statuses: List[Dict[str, Any]], stale_after_s: float = 10
         lines.append("")
         lines.extend(_render_table([stream_header, *stream_rows]))
     if fleet_rows:
-        fleet_header = ("rank", "fleet/leaf", "health", "state/cov", "streams", "fold_seq")
+        fleet_header = (
+            "rank", "fleet/leaf", "health", "state/cov", "leaves",
+            "lagging", "quarantined", "streams", "fold_seq",
+        )
         lines.append("")
         lines.extend(_render_table([fleet_header, *fleet_rows]))
     summary = ", ".join(f"{n} {state}" for state, n in sorted(states.items()))
@@ -834,7 +867,24 @@ def format_watch_json(statuses: List[Dict[str, Any]], stale_after_s: float = 10.
             if "circuit_state" in detail:
                 stream_row["circuit"] = _CIRCUIT_NAME.get(int(detail["circuit_state"]), "?")
             lines.append(json.dumps(stream_row, separators=(",", ":")))
-        for leaf, detail in sorted(group_fleet_gauges(gauges).items()):
+        fleet = group_fleet_gauges(gauges)
+        if fleet:
+            # the same hierarchy as the table: ONE aggregator row with the
+            # coverage/lagging/quarantined tallies, then its leaves
+            leaf_states = {leaf: int(detail.get("state", 0)) for leaf, detail in fleet.items()}
+            worst = max(0, min(max((int(d.get("health_state", 0)) for d in fleet.values()), default=0), 3))
+            lines.append(json.dumps({
+                "kind": "fleet",
+                "rank": rank,
+                "health": _SEVERITY_NAME[worst],
+                "coverage": gauges.get("fleet.coverage"),
+                "leaves": gauges.get("fleet.leaves", len(fleet)),
+                "lagging": sum(1 for s in leaf_states.values() if s == 1),
+                "quarantined": sum(1 for s in leaf_states.values() if s == 3),
+                "streams": sum(int(d.get("streams", 0)) for d in fleet.values()),
+                "fold_seq": gauges.get("fleet.fold_seq"),
+            }, separators=(",", ":")))
+        for leaf, detail in sorted(fleet.items()):
             code = max(0, min(int(detail.get("health_state", 0)), 3))
             leaf_row: Dict[str, Any] = {
                 "kind": "leaf",

@@ -18,8 +18,11 @@ __all__ = [
     "accuracy",
     "binary_accuracy",
     "binary_average_precision",
+    "cardinality",
     "checked_binary_accuracy",
     "collection",
+    "drift",
+    "heavy_hitters",
     "quantile",
     "sliced_accuracy",
 ]
@@ -78,6 +81,67 @@ def collection(num_classes: int = 4) -> Any:
             "auroc": MulticlassAUROC(num_classes=num_classes, validate_args=False),
         }
     )
+
+
+def drift(
+    reference: Any = None,
+    bins: int = 64,
+    lo: float = 0.0,
+    hi: float = 1.0,
+    thresholds: Any = None,
+    patience: int = 3,
+    reference_checkpoint: Any = None,
+    reference_path: Any = None,
+    reference_state: Any = None,
+) -> Any:
+    """A :class:`~torchmetrics_tpu.drift.DriftScore` stream — live-window
+    drift vs a pinned reference, published as ``drift.<stream>.*`` gauges
+    that can floor ``/healthz``.
+
+    All kwargs are wire-JSON-able: ``reference`` is a raw sample (list of
+    floats) binned at ``bins/lo/hi``; ``reference_checkpoint`` is a path to
+    a pickled PR-2 checkpoint payload to pin the reference from instead
+    (``reference_path``/``reference_state`` narrow the lookup);
+    ``thresholds`` maps score names to ``[warn, critical]`` pairs.
+    """
+    from torchmetrics_tpu.drift import DriftScore
+
+    ckpt = None
+    if reference_checkpoint is not None:
+        import pickle
+
+        with open(reference_checkpoint, "rb") as fh:
+            ckpt = pickle.load(fh)
+    if thresholds is not None:
+        thresholds = {k: tuple(v) if isinstance(v, (list, tuple)) else v for k, v in dict(thresholds).items()}
+    return DriftScore(
+        reference=reference,
+        bins=bins,
+        lo=lo,
+        hi=hi,
+        thresholds=thresholds,
+        patience=patience,
+        reference_checkpoint=ckpt,
+        reference_path=reference_path,
+        reference_state=reference_state,
+    )
+
+
+def cardinality(precision: int = 12) -> Any:
+    """A :class:`~torchmetrics_tpu.drift.Cardinality` stream — HyperLogLog
+    distinct count of the streamed tags (``drift.<stream>.cardinality``
+    gauge rides ``/metrics``)."""
+    from torchmetrics_tpu.drift import Cardinality
+
+    return Cardinality(precision=precision)
+
+
+def heavy_hitters(depth: int = 4, width: int = 1024, k: int = 32) -> Any:
+    """A :class:`~torchmetrics_tpu.drift.HeavyHitters` stream — top-``k``
+    hot tags via Count-Min; query via stream snapshots/compute."""
+    from torchmetrics_tpu.drift import HeavyHitters
+
+    return HeavyHitters(depth=depth, width=width, k=k)
 
 
 def sliced_accuracy(num_classes: int = 4, num_cells: int = 16, key_width: int = 1) -> Any:

@@ -1318,7 +1318,9 @@ class Stream:
             return code
 
     def gauges(self) -> Dict[str, float]:
-        """The ``serve.<name>.*`` gauge family (daemon probe fodder)."""
+        """The ``serve.<name>.*`` gauge family (daemon probe fodder), plus
+        the metric's own ``drift.<name>.*`` family when the target publishes
+        serve gauges (the drift subsystem: psi/kl/ks/severity/cardinality)."""
         prefix = f"serve.{self.spec.name}."
         with self._lock:
             state, qsize = self.state, self._queue.qsize()
@@ -1326,7 +1328,7 @@ class Stream:
             restarts, circuit = self.restarts, self.circuit
             deadletter_depth = len(self._deadletter)
             durable = self._durable and not self._dl_dirty
-        return {
+        out = {
             prefix + "health_state": float(self.health_code()),
             prefix + "state": float(STATE_CODES.get(state, 0)),
             prefix + "cursor": float(self.evaluator.cursor),
@@ -1338,3 +1340,11 @@ class Stream:
             prefix + "deadletter_depth": float(deadletter_depth),
             prefix + "durability": 1.0 if durable else 0.0,
         }
+        serve_fn = getattr(getattr(self.evaluator, "metric", None), "serve_gauges", None)
+        if callable(serve_fn):
+            try:
+                for key, val in serve_fn().items():
+                    out[f"drift.{self.spec.name}.{key}"] = float(val)
+            except Exception:  # a gauge read must never take the probe down
+                _obs_counters.inc("serve.gauge_read_failures")
+        return out

@@ -27,8 +27,22 @@ Sketches:
 - :class:`HistogramSketch` — fixed-bin streaming histogram (exact merge);
 - :class:`ReservoirSketch` — uniform sample via tagged top-k, PRNG key
   threaded through the state (no hidden RNG);
-- :class:`MomentsSketch` — Chan/Welford parallel-merge count/mean/M2.
+- :class:`MomentsSketch` — Chan/Welford parallel-merge count/mean/M2;
+- :class:`HLLSketch` — HyperLogLog distinct count, union merge by register
+  max, error ``1.04/sqrt(m)`` (:func:`hll_error_bound`);
+- :class:`CountMinSketch` — Count-Min frequency grid + SpaceSaving-style
+  heavy-hitter table; point queries upper-bound the true count.
 """
+from torchmetrics_tpu.sketch.countmin import (
+    CountMinSketch,
+    cm_error_bound,
+    cm_heavy_hitters,
+    cm_init,
+    cm_merge,
+    cm_point_query,
+    cm_state_bytes,
+    cm_update,
+)
 from torchmetrics_tpu.sketch.histogram import (
     HistogramSketch,
     hist_cdf,
@@ -37,6 +51,18 @@ from torchmetrics_tpu.sketch.histogram import (
     hist_merge,
     hist_quantile,
     hist_update,
+)
+from torchmetrics_tpu.sketch.hll import (
+    MAX_PRECISION,
+    MIN_PRECISION,
+    HLLSketch,
+    hll_cardinality,
+    hll_error_bound,
+    hll_init,
+    hll_merge,
+    hll_precision,
+    hll_state_bytes,
+    hll_update,
 )
 from torchmetrics_tpu.sketch.moments import (
     MomentsSketch,
@@ -79,17 +105,35 @@ from torchmetrics_tpu.sketch.reservoir import (
 )
 
 __all__ = [
+    "CountMinSketch",
+    "HLLSketch",
     "HistogramSketch",
     "KLLSketch",
+    "MAX_PRECISION",
     "MAX_STREAM",
+    "MIN_PRECISION",
     "MomentsSketch",
     "ReservoirSketch",
+    "cm_error_bound",
+    "cm_heavy_hitters",
+    "cm_init",
+    "cm_merge",
+    "cm_point_query",
+    "cm_state_bytes",
+    "cm_update",
     "hist_cdf",
     "hist_counts",
     "hist_init",
     "hist_merge",
     "hist_quantile",
     "hist_update",
+    "hll_cardinality",
+    "hll_error_bound",
+    "hll_init",
+    "hll_merge",
+    "hll_precision",
+    "hll_state_bytes",
+    "hll_update",
     "is_sketch_state",
     "kll_cdf",
     "kll_error_bound",

@@ -1,0 +1,178 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""Count-Min sketch with a SpaceSaving-style heavy-hitter track.
+
+Frequency estimation in fixed memory: a ``[depth, width]`` int32 counter grid
+where every item increments one cell per row (row-seeded murmur hashes) and a
+point query takes the **min** over rows — always an upper bound on the true
+count, and at most ``true + (e/width) * N`` with probability ``1 - e^-depth``
+(Cormode & Muthukrishnan 2005). The grid merge is exact elementwise addition,
+so it is associative/commutative and rides ``dist_reduce_fx="merge"``
+unchanged.
+
+Top-k label skew needs names, not just counts, so a fixed-``k`` candidate
+table rides along (SpaceSaving-style: the minimum-estimate candidate is
+evicted when a larger newcomer arrives, with estimates re-scored against the
+counter grid). The table is a heuristic view — merge re-scores the union of
+both sides' candidates against the merged grid and keeps the top ``k`` with a
+deterministic (count desc, key asc) tie-break, so merged tables are
+reproducible even though candidate *recall* is approximate.
+
+Items are opaque 32-bit tags exactly as in :mod:`torchmetrics_tpu.sketch.hll`
+(integers cast, floats bit-cast).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.hll import _as_tags, _fmix32
+from torchmetrics_tpu.sketch.registry import register_sketch_state
+
+Array = jax.Array
+
+
+class CountMinSketch(NamedTuple):
+    """Registered pytree state of the Count-Min + heavy-hitter sketch."""
+
+    counts: Array  #: (depth, width) int32 counter grid
+    hh_keys: Array  #: (k,) uint32 heavy-hitter candidate tags
+    hh_counts: Array  #: (k,) int32 candidate count estimates (0 = empty slot)
+    count: Array  #: () int32 total items folded in
+
+
+def _row_seeds(depth: int) -> Array:
+    """Deterministic per-row hash seeds — a pure function of the row index,
+    so any two sketches of the same depth hash identically and merge exactly."""
+    rows = jnp.arange(1, depth + 1, dtype=jnp.uint32)
+    return _fmix32(rows * jnp.uint32(0x9E3779B9))
+
+
+def _columns(tags: Array, depth: int, width: int) -> Array:
+    """(depth, n) column index per row for each tag."""
+    seeds = _row_seeds(depth)
+    h = _fmix32(tags[None, :] ^ seeds[:, None])
+    return (h % jnp.uint32(width)).astype(jnp.int32)
+
+
+def cm_init(depth: int = 4, width: int = 1024, k: int = 32) -> CountMinSketch:
+    """Empty Count-Min grid with a ``k``-slot heavy-hitter table.
+
+    Defaults give overestimate ``<= e/1024 * N ~ 0.27% of N`` per query with
+    probability ``1 - e^-4 ~ 98%`` in 16 KiB of grid state.
+    """
+    if depth < 1:
+        raise ValueError(f"need depth >= 1, got {depth}")
+    if width < 2:
+        raise ValueError(f"need width >= 2, got {width}")
+    if k < 1:
+        raise ValueError(f"need k >= 1, got {k}")
+    return CountMinSketch(
+        counts=jnp.zeros((depth, width), jnp.int32),
+        hh_keys=jnp.zeros((k,), jnp.uint32),
+        hh_counts=jnp.zeros((k,), jnp.int32),
+        count=jnp.asarray(0, jnp.int32),
+    )
+
+
+def _point(counts: Array, tags: Array) -> Array:
+    """Min-over-rows count estimate for each tag (the CM upper bound)."""
+    depth, width = counts.shape
+    cols = _columns(tags, depth, width)
+    gathered = jnp.take_along_axis(counts, cols, axis=1)  # (depth, n)
+    return jnp.min(gathered, axis=0)
+
+
+def cm_update(state: CountMinSketch, x: Array) -> CountMinSketch:
+    """Fold a batch of tags in (jit-safe; shapes preserved).
+
+    The grid takes one vectorized scatter-add; the heavy-hitter table is then
+    maintained per-item with a ``lax.scan`` over the batch (fixed-shape
+    carry), scoring candidates against the post-batch grid.
+    """
+    tags = _as_tags(x)
+    if tags.size == 0:
+        return state
+    depth, width = state.counts.shape
+    cols = _columns(tags, depth, width)
+    rows = jnp.broadcast_to(jnp.arange(depth, dtype=jnp.int32)[:, None], cols.shape)
+    counts = state.counts.at[rows, cols].add(1)
+
+    def track(carry, tag):
+        keys, cnts = carry
+        est = _point(counts, tag[None])[0]
+        tracked = (keys == tag) & (cnts > 0)
+        any_tracked = jnp.any(tracked)
+        pos_min = jnp.argmin(cnts)
+        pos = jnp.where(any_tracked, jnp.argmax(tracked), pos_min)
+        admit = any_tracked | (est > cnts[pos_min])
+        new_cnt = jnp.where(any_tracked, jnp.maximum(cnts[pos], est), est)
+        keys = jnp.where(admit, keys.at[pos].set(tag), keys)
+        cnts = jnp.where(admit, cnts.at[pos].set(new_cnt), cnts)
+        return (keys, cnts), None
+
+    (hh_keys, hh_counts), _ = jax.lax.scan(track, (state.hh_keys, state.hh_counts), tags)
+    return CountMinSketch(
+        counts=counts,
+        hh_keys=hh_keys,
+        hh_counts=hh_counts,
+        count=state.count + jnp.asarray(tags.size, jnp.int32),
+    )
+
+
+def _top_k(keys: Array, ests: Array, k: int) -> Tuple[Array, Array]:
+    """Deterministic top-``k`` by (count desc, key asc); zero counts lose."""
+    order = jnp.lexsort((keys, -ests))
+    return keys[order[:k]], ests[order[:k]]
+
+
+def cm_merge(a: CountMinSketch, b: CountMinSketch) -> CountMinSketch:
+    """Merge: grid counts add EXACTLY (same geometry hashes identically);
+    the heavy-hitter union is re-scored against the merged grid and the top
+    ``k`` kept with a deterministic tie-break."""
+    if a.counts.shape != b.counts.shape or a.hh_keys.shape != b.hh_keys.shape:
+        raise ValueError(
+            "cannot merge Count-Min sketches of different geometry:"
+            f" {a.counts.shape}+{a.hh_keys.shape} vs {b.counts.shape}+{b.hh_keys.shape}"
+        )
+    counts = a.counts + b.counts
+    cand_keys = jnp.concatenate([a.hh_keys, b.hh_keys])
+    valid = jnp.concatenate([a.hh_counts > 0, b.hh_counts > 0])
+    ests = jnp.where(valid, _point(counts, cand_keys), 0)
+    # drop later duplicates of the same key so one item can't hold two slots
+    same = (cand_keys[None, :] == cand_keys[:, None]) & valid[:, None] & valid[None, :]
+    dup_of_earlier = jnp.any(jnp.tril(same, -1), axis=1)
+    ests = jnp.where(dup_of_earlier, 0, ests)
+    hh_keys, hh_counts = _top_k(cand_keys, ests, a.hh_keys.shape[0])
+    return CountMinSketch(counts=counts, hh_keys=hh_keys, hh_counts=hh_counts, count=a.count + b.count)
+
+
+def cm_point_query(state: CountMinSketch, x: Array) -> Array:
+    """Estimated count(s) for tag(s) ``x`` — never below the true count."""
+    tags = _as_tags(x)
+    return _point(state.counts, tags)
+
+
+def cm_heavy_hitters(state: CountMinSketch) -> Tuple[Array, Array]:
+    """``(keys, counts)`` candidate table sorted by (count desc, key asc);
+    slots with count 0 are empty."""
+    return _top_k(state.hh_keys, state.hh_counts, state.hh_keys.shape[0])
+
+
+def cm_error_bound(state: CountMinSketch) -> float:
+    """Additive overestimate bound ``(e/width) * N`` that holds per point
+    query with probability ``1 - e^-depth`` (host-side; reads ``count``)."""
+    import math
+
+    depth, width = state.counts.shape
+    return math.e / width * int(state.count)
+
+
+def cm_state_bytes(depth: int = 4, width: int = 1024, k: int = 32) -> int:
+    """Fixed state footprint in bytes for a given geometry."""
+    return depth * width * 4 + k * 8 + 4
+
+
+register_sketch_state(CountMinSketch, cm_merge)

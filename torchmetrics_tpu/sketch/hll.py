@@ -1,0 +1,149 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""HyperLogLog — fixed-shape mergeable distinct-count sketch.
+
+The canonical "millions of users" counter: ``m = 2**precision`` one-byte-ish
+registers (stored int32 for scatter-max friendliness) estimate the number of
+DISTINCT values folded in with relative standard error ``1.04/sqrt(m)``
+(Flajolet et al. 2007), independent of stream length. Merging two sketches of
+the same precision is an elementwise register ``max`` — exactly the union of
+the two multisets, so it is associative, commutative, and idempotent: folding
+the same shard twice cannot double-count, which is what makes the fleet-fold
+and window regimes safe for cardinality.
+
+Values are hashed on-device with the murmur3 finalizer (``fmix32``), an
+avalanche permutation of the 32-bit value — inputs are taken as opaque
+32-bit tags (integers cast, floats bit-cast), so "distinct" means distinct
+bit patterns.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.sketch.registry import register_sketch_state
+
+Array = jax.Array
+
+#: precision bounds: below 4 the bias correction breaks down, above 16 the
+#: register file (2**p int32) stops being "small sketch state"
+MIN_PRECISION = 4
+MAX_PRECISION = 16
+
+
+class HLLSketch(NamedTuple):
+    """Registered pytree state of the HyperLogLog sketch."""
+
+    registers: Array  #: (m,) int32 max leading-zero rank seen per register
+    count: Array  #: () int32 total values folded in (not distinct count)
+
+
+def _fmix32(h: Array) -> Array:
+    """Murmur3 32-bit finalizer: a full-avalanche bijection on uint32."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _as_tags(x: Array) -> Array:
+    """Flatten input to opaque uint32 tags (floats bit-cast, ints cast)."""
+    x = jnp.ravel(jnp.asarray(x))
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def hll_init(precision: int = 12) -> HLLSketch:
+    """Empty HyperLogLog with ``2**precision`` registers.
+
+    The default ``precision=12`` (4096 registers, 16 KiB of int32 state) has
+    ~1.6% standard error — the usual production point for user counting.
+    """
+    if not MIN_PRECISION <= precision <= MAX_PRECISION:
+        raise ValueError(f"need {MIN_PRECISION} <= precision <= {MAX_PRECISION}, got {precision}")
+    return HLLSketch(
+        registers=jnp.zeros((1 << precision,), jnp.int32),
+        count=jnp.asarray(0, jnp.int32),
+    )
+
+
+def hll_precision(state: HLLSketch) -> int:
+    """Recover the precision from the (static) register-file shape."""
+    m = state.registers.shape[0]
+    return int(m).bit_length() - 1
+
+
+def hll_update(state: HLLSketch, x: Array) -> HLLSketch:
+    """Fold a batch of tags in (jit-safe scatter-max; shapes preserved)."""
+    tags = _as_tags(x)
+    if tags.size == 0:
+        return state
+    p = hll_precision(state)
+    h = _fmix32(tags)
+    idx = (h >> jnp.uint32(32 - p)).astype(jnp.int32)
+    # rank = leading zeros of the remaining (32-p)-bit suffix, plus one;
+    # an all-zero suffix gets the max rank 32-p+1
+    suffix = h << jnp.uint32(p)
+    rho = jnp.minimum(jax.lax.clz(suffix).astype(jnp.int32) + 1, 32 - p + 1)
+    return HLLSketch(
+        registers=state.registers.at[idx].max(rho),
+        count=state.count + jnp.asarray(tags.size, jnp.int32),
+    )
+
+
+def hll_merge(a: HLLSketch, b: HLLSketch) -> HLLSketch:
+    """Union merge: elementwise register max (idempotent on shared items).
+    Both sketches must share the precision (register-file shape)."""
+    if a.registers.shape != b.registers.shape:
+        raise ValueError(
+            f"cannot merge HLL sketches of different precision: {a.registers.shape} vs {b.registers.shape}"
+        )
+    return HLLSketch(
+        registers=jnp.maximum(a.registers, b.registers),
+        count=a.count + b.count,
+    )
+
+
+def hll_cardinality(state: HLLSketch) -> Array:
+    """Bias-corrected estimate of the number of distinct tags folded in.
+
+    The raw harmonic-mean estimate ``alpha_m * m^2 / sum(2^-M_j)`` is
+    corrected at both ends (Flajolet et al. 2007 §4): linear counting
+    ``m * ln(m/V)`` when the estimate is small and some registers are still
+    empty, and the 32-bit-hash saturation correction when the estimate
+    approaches ``2^32``. Pure jnp; jit-safe.
+    """
+    m = state.registers.shape[0]
+    if m >= 128:
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+    else:
+        alpha = {16: 0.673, 32: 0.697, 64: 0.709}[m]
+    regs = state.registers.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    raw = alpha * m * m / jnp.sum(jnp.exp2(-regs))
+    zeros = jnp.sum(state.registers == 0).astype(raw.dtype)
+    # small-range: linear counting while empty registers remain
+    linear = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    est = jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+    # large-range: correct 32-bit hash-collision saturation
+    two32 = jnp.asarray(2.0**32, est.dtype)
+    est = jnp.where(est > two32 / 30.0, -two32 * jnp.log1p(-est / two32), est)
+    return est
+
+
+def hll_error_bound(state: HLLSketch) -> float:
+    """Published relative standard error of :func:`hll_cardinality`:
+    ``1.04 / sqrt(m)`` (e.g. ~1.6% at precision 12)."""
+    return 1.04 / float(state.registers.shape[0]) ** 0.5
+
+
+def hll_state_bytes(precision: int = 12) -> int:
+    """Fixed state footprint in bytes for a given precision."""
+    return (1 << precision) * 4 + 4
+
+
+register_sketch_state(HLLSketch, hll_merge)
