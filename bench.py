@@ -323,6 +323,7 @@ def main() -> None:
             bench_federated_fold,
             bench_fid50k,
             bench_fused_suite,
+            bench_guarded_ingest,
             bench_live_publish,
             bench_retrieval_ndcg,
             bench_serve_sustained,
@@ -372,6 +373,9 @@ def main() -> None:
             # sustained multi-stream ingest through the metricserve daemon
             # (ISSUE 14): host+disk only, asserts zero dropped batches
             ("serve_sustained_streams", bench_serve_sustained, (), 45),
+            # StateGuard mask/rollback under serve load (ISSUE 20): host+disk
+            # only, asserts the masked-row and rollback accounting
+            ("guarded_ingest_throughput", bench_guarded_ingest, (), 45),
             # two-tier fleet fold rounds over real leaf daemons (ISSUE 17):
             # host+HTTP only, self-checks fold parity before timing
             ("federated_fold_throughput", bench_federated_fold, (), 40),

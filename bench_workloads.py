@@ -682,6 +682,103 @@ def bench_serve_sustained(n_batches: int = 24, repeats: int = 3) -> Dict:
     }
 
 
+def bench_guarded_ingest(n_batches: int = 24, repeats: int = 3) -> Dict:
+    """``guarded_ingest_throughput``: StateGuard (ISSUE 20) under serve-plane
+    load. A mask-policy ``guarded_binary_accuracy`` stream ingests wire-shaped
+    batches carrying a fixed ~1% of invalid rows (NaN / out-of-range prob /
+    bad label) that the compiled contract must drop in-graph, while a
+    propagate+probe ``guarded_mean_squared_error`` stream takes two poison
+    frames and must roll back from its known-good ring both times. Headline
+    is the guarded stream's drained samples/s; ``ratio_vs_unguarded``
+    compares an identical unguarded stream fed the same traffic (the guard's
+    end-to-end overhead), and the accounting — ``masked_rows`` equal to the
+    injected count, ``rollbacks == 2``, both poison seqs quarantined — is
+    ASSERTED, so a silently disabled guard fails the leg instead of
+    recording a fast run."""
+    import shutil
+    import tempfile
+
+    from torchmetrics_tpu.serve import ServeDaemon
+
+    rng = np.random.RandomState(0)
+    batch = 2048
+    n = batch * n_batches
+    preds = rng.rand(n).astype(np.float64)
+    target = rng.randint(0, 2, n)
+    bad = rng.choice(n, size=max(1, n // 100), replace=False)  # ~1% invalid rows
+    preds[bad[0::3]] = np.nan
+    preds[bad[1::3]] = 1.5
+    target[bad[2::3]] = 7
+    n_invalid = len(bad)
+    wire = [
+        [np.array_split(preds, n_batches)[k].tolist(), np.array_split(target, n_batches)[k].tolist()]
+        for k in range(n_batches)
+    ]
+    mse_frames = [[[0.1, 0.2, 0.3, 0.4], [0.0, 1.0, 0.5, 0.25]] for _ in range(6)]
+    mse_frames[2] = [[float("nan"), 0.5, 0.25, 0.75], [0.0, 1.0, 0.0, 1.0]]
+    mse_frames[4] = [[0.5, float("nan"), 0.25, 0.75], [0.0, 1.0, 0.0, 1.0]]
+
+    specs = {
+        "guarded": {"name": "guarded",
+                    "target": "torchmetrics_tpu.serve.factories:guarded_binary_accuracy",
+                    "kwargs": {"policy": "mask"}, "snapshot_every_n": 8, "use_feed": False},
+        "plain": {"name": "plain",
+                  "target": "torchmetrics_tpu.serve.factories:binary_accuracy",
+                  "snapshot_every_n": 8, "use_feed": False},
+        "mse": {"name": "mse",
+                "target": "torchmetrics_tpu.serve.factories:guarded_mean_squared_error",
+                "snapshot_every_n": 2, "guard_recover_s": 1.0, "use_feed": False},
+    }
+
+    def ingest_stream(daemon, name, batches, t_accum):
+        t0 = time.perf_counter()
+        for seq, payload in enumerate(batches):
+            reply = daemon.ingest(name, seq, payload, block=True, deadline_s=120.0)
+            if not reply.get("ok"):
+                raise RuntimeError(f"ingest {name}[{seq}]: {reply}")
+        reply = daemon.drain_stream(name)
+        if not reply.get("ok"):
+            raise RuntimeError(f"drain {name}: {reply}")
+        t_accum[name] = t_accum.get(name, 0.0) + (time.perf_counter() - t0)
+
+    runs, ratios = [], []
+    for _ in range(repeats):
+        base = tempfile.mkdtemp(prefix="tm_tpu_guard_bench_")
+        daemon = ServeDaemon(base, publish=False).start()
+        try:
+            for name in sorted(specs):
+                reply = daemon.create_stream(specs[name])
+                if not reply.get("ok"):
+                    raise RuntimeError(f"create {name}: {reply}")
+            elapsed: Dict[str, float] = {}
+            ingest_stream(daemon, "guarded", wire, elapsed)
+            ingest_stream(daemon, "plain", wire, elapsed)
+            ingest_stream(daemon, "mse", mse_frames, elapsed)
+            by_name = {s["name"]: s for s in daemon.status()["streams"]}
+            guard = by_name["guarded"].get("guard") or {}
+            if guard.get("masked_rows") != n_invalid:
+                raise RuntimeError(
+                    f"mask accounting drifted: {guard.get('masked_rows')} != {n_invalid} injected"
+                )
+            mse_guard = by_name["mse"].get("guard") or {}
+            if mse_guard.get("rollbacks") != 2 or mse_guard.get("poisoned") != 2:
+                raise RuntimeError(f"rollback drill failed: {mse_guard}")
+        finally:
+            daemon.shutdown(drain=False)
+            shutil.rmtree(base, ignore_errors=True)
+        runs.append(n / elapsed["guarded"])
+        ratios.append(elapsed["guarded"] / elapsed["plain"])
+    return {
+        "runs": runs,
+        "unit": "samples/s",
+        "baseline": None,
+        "batches": n_batches,
+        "invalid_rows": n_invalid,
+        "rollbacks": 2,
+        "ratio_vs_unguarded": round(sorted(ratios)[len(ratios) // 2], 3),
+    }
+
+
 def bench_federated_fold(n_leaves: int = 3, n_batches: int = 6, repeats: int = 3) -> Dict:
     """``federated_fold_throughput``: the two-tier fleet aggregator (ISSUE 17)
     folding merge states pulled from real leaf daemons. ``n_leaves``

@@ -1,0 +1,243 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""StateGuard parity suite (ISSUE 20): the ``mask`` policy must equal
+host-side row filtering BITWISE — eager, under ``jit`` (make_jit_update),
+under ``lax.scan``, and under a ``SlicedPlan`` — and ``reject`` must leave
+state bitwise untouched on a vetoed batch. The poison probe must latch at
+the offending batch, not at compute()."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.classification.calibration_error import BinaryCalibrationError
+from torchmetrics_tpu.classification.precision_recall_curve import BinaryPrecisionRecallCurve
+from torchmetrics_tpu.classification.stat_scores import MultilabelStatScores
+from torchmetrics_tpu.parallel.sharded import fold_jit_state, make_jit_update
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.robustness.guard import (
+    enable_guard,
+    guard_counters,
+    guard_ineligibility,
+    guarded_policy,
+)
+
+NAN, INF = float("nan"), float("inf")
+
+
+def _filter_rows(batch, valid):
+    """Host-side reference filter: keep only the rows the contract accepts."""
+    keep = np.nonzero(valid)[0]
+    return tuple(np.asarray(a)[keep] for a in batch)
+
+
+def _binary_valid(preds, target):
+    p, t = np.asarray(preds, dtype=np.float64), np.asarray(target, dtype=np.float64)
+    return (
+        np.isfinite(p) & (p >= 0.0) & (p <= 1.0) & np.isfinite(t) & ((t == 0) | (t == 1))
+    )
+
+
+def _multiclass_valid(preds, target, num_classes):
+    p, t = np.asarray(preds, dtype=np.float64), np.asarray(target)
+    return np.isfinite(p).all(axis=1) & (t >= 0) & (t < num_classes)
+
+
+def _multilabel_valid(preds, target):
+    p, t = np.asarray(preds, dtype=np.float64), np.asarray(target)
+    return (
+        (np.isfinite(p) & (p >= 0.0) & (p <= 1.0)).all(axis=1)
+        & ((t == 0) | (t == 1)).all(axis=1)
+    )
+
+
+def _mse_valid(preds, target):
+    p, t = np.asarray(preds, dtype=np.float64), np.asarray(target, dtype=np.float64)
+    return np.isfinite(p) & np.isfinite(t)
+
+
+# Every batch is fixed-shape (6 rows) so the same schedule drives the eager,
+# jit, scan and sliced paths; each batch keeps at least one valid row so the
+# filtered reference never sees an empty update.
+_BINARY_BATCHES = [
+    (np.array([0.9, 0.2, NAN, 0.7, 0.4, 0.6]), np.array([1, 0, 1, 1, 0, 1])),
+    (np.array([0.8, INF, 0.1, 0.3, 1.5, 0.2]), np.array([1, 1, 0, 0, 1, 0])),
+    (np.array([0.6, 0.4, 0.2, 0.9, 0.5, 0.1]), np.array([1, 7, 0, 1, 0, 0])),
+]
+_MULTICLASS_BATCHES = [
+    (
+        np.array([[2.0, 1.0, 0.5], [NAN, 0.0, 1.0], [0.1, 3.0, 0.2],
+                  [1.0, 1.0, 4.0], [0.5, 0.5, 0.5], [2.0, 0.1, 0.1]]),
+        np.array([0, 1, 1, 2, 5, 0]),
+    ),
+    (
+        np.array([[1.0, 2.0, 3.0], [0.0, INF, 0.0], [4.0, 0.0, 0.0],
+                  [0.2, 0.3, 0.4], [1.0, 0.0, 2.0], [0.0, 1.0, 0.0]]),
+        np.array([2, 1, 0, -1, 2, 1]),
+    ),
+]
+_MULTILABEL_BATCHES = [
+    (
+        np.array([[0.9, 0.1], [NAN, 0.5], [0.3, 0.8],
+                  [0.7, 3.0], [0.2, 0.6], [0.5, 0.5]]),
+        np.array([[1, 0], [1, 1], [0, 1], [1, 0], [0, 2], [1, 1]]),
+    ),
+]
+_MSE_BATCHES = [
+    (np.array([0.1, NAN, 0.3, 0.4, 0.5, 0.6]), np.array([0.0, 1.0, 0.5, 0.25, 1.0, 0.0])),
+    (np.array([0.9, 0.8, INF, 0.2, 0.1, 0.4]), np.array([1.0, 1.0, 0.0, NAN, 0.0, 0.5])),
+]
+
+CASES = {
+    "binary_accuracy": (
+        lambda: BinaryAccuracy(),
+        _BINARY_BATCHES,
+        lambda p, t: _binary_valid(p, t),
+    ),
+    "multiclass_accuracy": (
+        lambda: MulticlassAccuracy(num_classes=3, average="micro"),
+        _MULTICLASS_BATCHES,
+        lambda p, t: _multiclass_valid(p, t, 3),
+    ),
+    "multilabel_stat_scores": (
+        lambda: MultilabelStatScores(num_labels=2, average="micro"),
+        _MULTILABEL_BATCHES,
+        lambda p, t: _multilabel_valid(p, t),
+    ),
+    "binary_calibration_error": (
+        lambda: BinaryCalibrationError(n_bins=5),
+        _BINARY_BATCHES,
+        lambda p, t: _binary_valid(p, t),
+    ),
+    "mean_squared_error": (
+        lambda: MeanSquaredError(),
+        _MSE_BATCHES,
+        lambda p, t: _mse_valid(p, t),
+    ),
+}
+
+
+def _reference(factory, batches, valid_fn):
+    """The unguarded metric fed ONLY the rows the contract accepts."""
+    ref = factory()
+    ref.validate_args = False  # the clean rows are valid; skip the host sync
+    for batch in batches:
+        kept = _filter_rows(batch, valid_fn(*batch))
+        if len(kept[0]):  # an all-invalid batch contributes nothing
+            ref.update(*kept)
+    return ref
+
+
+def _assert_bitwise_equal(got, want):
+    got, want = jax.tree_util.tree_leaves(got), jax.tree_util.tree_leaves(want)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_mask_matches_host_filtered_rows_eager(case):
+    factory, batches, valid_fn = CASES[case]
+    guarded = enable_guard(factory(), policy="mask")
+    for batch in batches:
+        guarded.update(*batch)
+    _assert_bitwise_equal(guarded.compute(), _reference(factory, batches, valid_fn).compute())
+    invalid = sum(int((~valid_fn(*b)).sum()) for b in batches)
+    counters = guard_counters(guarded)
+    assert counters["masked_rows"] == invalid
+    assert counters["poisoned"] == 0
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_mask_matches_host_filtered_rows_under_jit(case):
+    factory, batches, valid_fn = CASES[case]
+    guarded = enable_guard(factory(), policy="mask")
+    step, state = make_jit_update(guarded)
+    for batch in batches:
+        state = step(state, *(jnp.asarray(a) for a in batch))
+    fold_jit_state(guarded, state)
+    _assert_bitwise_equal(guarded.compute(), _reference(factory, batches, valid_fn).compute())
+    invalid = sum(int((~valid_fn(*b)).sum()) for b in batches)
+    assert guard_counters(guarded)["masked_rows"] == invalid
+
+
+@pytest.mark.parametrize("case", ["binary_accuracy", "mean_squared_error"])
+def test_mask_matches_host_filtered_rows_under_scan(case):
+    factory, batches, valid_fn = CASES[case]
+    guarded = enable_guard(factory(), policy="mask")
+    step, init = make_jit_update(guarded)
+    stacked = tuple(jnp.stack([jnp.asarray(b[i]) for b in batches]) for i in range(2))
+
+    def body(state, xs):
+        return step(state, *xs), None
+
+    final, _ = jax.lax.scan(body, init, stacked)
+    fold_jit_state(guarded, final)
+    _assert_bitwise_equal(guarded.compute(), _reference(factory, batches, valid_fn).compute())
+
+
+def test_mask_matches_host_filtered_rows_under_sliced_plan():
+    factory, batches, valid_fn = CASES["binary_accuracy"]
+    template = enable_guard(factory(), policy="mask")
+    plan = template.sliced(num_cells=8)
+    keys = np.array([0, 1, 2, 0, 1, 2])
+    for batch in batches:
+        plan.update(keys, *(jnp.asarray(a) for a in batch))
+    results = plan.results()
+    for cohort in (0, 1, 2):
+        rows = keys == cohort
+        cohort_batches = [tuple(np.asarray(a)[rows] for a in b) for b in batches]
+        want = _reference(factory, cohort_batches, valid_fn).compute()
+        _assert_bitwise_equal(results[(cohort,)], want)
+
+
+def test_reject_vetoes_bad_batch_bitwise():
+    factory, batches, valid_fn = CASES["binary_accuracy"]
+    guarded = enable_guard(factory(), policy="reject")
+    clean = (np.array([0.9, 0.2, 0.7]), np.array([1, 0, 1]))
+    guarded.update(*clean)
+    before = {k: np.asarray(v) for k, v in guarded._copy_state_dict().items()
+              if not k.startswith("guard_")}
+    guarded.update(*batches[0])  # carries a NaN row -> whole batch vetoed
+    after = guarded._copy_state_dict()
+    for name, prior in before.items():
+        np.testing.assert_array_equal(prior, np.asarray(after[name]))
+    counters = guard_counters(guarded)
+    assert counters["rejected_batches"] == 1
+    assert counters["nan_rows"] == 1
+    # a vetoed batch must not perturb the final value either
+    ref = factory()
+    ref.validate_args = False
+    ref.update(*clean)
+    _assert_bitwise_equal(guarded.compute(), ref.compute())
+
+
+def test_propagate_probe_latches_at_offending_batch():
+    guarded = enable_guard(MeanSquaredError(), policy="propagate")
+    guarded.update(np.array([0.1, 0.2]), np.array([0.0, 1.0]))
+    assert guard_counters(guarded)["poisoned"] == 0
+    guarded.update(np.array([NAN, 0.5]), np.array([1.0, 0.0]))
+    # detected at the batch that poisoned the state — no compute() needed
+    counters = guard_counters(guarded)
+    assert counters["poisoned"] == 1
+    assert counters["nan_rows"] == 1
+    guarded.update(np.array([0.3, 0.4]), np.array([0.0, 0.0]))
+    assert guard_counters(guarded)["poisoned"] == 1  # the latch holds
+
+
+def test_guard_refuses_cat_states_and_missing_contracts():
+    curve = BinaryPrecisionRecallCurve(thresholds=None)
+    with pytest.raises(ValueError, match="ML013"):
+        enable_guard(curve, policy="propagate")  # no domain contract declared
+    contract = BinaryAccuracy().domain_contract()
+    reason = guard_ineligibility(curve, "mask")
+    assert reason is not None and "cat" in reason
+    with pytest.raises(ValueError, match="ineligible"):
+        enable_guard(BinaryPrecisionRecallCurve(thresholds=None), policy="mask", contract=contract)
+    guarded = enable_guard(BinaryAccuracy(), policy="mask")
+    assert guarded_policy(guarded) == "mask"
+    with pytest.raises(ValueError, match="already guarded"):
+        enable_guard(guarded, policy="mask")

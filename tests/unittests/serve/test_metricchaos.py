@@ -54,6 +54,26 @@ def test_short_soak_upholds_invariants(tmp_path):
     assert isinstance(legs["circuit"]["results"], float)
 
 
+@pytest.mark.timeout(420)
+def test_poison_soak_upholds_guard_invariants(tmp_path):
+    """Seeded StateGuard drill (ISSUE 20): the mask stream matches a
+    reference fed the valid ROWS, the reject stream a reference fed the
+    valid BATCHES, and the propagate+probe MSE stream rolls back from its
+    in-memory known-good ring (2-second recovery window), quarantines both
+    NaN frames with their guard verdicts, and walks /healthz
+    200 → 503 → 200. The harness asserts every invariant; this test asserts
+    the leg ran and accounted for every injected frame."""
+    report = _report(_run_soak(tmp_path, "--mode", "poison", "--seed", "11"))
+    (leg,) = report["legs"]
+    assert leg["leg"] == "poison"
+    assert leg["quarantined"] == [2, 4]
+    assert leg["rollbacks"] == 2
+    assert leg["masked_rows"] == 4
+    assert leg["rejected_batches"] == 2
+    assert leg["health_walk"] == ["ok", "degraded", "ok"]
+    assert all(isinstance(v, float) for v in leg["results"].values())
+
+
 # the harness's jax-free property is gated statically by ML010 plus one
 # poisoned-jax smoke in tests/unittests/lint/test_jaxfree_surfaces.py
 

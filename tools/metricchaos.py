@@ -16,6 +16,9 @@ invariants end to end::
     # federation drill: leaf fleet + aggregator under kills and corruption
     python tools/metricchaos.py --workdir /tmp/chaos --mode fleet
 
+    # StateGuard drill: mask/reject sanitization + poison-probe rollback
+    python tools/metricchaos.py --workdir /tmp/chaos --mode poison
+
 The short soak is two legs:
 
 - **main leg** — one stream fed a schedule mixing a transient worker crash
@@ -40,6 +43,20 @@ Invariants asserted every leg:
    count,
 4. ``/healthz`` reflects ``degraded`` / ``stalled`` / ``ok`` at the right
    times.
+
+The **poison mode** drills the StateGuard (ISSUE 20): one daemon hosts a
+``mask``-policy stream fed batches with NaN/Inf/out-of-domain rows mixed in,
+a ``reject``-policy stream fed whole poisoned batches, and a
+``propagate``-policy MSE stream fed NaN frames that corrupt state and trip
+the in-program poison probe. Asserted invariants: the mask stream's drained
+result is BITWISE equal to a reference fed the same batches with the invalid
+ROWS stripped; the reject stream matches a reference fed only the valid
+BATCHES; the MSE stream rolls back to the known-good in-memory ring (no disk
+restore), quarantines each poison frame to ``deadletter.jsonl`` WITH its
+guard verdict, walks ``/healthz`` 200 → 503 → 200 as the rollback window
+drains, and still drains bitwise-equal to a reference fed only the valid
+frames; every injected frame is accounted for in the ``guard.<stream>.*``
+gauges plus the ledger.
 
 The **fleet mode** runs the federation drill: N real leaf daemons plus one
 corrupt HTTP stub under a ``fleet serve`` aggregator; a leaf is SIGKILLed
@@ -381,6 +398,164 @@ def run_circuit_leg(workdir: str, seed: int, n_batches: int = 6):
     return {"leg": "circuit", "seed": seed, "results": got, "restarts": status["restarts"]}
 
 
+# ------------------------------------------------------------------ poison
+
+
+_GUARDED_ACC = "torchmetrics_tpu.serve.factories:guarded_binary_accuracy"
+_GUARDED_MSE = "torchmetrics_tpu.serve.factories:guarded_mean_squared_error"
+
+NAN = float("nan")
+INF = float("inf")
+
+
+def _strip_invalid_rows(batch):
+    """Host-side truth of the ``mask`` policy for the guarded binary-accuracy
+    contract: drop rows with a non-finite pred, a pred outside [0, 1], or a
+    target outside {0, 1}."""
+    import math
+
+    preds, target = batch
+    keep = [
+        i for i, (p, t) in enumerate(zip(preds, target))
+        if math.isfinite(p) and 0.0 <= p <= 1.0 and t in (0, 1)
+    ]
+    return [[preds[i] for i in keep], [target[i] for i in keep]]
+
+
+def _create_stream(daemon: Daemon, name: str, target: str, **fields):
+    _, reply = daemon.http("POST", "/v1/streams", {
+        "name": name, "target": target, "snapshot_every_n": 2, "use_feed": False, **fields,
+    })
+    _check(reply.get("ok"), f"create {name} failed: {reply}")
+
+
+def _feed_and_drain(daemon: Daemon, name: str, batches):
+    for seq, batch in enumerate(batches):
+        _ingest(daemon, name, seq, batch)
+    _, reply = daemon.http("POST", f"/v1/streams/{name}/drain")
+    _check(reply.get("ok"), f"drain {name} failed: {reply}")
+    return reply["results"]
+
+
+def run_poison_leg(workdir: str, seed: int, recover_s: float = 2.0):
+    """The StateGuard drill (see the module docstring): sanitize (mask),
+    veto (reject) and rollback (propagate + probe) on one live daemon, with
+    the 200 → 503 → 200 ``/healthz`` walk and bitwise parity against
+    valid-subsequence references."""
+    # --- schedules (seeded clean base + deterministic injections) --------
+    mask_lines = make_batches(6, per_batch=4, seed=seed)
+    mask_lines[1][0][1] = NAN      # one NaN pred row
+    mask_lines[3][0][2] = INF      # one Inf pred row
+    mask_lines[4][0][3] = 1.5      # pred outside [0, 1]
+    mask_lines[4][1][0] = 7        # target outside {0, 1}
+    injected_rows = {"nan": 1, "inf": 1, "domain": 2}
+
+    reject_lines = make_batches(5, per_batch=4, seed=seed + 1)
+    reject_lines[1][0][2] = NAN    # one bad row vetoes the WHOLE batch
+    reject_lines[3][1][1] = 7
+    vetoed = [1, 3]
+
+    mse_lines = make_batches(6, per_batch=4, seed=seed + 2)
+    poison_at = [2, 4]
+    for seq in poison_at:
+        mse_lines[seq] = [[NAN, 0.5, 0.25, 0.75], [0, 1, 0, 1]]
+
+    base = os.path.join(workdir, f"poison-{seed}")
+    shutil.rmtree(base, ignore_errors=True)
+    daemon = Daemon(base)
+    try:
+        _create_stream(daemon, "mask", _GUARDED_ACC, kwargs={"policy": "mask"})
+        _create_stream(daemon, "reject", _GUARDED_ACC, kwargs={"policy": "reject"})
+        _create_stream(daemon, "mse", _GUARDED_MSE, kwargs={"policy": "propagate"},
+                       guard_ring=4, guard_recover_s=recover_s)
+        code, health = daemon.http("GET", "/healthz")
+        _check(code == 200 and health.get("state") == "ok", f"healthz should start 200 ok: {health}")
+
+        # --- mask + reject: absorption is NOT an incident ----------------
+        results = {"mask": _feed_and_drain(daemon, "mask", mask_lines),
+                   "reject": _feed_and_drain(daemon, "reject", reject_lines)}
+        code, health = daemon.http("GET", "/healthz")
+        _check(code == 200, f"masked/rejected rows must not floor health: {health}")
+
+        # --- rollback walk: both poison frames land back to back, so both
+        # rollbacks fall inside the recover_s window → degraded (503) ------
+        for seq, batch in enumerate(mse_lines):
+            _ingest(daemon, "mse", seq, batch)
+
+        def rolled_back():
+            status = daemon.stream_status("mse")
+            guard = status.get("guard") or {}
+            return guard.get("rollbacks", 0) >= len(poison_at) and status
+        status = _wait(rolled_back, 60.0, "the poison probe to roll back twice")
+        _check(status["dropped"] == 0, f"rollback dropped batches: {status}")
+        code, health = daemon.http("GET", "/healthz")
+        _check(code == 503 and health.get("state") == "degraded",
+               f"repeat rollbacks should floor healthz at 503: {code} {health}")
+        _check("rolled back" in str(health.get("reason")),
+               f"health reason should name the rollback: {health}")
+
+        # recovery: the sliding window drains and health un-floors
+        _wait(lambda: daemon.http("GET", "/healthz")[0] == 200, recover_s + 30.0,
+              "healthz to recover to 200 after the rollback window")
+
+        _, reply = daemon.http("POST", "/v1/streams/mse/drain")
+        _check(reply.get("ok"), f"mse drain failed: {reply}")
+        results["mse"] = reply["results"]
+
+        # --- accounting: every injected frame in gauges + ledger ----------
+        mask_guard = daemon.stream_status("mask")["guard"]
+        _check(mask_guard["nan_rows"] == injected_rows["nan"]
+               and mask_guard["inf_rows"] == injected_rows["inf"]
+               and mask_guard["domain_rows"] == injected_rows["domain"]
+               and mask_guard["masked_rows"] == sum(injected_rows.values())
+               and mask_guard["rollbacks"] == 0,
+               f"mask accounting: {mask_guard}")
+        reject_guard = daemon.stream_status("reject")["guard"]
+        _check(reject_guard["rejected_batches"] == len(vetoed) and reject_guard["rollbacks"] == 0,
+               f"reject accounting: {reject_guard}")
+        mse_status = daemon.stream_status("mse")
+        _check(mse_status["guard"]["rollbacks"] == len(poison_at)
+               and mse_status["guard"]["poisoned"] == len(poison_at)
+               and mse_status["deadletter_depth"] == len(poison_at),
+               f"mse accounting: {mse_status}")
+        _, listing = daemon.http("GET", "/v1/streams/mse/deadletter")
+        records = {r["seq"]: r for r in listing["deadletter"]}
+        _check(sorted(records) == poison_at, f"quarantined seqs: {sorted(records)}")
+        for rec in records.values():
+            _check(rec.get("guard", {}).get("nan_rows") == 1,
+                   f"quarantine record lost its guard verdict: {rec}")
+            _check("poison probe" in rec["error"], f"quarantine lost its error: {rec}")
+    finally:
+        daemon.sigterm()
+
+    # --- bitwise parity vs the valid subsequence ------------------------
+    ref_base = os.path.join(workdir, f"poison-ref-{seed}")
+    shutil.rmtree(ref_base, ignore_errors=True)
+    ref = Daemon(ref_base)
+    try:
+        _create_stream(ref, "mask", _GUARDED_ACC, kwargs={"policy": "mask"})
+        _create_stream(ref, "reject", _GUARDED_ACC, kwargs={"policy": "reject"})
+        _create_stream(ref, "mse", _GUARDED_MSE, kwargs={"policy": "propagate"})
+        want = {
+            "mask": _feed_and_drain(ref, "mask", [_strip_invalid_rows(b) for b in mask_lines]),
+            "reject": _feed_and_drain(
+                ref, "reject", [b for i, b in enumerate(reject_lines) if i not in vetoed]),
+            "mse": _feed_and_drain(
+                ref, "mse", [b for i, b in enumerate(mse_lines) if i not in poison_at]),
+        }
+    finally:
+        ref.sigterm()
+    for name in ("mask", "reject", "mse"):
+        _check(results[name] == want[name],
+               f"{name} diverged from its valid-subsequence reference: "
+               f"{results[name]} != {want[name]}")
+    return {
+        "leg": "poison", "seed": seed, "results": results, "quarantined": poison_at,
+        "masked_rows": sum(injected_rows.values()), "rejected_batches": len(vetoed),
+        "rollbacks": len(poison_at), "health_walk": ["ok", "degraded", "ok"],
+    }
+
+
 # ------------------------------------------------------------------- fleet
 
 
@@ -633,6 +808,10 @@ def run_fleet(workdir: str, seed: int):
     return [run_fleet_leg(workdir, seed)]
 
 
+def run_poison(workdir: str, seed: int):
+    return [run_poison_leg(workdir, seed)]
+
+
 def run_long(workdir: str, seed: int, rounds: int):
     """Seeded randomized soak: each round draws its own fault schedule from
     the master seed and must uphold the same invariants."""
@@ -658,7 +837,7 @@ def run_long(workdir: str, seed: int, rounds: int):
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="metricchaos", description=__doc__.split("\n\n")[0])
     parser.add_argument("--workdir", required=True, help="scratch root for daemon base dirs")
-    parser.add_argument("--mode", choices=("short", "long", "fleet"), default="short")
+    parser.add_argument("--mode", choices=("short", "long", "fleet", "poison"), default="short")
     parser.add_argument("--seed", type=int, default=11)
     parser.add_argument("--rounds", type=int, default=3, help="long-mode rounds")
     args = parser.parse_args(argv)
@@ -669,6 +848,8 @@ def main(argv=None) -> int:
             reports = run_short(args.workdir, args.seed)
         elif args.mode == "fleet":
             reports = run_fleet(args.workdir, args.seed)
+        elif args.mode == "poison":
+            reports = run_poison(args.workdir, args.seed)
         else:
             reports = run_long(args.workdir, args.seed, args.rounds)
     except ChaosFailure as err:

@@ -8,12 +8,16 @@ Usage::
     python tools/metricdoctor.py verify /ckpts/eval-run-7
     python tools/metricdoctor.py list   /ckpts/eval-run-7
     python tools/metricdoctor.py prune  /ckpts/eval-run-7 --keep 2
+    python tools/metricdoctor.py deadletter /serve/streams/accuracy
 
 ``verify`` replays the store's own recovery checks offline — manifest parse,
 per-snapshot size + CRC32, torn-write debris — and exits non-zero when any
 manifest-listed snapshot is damaged, so a supervisor can gate a resume on it.
 ``list`` prints the snapshot table (step, file, bytes, integrity). ``prune``
-applies ``keep_last`` retention and clears torn temp files.
+applies ``keep_last`` retention and clears torn temp files. ``deadletter``
+pretty-prints a serve stream's quarantine ledger (``deadletter.jsonl``),
+including the StateGuard verdict (nan/inf/domain row counts) on
+poison-rollback records.
 
 Like ``tools/metricscope.py``, this tool NEVER imports jax (or the metric
 library): it loads the stdlib-only format module
@@ -113,6 +117,70 @@ def _cmd_prune(args) -> int:
     return 0
 
 
+def _deadletter_path(path: str) -> str:
+    """Accept the ledger file itself, a stream directory containing one, or
+    a stream's ``store`` dir (the ledger lives one level above the store)."""
+    if os.path.isdir(path):
+        candidate = os.path.join(path, "deadletter.jsonl")
+        if os.path.exists(candidate):
+            return candidate
+        return os.path.join(os.path.dirname(os.path.abspath(path)), "deadletter.jsonl")
+    return path
+
+
+def _cmd_deadletter(args) -> int:
+    import json
+    import time as _time
+
+    path = _deadletter_path(args.path)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+    except FileNotFoundError:
+        print(f"{args.path}: no deadletter.jsonl (empty quarantine)")
+        return 0
+    records, torn = [], 0
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            records.append(json.loads(line))
+        except ValueError:
+            torn += 1  # a torn line can only predate atomic_write — count it
+    records.sort(key=lambda r: r.get("seq", 0))
+    if args.json:
+        print(json.dumps({"path": path, "deadletter": records, "torn_lines": torn}))
+        return 0
+    print(f"ledger: {path}")
+    if not records:
+        print("0 quarantined record(s)")
+        return 0
+    for rec in records:
+        when = rec.get("quarantined_at")
+        stamp = (
+            _time.strftime("%Y-%m-%d %H:%M:%S", _time.localtime(when))
+            if isinstance(when, (int, float))
+            else "?"
+        )
+        print(f"seq {rec.get('seq', '?'):>6}  stream {rec.get('stream', '?')}"
+              f"  attempts {rec.get('attempts', '?')}  at {stamp}")
+        print(f"       error: {rec.get('error', '?')}")
+        guard = rec.get("guard")
+        if guard:
+            # the StateGuard verdict recorded at quarantine time: why the
+            # batch was condemned, per failure class
+            parts = [f"{key}={guard[key]}" for key in
+                     ("nan_rows", "inf_rows", "domain_rows", "invalid_rows", "batch_ok")
+                     if key in guard]
+            print(f"       guard verdict: {' '.join(parts) if parts else guard}")
+        if rec.get("batch") is None:
+            print("       batch: not retained (replay from the source feed)")
+    print(f"{len(records)} quarantined record(s)"
+          + (f", {torn} torn line(s) skipped" if torn else ""))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="metricdoctor", description=__doc__.splitlines()[0])
     sub = parser.add_subparsers(dest="command", required=True)
@@ -129,6 +197,14 @@ def main(argv=None) -> int:
     p_prune.add_argument("store", help="CheckpointStore directory")
     p_prune.add_argument("--keep", type=int, default=3, help="snapshots to keep (default: 3)")
     p_prune.set_defaults(fn=_cmd_prune)
+
+    p_dl = sub.add_parser(
+        "deadletter",
+        help="pretty-print a serve stream's quarantine ledger (deadletter.jsonl), guard verdicts included",
+    )
+    p_dl.add_argument("path", help="deadletter.jsonl, the stream directory holding it, or the stream's store dir")
+    p_dl.add_argument("--json", action="store_true", help="emit one machine-readable JSON object instead")
+    p_dl.set_defaults(fn=_cmd_deadletter)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -14,14 +14,15 @@ from torchmetrics_tpu.functional.classification.calibration_error import (
     _binary_calibration_error_format,
     _binary_calibration_error_tensor_validation,
     _binary_calibration_error_update,
-    _ce_compute,
+    _binning_update,
+    _ce_compute_binned,
     _multiclass_calibration_error_arg_validation,
     _multiclass_calibration_error_format,
     _multiclass_calibration_error_tensor_validation,
     _multiclass_calibration_error_update,
 )
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.robustness.guard import ArgSpec, DomainContract
 
 Array = jax.Array
 
@@ -50,24 +51,37 @@ class BinaryCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        # binned sum states instead of unbounded `cat` lists: bin membership
+        # is per-sample, so per-bin sums accumulated at update() reproduce
+        # the concat-then-bin reference exactly (functional `_binning_update`)
+        self.add_state("bin_conf_sum", jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("bin_acc_sum", jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("bin_count", jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+
+    def domain_contract(self) -> DomainContract:
+        return DomainContract(
+            args=(
+                ArgSpec(name="preds", finite=True, lo=0.0, hi=1.0, values=(0, 1)),
+                ArgSpec(name="target", finite=True, values=(0, 1), ignore_index=self.ignore_index),
+            ),
+            family="binary_calibration_error",
+        )
 
     def update(self, preds: Array, target: Array) -> None:
-        """Accumulate top-1 confidences/accuracies (reference ``:115-121``)."""
+        """Accumulate per-bin confidence/accuracy sums (reference ``:115-121``)."""
         preds, target = jnp.asarray(preds), jnp.asarray(target)
         if self.validate_args:
             _binary_calibration_error_tensor_validation(preds, target, self.ignore_index)
         preds, target = _binary_calibration_error_format(preds, target, self.ignore_index)
         confidences, accuracies = _binary_calibration_error_update(preds, target)
-        self.confidences.append(confidences)
-        self.accuracies.append(accuracies)
+        conf_sum, acc_sum, count = _binning_update(confidences, accuracies, self.n_bins)
+        self.bin_conf_sum = self.bin_conf_sum + conf_sum
+        self.bin_acc_sum = self.bin_acc_sum + acc_sum
+        self.bin_count = self.bin_count + count
 
     def compute(self) -> Array:
         """Finalize calibration error (reference ``:123-126``)."""
-        confidences = dim_zero_cat(self.confidences)
-        accuracies = dim_zero_cat(self.accuracies)
-        return _ce_compute(confidences, accuracies, self.n_bins, norm=self.norm)
+        return _ce_compute_binned(self.bin_conf_sum, self.bin_acc_sum, self.bin_count, norm=self.norm)
 
     def plot(self, val=None, ax=None):
         return self._plot(val, ax)
@@ -100,24 +114,36 @@ class MulticlassCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confidences", [], dist_reduce_fx="cat")
-        self.add_state("accuracies", [], dist_reduce_fx="cat")
+        # binned sum states instead of unbounded `cat` lists (see
+        # BinaryCalibrationError): fixed (n_bins,) accumulators, ML006-clean
+        self.add_state("bin_conf_sum", jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("bin_acc_sum", jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("bin_count", jnp.zeros(n_bins, jnp.float32), dist_reduce_fx="sum")
+
+    def domain_contract(self) -> DomainContract:
+        return DomainContract(
+            args=(
+                ArgSpec(name="preds", finite=True),
+                ArgSpec(name="target", finite=True, num_classes=self.num_classes, ignore_index=self.ignore_index),
+            ),
+            family="multiclass_calibration_error",
+        )
 
     def update(self, preds: Array, target: Array) -> None:
-        """Accumulate top-1 confidences/accuracies (reference ``:233-239``)."""
+        """Accumulate per-bin top-1 confidence/accuracy sums (reference ``:233-239``)."""
         preds, target = jnp.asarray(preds), jnp.asarray(target)
         if self.validate_args:
             _multiclass_calibration_error_tensor_validation(preds, target, self.num_classes, self.ignore_index)
         preds, target = _multiclass_calibration_error_format(preds, target, self.ignore_index)
         confidences, accuracies = _multiclass_calibration_error_update(preds, target)
-        self.confidences.append(confidences)
-        self.accuracies.append(accuracies)
+        conf_sum, acc_sum, count = _binning_update(confidences, accuracies, self.n_bins)
+        self.bin_conf_sum = self.bin_conf_sum + conf_sum
+        self.bin_acc_sum = self.bin_acc_sum + acc_sum
+        self.bin_count = self.bin_count + count
 
     def compute(self) -> Array:
         """Finalize calibration error (reference ``:241-244``)."""
-        confidences = dim_zero_cat(self.confidences)
-        accuracies = dim_zero_cat(self.accuracies)
-        return _ce_compute(confidences, accuracies, self.n_bins, norm=self.norm)
+        return _ce_compute_binned(self.bin_conf_sum, self.bin_acc_sum, self.bin_count, norm=self.norm)
 
     def plot(self, val=None, ax=None):
         return self._plot(val, ax)

@@ -51,7 +51,13 @@ class _AbstractCurveMetric(Metric):
 
     def _create_curve_state(self, thresholds: Optional[Array], state_shape: Tuple[int, ...]) -> None:
         if thresholds is None:
+            # the thresholds=None contract IS the exact curve over every seen
+            # score — an unbounded cat state is the semantics, not an
+            # accident, and the bounded escape already ships in this very
+            # branch: pass thresholds=... for the fixed-shape confmat state
+            # metriclint: disable=ML006 -- exact-curve contract; thresholds=... is the bounded alternative
             self.add_state("preds", [], dist_reduce_fx="cat")
+            # metriclint: disable=ML006 -- exact-curve contract; thresholds=... is the bounded alternative
             self.add_state("target", [], dist_reduce_fx="cat")
         else:
             self.add_state("confmat", jnp.zeros(state_shape, dtype=jnp.int32), dist_reduce_fx="sum")

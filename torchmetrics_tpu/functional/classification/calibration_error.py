@@ -41,6 +41,21 @@ def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Ar
     return acc_bin, conf_bin, prop_bin
 
 
+def _ce_from_bins(acc_bin: Array, conf_bin: Array, prop_bin: Array, norm: str, debias: bool, n_valid: Array) -> Array:
+    """Norm over per-bin means/proportions — the tail shared by the
+    concat-at-compute path (:func:`_ce_compute`) and the binned-sum module
+    states (:func:`_ce_compute_binned`)."""
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * n_valid - 1)
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
 def _ce_compute(
     confidences: Array,
     accuracies: Array,
@@ -55,17 +70,39 @@ def _ce_compute(
         raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
 
     acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+    n_valid = jnp.sum((confidences >= 0) & (confidences <= 1))
+    return _ce_from_bins(acc_bin, conf_bin, prop_bin, norm, debias, n_valid)
 
-    if norm == "l1":
-        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
-    if norm == "max":
-        return jnp.max(jnp.abs(acc_bin - conf_bin))
-    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
-    if debias:
-        n_valid = jnp.sum((confidences >= 0) & (confidences <= 1))
-        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (prop_bin * n_valid - 1)
-        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
-    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+def _binning_update(confidences: Array, accuracies: Array, n_bins: int) -> Tuple[Array, Array, Array]:
+    """Per-bin ``(conf_sum, acc_sum, count)`` for one batch.
+
+    The binned-sum decomposition of :func:`_binning_bucketize`: bin
+    membership is decided per sample, so accumulating per-bin *sums* at
+    ``update()`` and normalizing at ``compute()`` is the same binning as
+    concatenating every sample first — fixed ``(n_bins,)`` state instead of
+    an unbounded ``cat`` list (metriclint ML006).
+    """
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=confidences.dtype)
+    accuracies = accuracies.astype(confidences.dtype)
+    valid = (confidences >= 0) & (confidences <= 1)
+    idx = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="right") - 1, 0, n_bins - 1)
+    onehot = ((idx[:, None] == jnp.arange(n_bins)[None, :]) & valid[:, None]).astype(confidences.dtype)  # (N, B)
+    count = onehot.sum(axis=0)
+    conf_sum = jnp.where(valid, confidences, 0.0) @ onehot
+    acc_sum = accuracies @ onehot
+    return conf_sum, acc_sum, count
+
+
+def _ce_compute_binned(conf_sum: Array, acc_sum: Array, count: Array, norm: str = "l1", debias: bool = False) -> Array:
+    """Calibration error from accumulated per-bin sums (:func:`_binning_update`)."""
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    acc_bin = _safe_divide(acc_sum, count)
+    conf_bin = _safe_divide(conf_sum, count)
+    n_valid = count.sum()
+    prop_bin = count / n_valid
+    return _ce_from_bins(acc_bin, conf_bin, prop_bin, norm, debias, n_valid)
 
 
 def _binary_calibration_error_arg_validation(

@@ -28,6 +28,7 @@ RULES: Dict[str, str] = {
     "ML010": "jax-free CLI surface reaches jax through its module-level import closure",
     "ML011": "host-sync coercion of a traced value in a callee of a jit entry point",
     "ML012": "serve-plane lock discipline: blocking op under a lock, or counter mutated outside it",
+    "ML013": "float-prediction update() with no registered StateGuard domain contract",
 }
 
 #: long-form rationale + fix pattern per rule, printed by
@@ -128,6 +129,17 @@ EXPLANATIONS: Dict[str, str] = {
         "Locks that exist purely to serialize writers (not to guard readers)\n"
         "are legitimate — suppress with a written reason."
     ),
+    "ML013": (
+        "A Metric whose update() consumes float predictions (first batch\n"
+        "parameter named `preds`) but whose ancestry registers no\n"
+        "domain_contract() cannot be guarded: enable_guard() has no compiled\n"
+        "contract to mask/reject invalid rows with, so NaN/Inf/out-of-domain\n"
+        "rows flow straight into state on the serve plane.\n"
+        "Fix: override domain_contract() returning a\n"
+        "robustness.guard.DomainContract (per-argument ArgSpec bounds) — see\n"
+        "classification/stat_scores.py for the family pattern. Pre-existing\n"
+        "offenders are ratcheted in the baseline."
+    ),
 }
 
 
@@ -199,6 +211,11 @@ class ClassInfo:
     #: None = this class defines no update(); else whether its update accepts
     #: any positional batch argument (the ML007 fusability signal)
     update_positional: Optional[bool] = None
+    #: this class body defines a domain_contract() method (the ML013 signal)
+    defines_contract: bool = False
+    #: this class body defines an update() whose first batch param is `preds`
+    #: and (by annotation, when one exists) consumes arrays rather than text
+    update_takes_preds: bool = False
 
 
 def _base_name(node: ast.expr) -> Optional[str]:
@@ -245,9 +262,23 @@ def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
     host_only = False
     fsu_false = False
     update_positional: Optional[bool] = None
+    defines_contract = False
+    update_takes_preds = False
     for item in node.body:
         if isinstance(item, ast.FunctionDef) and item.name == "update":
             update_positional = _update_accepts_positional(item)
+            params = [p for p in list(item.args.posonlyargs) + list(item.args.args)
+                      if p.arg not in ("self", "cls")]
+            if params and params[0].arg == "preds":
+                # an annotation of str/Sequence[str] marks a text metric —
+                # guard contracts only make sense for array-valued preds
+                ann = params[0].annotation
+                ann_src = ast.unparse(ann) if ann is not None else None
+                update_takes_preds = ann_src is None or any(
+                    hint in ann_src for hint in ("Array", "ndarray", "Tensor")
+                )
+        elif isinstance(item, ast.FunctionDef) and item.name == "domain_contract":
+            defines_contract = True
     for stmt in ast.walk(node):
         if isinstance(stmt, ast.Call) and _is_self_call(stmt, "add_state"):
             name_arg = _call_arg(stmt, 0, "name")
@@ -286,6 +317,8 @@ def _collect_class_info(path: str, node: ast.ClassDef) -> ClassInfo:
         host_only=host_only,
         fsu_false=fsu_false,
         update_positional=update_positional,
+        defines_contract=defines_contract,
+        update_takes_preds=update_takes_preds,
     )
 
 
@@ -350,6 +383,12 @@ class ClassIndex:
 
     def classes_in_file(self, path: str) -> List[ClassInfo]:
         return [info for infos in self._by_name.values() for info in infos if info.path == path]
+
+    def declares_contract(self, info: ClassInfo) -> bool:
+        """True when the class (or an ancestor) defines ``domain_contract``.
+        The ``Metric`` base's contract-less default is excluded — "declares"
+        means somebody registered real per-argument bounds."""
+        return any(cur.defines_contract for cur in self._ancestry(info) if cur.name != "Metric")
 
     def claims_fsu_false(self, info: ClassInfo) -> bool:
         """True when the class (or a non-root ancestor) declares a literal
@@ -726,6 +765,30 @@ def check_ml006(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
         )
 
 
+def check_ml013(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
+    """Float-prediction metric without a registered StateGuard contract.
+
+    A class whose ``update`` (own or inherited) leads with a ``preds``
+    parameter consumes model predictions — exactly the input family the
+    serve plane guards with compiled domain contracts. Without a
+    ``domain_contract`` override anywhere in the ancestry,
+    ``enable_guard()`` has nothing to mask/reject with, so the metric can
+    only run the probe-less ``propagate`` policy. Pre-existing offenders
+    are ratcheted in the baseline; new prediction metrics should ship a
+    contract (see ``classification/stat_scores.py`` for the pattern)."""
+    if index.declares_contract(info):
+        return
+    if not any(cur.update_takes_preds for cur in index._ancestry(info)):
+        return
+    yield Violation(
+        "ML013", info.path, info.node.lineno, info.node.col_offset, info.name,
+        "update() consumes float predictions but no domain_contract() is registered"
+        " anywhere in the ancestry: enable_guard() cannot sanitize this metric's"
+        " inputs — override domain_contract() with per-argument ArgSpec bounds"
+        " (robustness/guard.py)",
+    )
+
+
 def check_ml005(info: "ClassInfo", index: ClassIndex) -> Iterator[Violation]:
     """Metric instances placed where ``_walk_metrics`` cannot see them.
 
@@ -993,6 +1056,7 @@ def check_file(path: str, tree: ast.Module, index: ClassIndex) -> List[Violation
         violations.extend(check_ml003(info, index))
         violations.extend(check_ml005(info, index))
         violations.extend(check_ml006(info, index))
+        violations.extend(check_ml013(info, index))
         for item in info.node.body:
             if not (isinstance(item, ast.FunctionDef) and item.name in ("update", "compute")):
                 continue

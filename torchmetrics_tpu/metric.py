@@ -188,8 +188,28 @@ class Metric:
 
     # ------------------------------------------------------------------ wrap
     def _rewrap(self) -> None:
-        self.update: Callable[..., None] = self._wrap_update(self.__class__.update.__get__(self))  # type: ignore[method-assign]
+        if getattr(self, "_guard_policy", None) is not None:
+            # StateGuard-enabled metric (robustness/guard.py): the guarded
+            # closure replaces the raw update INSIDE the transactional wrapper,
+            # so pickle/__setstate__ round-trips re-install the guard
+            from torchmetrics_tpu.robustness.guard import _guard_wrap_update
+
+            self.update: Callable[..., None] = self._wrap_update(_guard_wrap_update(self))  # type: ignore[method-assign]
+        else:
+            self.update = self._wrap_update(self.__class__.update.__get__(self))  # type: ignore[method-assign]
         self.compute: Callable[..., Any] = self._wrap_compute(self.__class__.compute.__get__(self))  # type: ignore[method-assign]
+
+    def domain_contract(self) -> Optional[Any]:
+        """Input-domain contract for the StateGuard plane, or ``None``.
+
+        Families whose ``update`` consumes float predictions override this to
+        return a :class:`~torchmetrics_tpu.robustness.guard.DomainContract`
+        describing per-argument validity (finite, probs in [0, 1], labels <
+        num_classes) — compiled into the update step by
+        :func:`~torchmetrics_tpu.robustness.guard.enable_guard`. Metriclint
+        ML013 flags float-prediction metrics that leave this unimplemented.
+        """
+        return None
 
     def __getstate__(self) -> Dict[str, Any]:
         """Drop wrapped closures for pickling (reference ``metric.py:713``)."""

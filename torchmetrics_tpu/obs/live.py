@@ -157,6 +157,15 @@ _DRIFT_HEALTH_RE = re.compile(r"^drift\.(?P<stream>[^.]+)\.severity$")
 #: drift severity code → the health state it floors to
 _DRIFT_SEVERITY_HEALTH = {1: "stalling", 2: "degraded"}
 
+#: the StateGuard's per-stream rollback-pressure gauge (0 ok / 1 one recent
+#: rollback / 2 repeats inside the recovery window — serve.stream publishes
+#: it from the rollback ring): one rollback is a survived incident and floors
+#: at "stalling" (visible, still 200); repeats mean the upstream is actively
+#: feeding poison and floor at "degraded" (503) until the window drains
+_GUARD_HEALTH_RE = re.compile(r"^guard\.(?P<stream>[^.]+)\.health_state$")
+
+_GUARD_CODE_HEALTH = {1: "stalling", 2: "degraded"}
+
 
 def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[str, Any]:
     """Liveness state from a counter/gauge snapshot (see the module table).
@@ -211,6 +220,17 @@ def derive_health(counters: Dict[str, int], gauges: Dict[str, float]) -> Dict[st
                 psi = gauges.get(f"drift.{match.group('stream')}.psi")
                 why = f"stream {match.group('stream')} is drifting"
                 escalate(floor, why if psi is None else f"{why} (psi {psi:.3f})")
+            continue
+        # guard floor: poison-probe rollbacks on a served stream (state was
+        # corrupted and restored from the known-good ring) — repeats read as
+        # an actively-poisoning upstream
+        match = _GUARD_HEALTH_RE.match(name)
+        if match is not None:
+            floor = _GUARD_CODE_HEALTH.get(max(0, min(int(value), 2)))
+            if floor is not None:
+                rollbacks = gauges.get(f"guard.{match.group('stream')}.rollbacks")
+                why = f"stream {match.group('stream')} rolled back poisoned state"
+                escalate(floor, why if rollbacks is None else f"{why} ({int(rollbacks)} rollback(s))")
             continue
         # fleet floor (federation aggregator probe): a process hosting an
         # aggregator is only as healthy as its sickest leaf

@@ -10,6 +10,7 @@ import jax.numpy as jnp
 
 from torchmetrics_tpu.functional.regression.mse import _mean_squared_error_compute, _mean_squared_error_update
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.robustness.guard import ArgSpec, DomainContract
 
 Array = jax.Array
 
@@ -32,6 +33,14 @@ class MeanSquaredError(Metric):
         self.num_outputs = num_outputs
         self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def domain_contract(self) -> DomainContract:
+        # a single NaN/Inf sample poisons the float error sum forever — the
+        # canonical StateGuard poison-probe target (robustness/guard.py)
+        return DomainContract(
+            args=(ArgSpec(name="preds", finite=True), ArgSpec(name="target", finite=True)),
+            family="mean_squared_error",
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         """Fold a batch of squared errors into the state (reference ``mse.py:100``)."""

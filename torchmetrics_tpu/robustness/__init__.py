@@ -33,6 +33,19 @@ from torchmetrics_tpu.robustness.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from torchmetrics_tpu.robustness.guard import (
+    GUARD_POLICIES,
+    GUARD_STATES,
+    ArgSpec,
+    DomainContract,
+    GuardVerdict,
+    check_batch,
+    enable_guard,
+    guard_counters,
+    guard_ineligibility,
+    guarded_policy,
+    state_finiteness,
+)
 from torchmetrics_tpu.robustness.runner import StreamingEvaluator
 from torchmetrics_tpu.robustness.spec import StateSpec, build_state_specs, spec_fingerprint, validate_state_tree
 from torchmetrics_tpu.robustness.store import CheckpointStore
@@ -40,16 +53,26 @@ from torchmetrics_tpu.robustness.sync_config import DEFAULT_SYNC_CONFIG, SyncCon
 
 __all__ = [
     "CHECKPOINT_FORMAT_VERSION",
+    "ArgSpec",
     "CheckpointStore",
     "DEFAULT_SYNC_CONFIG",
+    "DomainContract",
+    "GUARD_POLICIES",
+    "GUARD_STATES",
+    "GuardVerdict",
     "StateSpec",
     "StreamingEvaluator",
     "SyncConfig",
     "build_state_specs",
+    "check_batch",
     "checkpoint_fingerprint",
+    "enable_guard",
     "faults",
+    "guard_counters",
+    "guard_ineligibility",
+    "guarded_policy",
     "load_checkpoint",
     "save_checkpoint",
     "spec_fingerprint",
-    "validate_state_tree",
+    "state_finiteness",
 ]

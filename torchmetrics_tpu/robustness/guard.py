@@ -1,0 +1,429 @@
+# Copyright The TorchMetrics-TPU contributors.
+# Licensed under the Apache License, Version 2.0.
+"""StateGuard: in-program input sanitization and poison detection.
+
+The reference validates inputs eagerly on host (``_input_validation``), which
+a donated, ``lax.scan``-ingested pipeline cannot afford: one NaN/Inf or
+out-of-range label in a single ``update()`` batch silently poisons
+elementwise state forever. This module compiles the per-family **domain
+contract** (finite, probs in [0, 1], labels < num_classes) *into* the update
+step as fixed-shape masking, under one of three policies:
+
+``propagate``
+    Today's behavior — the batch is applied untouched; the guard only counts
+    invalid rows and latches the poison probe if state goes non-finite.
+``mask``
+    Only valid rows are accumulated (one fresh per-row update, vmapped, then
+    a segment-reduce that spills invalid rows — the ``parallel/sliced.py``
+    cell fold, with validity as the cell). Invalid rows are counted, never
+    applied.
+``reject``
+    Whole-batch veto: the candidate state is computed, then every leaf is
+    ``where(batch_ok, new, old)``-selected, so an invalid batch leaves state
+    bitwise untouched.
+
+Every check is a fixed-shape device reduction — zero host sync, safe under
+``jit``/``lax.scan``/donation/``SlicedPlan``. The verdict counters ride the
+metric's own state registry (``guard_*`` states registered via
+:meth:`~torchmetrics_tpu.metric.Metric.add_state`), so they checkpoint,
+sync, fold and slice exactly like any other state.
+
+The **poison probe** is one cheap in-program finiteness reduction over the
+float state leaves, folded into the guarded update: corruption is detected
+at the batch that caused it, not at ``compute()``. The serve plane
+(``serve/stream.py``) reads the ``guard_poisoned`` latch per applied batch
+and rolls back to its known-good ring.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+#: guard verdict counters registered on the metric by :func:`enable_guard`.
+#: All scalars: int32 "sum" states except the "max" latch ``guard_poisoned``.
+GUARD_STATES: Tuple[str, ...] = (
+    "guard_nan_rows",
+    "guard_inf_rows",
+    "guard_domain_rows",
+    "guard_masked_rows",
+    "guard_rejected_batches",
+    "guard_poisoned",
+)
+
+GUARD_POLICIES: Tuple[str, ...] = ("propagate", "mask", "reject")
+
+#: array reductions the mask policy can fold row-decomposed states with
+#: (mirrors ``parallel/sliced.py:_SLICEABLE_REDUCTIONS`` minus "merge")
+_MASKABLE_REDUCTIONS = frozenset({"sum", "max", "min"})
+
+
+class ArgSpec(NamedTuple):
+    """Domain contract for one positional ``update`` argument.
+
+    Checks are dtype-aware so one spec covers both prob/logit *and* label
+    encodings of the same argument: ``lo``/``hi`` range checks apply only to
+    floating inputs, ``values``/``num_classes`` membership checks only to
+    integer inputs. Elements equal to ``ignore_index`` are exempt from the
+    domain checks (they are sentinels, not data). Non-finite elements are
+    flagged by ``finite`` and excluded from the domain count, so a NaN is
+    never double-billed.
+    """
+
+    name: str = "arg"
+    finite: bool = False
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    num_classes: Optional[int] = None
+    values: Optional[Tuple[int, ...]] = None
+    ignore_index: Optional[int] = None
+
+
+class GuardVerdict(NamedTuple):
+    """Fixed-shape per-batch verdict — int32/bool device scalars that ride
+    step outputs with zero host sync."""
+
+    nan_rows: Array
+    inf_rows: Array
+    domain_rows: Array
+    invalid_rows: Array
+    batch_ok: Array
+
+
+class DomainContract(NamedTuple):
+    """Per-family input-domain contract: one :class:`ArgSpec` per positional
+    ``update`` argument (extra arguments are unchecked)."""
+
+    args: Tuple[ArgSpec, ...]
+    family: str = ""
+
+    def row_invalid(self, *batch: Any) -> Tuple[Array, Array, Array]:
+        """Per-row (nan, inf, domain) violation masks, each bool ``(rows,)``.
+
+        A "row" is an index along dim 0 of the batched arguments; trailing
+        dims are flattened per row, so a single bad class score invalidates
+        its whole sample.
+        """
+        rows = None
+        for a in batch:
+            a = jnp.asarray(a)
+            if a.ndim >= 1:
+                rows = a.shape[0]
+                break
+        if rows is None:
+            raise ValueError("guard contract needs at least one batched (ndim >= 1) input")
+        zeros = jnp.zeros((rows,), dtype=bool)
+        nan_any, inf_any, dom_any = zeros, zeros, zeros
+        for spec, a in zip(self.args, batch):
+            a = jnp.asarray(a)
+            if a.ndim == 0:
+                continue
+            flat = a.reshape((a.shape[0], -1))
+            exempt = jnp.zeros_like(flat, dtype=bool)
+            if spec.ignore_index is not None:
+                exempt = flat == spec.ignore_index
+            if jnp.issubdtype(flat.dtype, jnp.floating):
+                nonfinite_nan = jnp.isnan(flat) & ~exempt
+                nonfinite_inf = jnp.isinf(flat) & ~exempt
+                if spec.finite:
+                    nan_any = nan_any | jnp.any(nonfinite_nan, axis=1)
+                    inf_any = inf_any | jnp.any(nonfinite_inf, axis=1)
+                bad = jnp.zeros_like(flat, dtype=bool)
+                if spec.lo is not None:
+                    bad = bad | (flat < spec.lo)
+                if spec.hi is not None:
+                    bad = bad | (flat > spec.hi)
+                # integer membership checks also apply to float-encoded labels
+                # (a JSON frame with one NaN floats the whole target array)
+                if spec.values is not None and spec.lo is None and spec.hi is None:
+                    ok = jnp.zeros_like(flat, dtype=bool)
+                    for v in spec.values:
+                        ok = ok | (flat == v)
+                    bad = bad | ~ok
+                if spec.num_classes is not None and flat.ndim == 2 and a.ndim == 1:
+                    bad = bad | (flat < 0) | (flat >= spec.num_classes)
+                bad = bad & jnp.isfinite(flat) & ~exempt
+                dom_any = dom_any | jnp.any(bad, axis=1)
+            else:
+                bad = jnp.zeros_like(flat, dtype=bool)
+                if spec.values is not None:
+                    ok = jnp.zeros_like(flat, dtype=bool)
+                    for v in spec.values:
+                        ok = ok | (flat == v)
+                    bad = bad | ~ok
+                elif spec.num_classes is not None:
+                    bad = bad | (flat < 0) | (flat >= spec.num_classes)
+                bad = bad & ~exempt
+                dom_any = dom_any | jnp.any(bad, axis=1)
+        return nan_any, inf_any, dom_any
+
+    def check_batch(self, *batch: Any) -> GuardVerdict:
+        """Compile the contract over one batch into a :class:`GuardVerdict`."""
+        nan_any, inf_any, dom_any = self.row_invalid(*batch)
+        invalid = nan_any | inf_any | dom_any
+        return GuardVerdict(
+            nan_rows=jnp.sum(nan_any).astype(jnp.int32),
+            inf_rows=jnp.sum(inf_any).astype(jnp.int32),
+            domain_rows=jnp.sum(dom_any).astype(jnp.int32),
+            invalid_rows=jnp.sum(invalid).astype(jnp.int32),
+            batch_ok=~jnp.any(invalid),
+        )
+
+
+def check_batch(contract: DomainContract, *batch: Any) -> GuardVerdict:
+    """Pure functional form of :meth:`DomainContract.check_batch`."""
+    return contract.check_batch(*batch)
+
+
+# --------------------------------------------------------------- eligibility
+def guard_ineligibility(metric: Any, policy: str) -> Optional[str]:
+    """Why ``metric`` cannot run under ``policy`` — or ``None`` if it can.
+
+    Mirrors ``parallel.sliced_ineligibility``: a *reason string* rather than
+    a bool so the refusal can name the offending state. ``propagate`` never
+    rewrites the update and is always eligible.
+    """
+    if policy not in GUARD_POLICIES:
+        raise ValueError(f"unknown guard policy {policy!r}; expected one of {GUARD_POLICIES}")
+    if policy == "propagate":
+        return None
+    name = type(metric).__name__
+    if getattr(metric, "_sharded_update_unsupported", None):
+        return f"{name}.update cannot run traced: {metric._sharded_update_unsupported}"
+    if getattr(metric, "_host_counters", ()):
+        return f"{name} keeps host-side counters {metric._host_counters} the traced guard cannot restore"
+    for state, default in metric._defaults.items():
+        if state in GUARD_STATES:
+            continue
+        if isinstance(default, list):
+            return f"state {state!r} is a list ('cat') state — rows cannot be unappended in-graph"
+        red = metric._reductions.get(state)
+        if policy == "mask" and not (isinstance(red, str) and red in _MASKABLE_REDUCTIONS):
+            return (
+                f"state {state!r} has reduction {red!r}; mask-policy row folding supports"
+                f" {sorted(_MASKABLE_REDUCTIONS)} only"
+            )
+    if policy == "mask" and getattr(metric, "full_state_update", False):
+        return f"{name} declares full_state_update=True; per-row decomposition from defaults is unsound"
+    return None
+
+
+# ------------------------------------------------------------------- install
+def enable_guard(
+    metric: Any,
+    policy: str = "mask",
+    contract: Optional[DomainContract] = None,
+    probe: bool = True,
+) -> Any:
+    """Install the StateGuard on ``metric`` in place and return it.
+
+    Registers the ``guard_*`` counter states and re-binds the instance
+    ``update`` with the guarded closure (via ``Metric._rewrap``, so pickling
+    and ``__setstate__`` re-install it automatically). ``contract`` defaults
+    to the metric's own :meth:`~torchmetrics_tpu.metric.Metric.domain_contract`.
+    """
+    if policy not in GUARD_POLICIES:
+        raise ValueError(f"unknown guard policy {policy!r}; expected one of {GUARD_POLICIES}")
+    if getattr(metric, "_guard_policy", None) is not None:
+        raise ValueError(f"{type(metric).__name__} is already guarded (policy={metric._guard_policy!r})")
+    contract = contract if contract is not None else metric.domain_contract()
+    if contract is None:
+        raise ValueError(
+            f"{type(metric).__name__} declares no domain contract (see metriclint ML013);"
+            " pass contract= explicitly or implement domain_contract()"
+        )
+    reason = guard_ineligibility(metric, policy)
+    if reason is not None:
+        raise ValueError(f"metric ineligible for guard policy {policy!r}: {reason}")
+    for state in GUARD_STATES:
+        if state in metric._defaults:
+            raise ValueError(f"state name {state!r} is reserved for the StateGuard plane")
+    for state in GUARD_STATES[:-1]:
+        metric.add_state(state, jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+    # the poison latch merges as max so a tripped shard/leaf taints the fold
+    metric.add_state("guard_poisoned", jnp.zeros((), jnp.int32), dist_reduce_fx="max")
+    metric._guard_policy = policy
+    metric._guard_contract = contract
+    metric._guard_probe = bool(probe)
+    if hasattr(metric, "validate_args"):
+        # the compiled contract subsumes eager host validation — which would
+        # both host-sync per batch and raise on the very batches the mask and
+        # reject policies exist to absorb
+        metric.validate_args = False
+    metric._rewrap()
+    return metric
+
+
+def _guard_wrap_update(metric: Any):
+    """The guarded raw update — installed by ``Metric._rewrap`` *inside* the
+    transactional ``_wrap_update`` wrapper, so count/state rollback on
+    exception covers the guard counters too."""
+    raw = metric.__class__.update.__get__(metric)
+    sig = inspect.signature(metric.__class__.update)
+    policy: str = metric._guard_policy
+    contract: DomainContract = metric._guard_contract
+
+    @functools.wraps(metric.__class__.update)
+    def guarded(*args: Any, **kwargs: Any) -> None:
+        bound = sig.bind(metric, *args, **kwargs)
+        if bound.kwargs:
+            raise TypeError(
+                f"guarded update of {type(metric).__name__} accepts positionally-bindable arguments only"
+            )
+        batch = tuple(jnp.asarray(a) for a in bound.args[1:])
+        verdict = contract.check_batch(*batch)
+        if policy == "mask":
+            _mask_apply(metric, raw, batch, verdict)
+        elif policy == "reject":
+            _reject_apply(metric, raw, batch, verdict)
+        else:
+            raw(*batch)
+        _accumulate_verdict(metric, verdict, policy)
+        if metric._guard_probe:
+            bad = ~state_finiteness(metric)
+            metric.guard_poisoned = jnp.maximum(metric.guard_poisoned, bad.astype(jnp.int32))
+
+    return guarded
+
+
+def _plain_states(metric: Any) -> Tuple[str, ...]:
+    return tuple(k for k in metric._defaults if k not in GUARD_STATES)
+
+
+def _reject_apply(metric: Any, raw, batch: Tuple[Array, ...], verdict: GuardVerdict) -> None:
+    """Whole-batch veto: compute the candidate state, then select old/new per
+    leaf on ``batch_ok`` — an invalid batch leaves state bitwise untouched
+    (``where(False, new, old)`` is elementwise ``old``)."""
+    prior = {k: getattr(metric, k) for k in _plain_states(metric)}
+    raw(*batch)
+    ok = verdict.batch_ok
+    for k, old in prior.items():
+        new = getattr(metric, k)
+        setattr(metric, k, jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, old))
+
+
+def _mask_apply(metric: Any, raw, batch: Tuple[Array, ...], verdict: GuardVerdict) -> None:
+    """Accumulate only valid rows: one fresh update per row, vmapped
+    (``parallel/sliced.py:_row_states`` staging), then a segment-reduce where
+    invalid rows carry the spill segment and fall off — exact for integer
+    count states, reassociated summation for float ones."""
+    nan_any, inf_any, dom_any = metric._guard_contract.row_invalid(*batch)
+    invalid_row = nan_any | inf_any | dom_any
+    in_axes = tuple(0 if a.ndim >= 1 else None for a in batch)
+    rows = next(a.shape[0] for a, ax in zip(batch, in_axes) if ax == 0)
+    staged = tuple(
+        a.reshape((rows, 1) + a.shape[1:]) if ax == 0 else a for a, ax in zip(batch, in_axes)
+    )
+    states = _plain_states(metric)
+    saved = {k: getattr(metric, k) for k in states}
+
+    def one(*row: Any) -> Dict[str, Any]:
+        for k in states:
+            setattr(metric, k, metric._defaults[k])
+        raw(*row)
+        return {k: getattr(metric, k) for k in states}
+
+    try:
+        per_row = jax.vmap(one, in_axes=in_axes)(*staged)
+    finally:
+        # drop tracers: the host-side object must only ever hold the carry
+        for k, v in saved.items():
+            setattr(metric, k, v)
+
+    seg = invalid_row.astype(jnp.int32)  # valid rows -> cell 0, invalid -> spill
+    any_valid = jnp.any(~invalid_row)
+    for k in states:
+        red = metric._reductions[k]
+        fresh = _segment_reduce(red, per_row[k], seg)
+        if red == "sum":
+            merged = saved[k] + fresh
+        elif red == "max":
+            merged = jnp.maximum(saved[k], fresh)
+        else:
+            merged = jnp.minimum(saved[k], fresh)
+        # all-invalid batch: segment identities never leak into the carry
+        setattr(metric, k, jnp.where(any_valid, merged, saved[k]))
+
+
+def _segment_reduce(red: str, rows: Array, seg: Array) -> Array:
+    """Fold the per-row leading axis into the single valid cell; spilled rows
+    carry segment id 1 and are sliced off (``parallel/sliced.py:302`` with
+    ``num_cells=1``)."""
+    if red == "sum":
+        return jax.ops.segment_sum(rows, seg, num_segments=2)[0]
+    if red == "max":
+        return jax.ops.segment_max(rows, seg, num_segments=2)[0]
+    if red == "min":
+        return jax.ops.segment_min(rows, seg, num_segments=2)[0]
+    raise ValueError(f"unexpected maskable reduction {red!r}")  # pragma: no cover - guard_ineligibility
+
+
+def _accumulate_verdict(metric: Any, verdict: GuardVerdict, policy: str) -> None:
+    metric.guard_nan_rows = metric.guard_nan_rows + verdict.nan_rows
+    metric.guard_inf_rows = metric.guard_inf_rows + verdict.inf_rows
+    metric.guard_domain_rows = metric.guard_domain_rows + verdict.domain_rows
+    if policy == "mask":
+        metric.guard_masked_rows = metric.guard_masked_rows + verdict.invalid_rows
+    elif policy == "reject":
+        metric.guard_rejected_batches = metric.guard_rejected_batches + (
+            1 - verdict.batch_ok.astype(jnp.int32)
+        )
+
+
+# --------------------------------------------------------------- poison probe
+def state_finiteness(metric: Any) -> Array:
+    """One in-program finiteness reduction over the float state leaves —
+    scalar bool ``True`` iff no float leaf carries NaN/Inf. Integer leaves
+    are finite by construction and skipped; guard counters are excluded."""
+    ok = jnp.asarray(True)
+    for k in _plain_states(metric):
+        for leaf in jax.tree_util.tree_leaves(getattr(metric, k)):
+            leaf = jnp.asarray(leaf)
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+# ----------------------------------------------------------- host-side reads
+def guarded_policy(metric: Any) -> Optional[str]:
+    """The installed guard policy, or ``None`` when unguarded — the serve
+    plane's feature probe (no isinstance, works through wrappers)."""
+    return getattr(metric, "_guard_policy", None)
+
+
+def guard_counters(metric: Any) -> Dict[str, int]:
+    """Host snapshot of the cumulative guard counters (forces a sync — serve
+    plane / gauges only, never inside compiled code)."""
+    return {
+        "nan_rows": int(metric.guard_nan_rows),
+        "inf_rows": int(metric.guard_inf_rows),
+        "domain_rows": int(metric.guard_domain_rows),
+        "masked_rows": int(metric.guard_masked_rows),
+        "rejected_batches": int(metric.guard_rejected_batches),
+        "poisoned": int(metric.guard_poisoned),
+    }
+
+
+def batch_verdict_host(metric: Any, batch: Tuple[Any, ...]) -> Optional[Dict[str, int]]:
+    """Re-run the contract over a (host) batch and return the verdict as
+    plain ints — the dead-letter ledger's poison-quarantine record. ``None``
+    when the metric is unguarded or the batch cannot be checked."""
+    contract = getattr(metric, "_guard_contract", None)
+    if contract is None:
+        return None
+    try:
+        v = contract.check_batch(*batch)
+        return {
+            "nan_rows": int(v.nan_rows),
+            "inf_rows": int(v.inf_rows),
+            "domain_rows": int(v.domain_rows),
+            "invalid_rows": int(v.invalid_rows),
+            "batch_ok": bool(v.batch_ok),
+        }
+    except Exception:  # malformed batch: the quarantine must still land
+        return None

@@ -187,6 +187,11 @@ class StreamingEvaluator:
         self.cursor = 0
         self._last_snapshot_t: Optional[float] = None
         self._last_good_payload: Optional[Dict[str, Any]] = None
+        #: optional veto over CADENCE snapshots only (explicit snapshot() is
+        #: never gated): the serve plane's StateGuard points this at the
+        #: poison probe so a just-corrupted state cannot reach disk in the
+        #: window between the apply and the rollback
+        self.snapshot_gate: Optional[Callable[[], bool]] = None
         # per-drive loop state, installed by _begin_drive (also the open-loop
         # serve_open): the hoisted apply callable and the stall-policy flag
         self._apply_batch: Optional[Callable[[Any], None]] = None
@@ -398,6 +403,8 @@ class StreamingEvaluator:
 
     def _maybe_snapshot(self) -> None:
         if self.store is None:
+            return
+        if self.snapshot_gate is not None and not self.snapshot_gate():
             return
         due_n = self.snapshot_every_n is not None and self.cursor % self.snapshot_every_n == 0
         due_s = (

@@ -22,6 +22,8 @@ __all__ = [
     "checked_binary_accuracy",
     "collection",
     "drift",
+    "guarded_binary_accuracy",
+    "guarded_mean_squared_error",
     "heavy_hitters",
     "quantile",
     "sliced_accuracy",
@@ -51,6 +53,28 @@ def checked_binary_accuracy(threshold: float = 0.5) -> Any:
     from torchmetrics_tpu.classification import BinaryAccuracy
 
     return BinaryAccuracy(threshold=threshold, validate_args=True)
+
+
+def guarded_binary_accuracy(threshold: float = 0.5, policy: str = "mask") -> Any:
+    """Binary accuracy under the StateGuard (``robustness/guard.py``): the
+    domain contract (finite preds in [0, 1], target in {0, 1}) is compiled
+    into the update step. ``policy="mask"`` accumulates only valid rows,
+    ``"reject"`` vetoes whole invalid batches, ``"propagate"`` only counts —
+    the stream publishes the verdicts as ``guard.<stream>.*`` gauges."""
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.robustness.guard import enable_guard
+
+    return enable_guard(BinaryAccuracy(threshold=threshold, validate_args=False), policy=policy)
+
+
+def guarded_mean_squared_error(policy: str = "propagate") -> Any:
+    """MSE under the StateGuard — float error-sum state, so a propagated NaN
+    frame actually poisons state and trips the in-program poison probe: the
+    canonical target for the serve plane's known-good rollback drill."""
+    from torchmetrics_tpu.regression.mse import MeanSquaredError
+    from torchmetrics_tpu.robustness.guard import enable_guard
+
+    return enable_guard(MeanSquaredError(), policy=policy)
 
 
 def binary_average_precision() -> Any:

@@ -134,6 +134,34 @@ def _disable_dist(target: Any) -> None:
         m.distributed_available_fn = lambda: False
 
 
+def _assert_finite_payload(node: Any, path: str = "checkpoint", in_sketch: bool = False) -> None:
+    """Walk a decoded export checkpoint and refuse any float leaf carrying a
+    non-finite value BEFORE it can be folded into the fleet aggregate — the
+    federation face of the StateGuard poison probe: one leaf that propagated
+    a NaN locally must quarantine here, not poison every downstream fold.
+
+    Sketch payloads (``__sketch__``-marked) legitimately carry ±inf sentinels
+    (KLL empty slots, reservoir empty tags), so inside them only NaN is a
+    defect; everywhere else Inf is corruption too."""
+    import numpy as np
+
+    from torchmetrics_tpu.robustness.spec import SKETCH_PAYLOAD_KEY
+
+    if isinstance(node, dict):
+        in_sketch = in_sketch or SKETCH_PAYLOAD_KEY in node
+        for key, value in node.items():
+            _assert_finite_payload(value, f"{path}.{key}", in_sketch)
+        return
+    if isinstance(node, (list, tuple)):
+        for i, value in enumerate(node):
+            _assert_finite_payload(value, f"{path}[{i}]", in_sketch)
+        return
+    if isinstance(node, np.ndarray) and np.issubdtype(node.dtype, np.floating):
+        bad = np.isnan(node).any() if in_sketch else not np.isfinite(node).all()
+        if bad:
+            raise StateRestoreError(f"non-finite value in export state at {path}")
+
+
 def _fold_metric(acc: Any, other: Any) -> None:
     """Fold ``other``'s state into ``acc`` under each state's declared
     ``dist_reduce_fx`` — ``mean`` states weighted by update counts, plain
@@ -487,6 +515,7 @@ class FleetAggregator:
         payload = decode_state(env.get("state"))
         if not isinstance(payload, dict) or "checkpoint" not in payload:
             raise StateRestoreError("export payload carries no checkpoint")
+        _assert_finite_payload(payload["checkpoint"])
         watermark = env.get("watermark")
         if not isinstance(watermark, int) or watermark < 0:
             raise StateRestoreError(f"export watermark {watermark!r} is not a non-negative int")

@@ -192,12 +192,21 @@ class StreamSpec:
             sleeps ``min(max, base·2ⁿ⁻¹)`` plus the same again in jitter.
         poison_threshold: consecutive worker deaths on the SAME batch before
             it is dead-lettered and skipped (≥ 1).
+        guard_ring: depth of the StateGuard known-good rollback ring (≥ 1) —
+            how many verified post-batch states are retained in memory for
+            an instant rollback when the poison probe trips. Only consulted
+            when the target metric is guarded (``robustness/guard.py``).
+        guard_recover_s: the sliding window guard rollbacks are counted over
+            for health: one rollback inside the window reads stalling, two
+            or more read degraded (floors ``/healthz`` at 503 until the
+            window drains).
     """
 
     _FIELDS = (
         "name", "target", "kwargs", "fused", "fused_options", "window", "snapshot_every_n",
         "snapshot_every_s", "queue_max", "use_feed", "watchdog_timeout_s", "on_stall",
         "max_restarts", "restart_window_s", "backoff_base_s", "backoff_max_s", "poison_threshold",
+        "guard_ring", "guard_recover_s",
     )
 
     def __init__(
@@ -219,6 +228,8 @@ class StreamSpec:
         backoff_base_s: float = 0.05,
         backoff_max_s: float = 2.0,
         poison_threshold: int = 3,
+        guard_ring: int = 4,
+        guard_recover_s: float = 60.0,
     ) -> None:
         if not name or any(ch in name for ch in "/\\.") or name != name.strip():
             raise ValueError(
@@ -237,6 +248,10 @@ class StreamSpec:
             )
         if poison_threshold < 1:
             raise ValueError(f"poison_threshold must be >= 1, got {poison_threshold}")
+        if guard_ring < 1:
+            raise ValueError(f"guard_ring must be >= 1, got {guard_ring}")
+        if guard_recover_s <= 0:
+            raise ValueError(f"guard_recover_s must be > 0, got {guard_recover_s}")
         self.name = name
         self.target = target
         self.kwargs = dict(kwargs or {})
@@ -254,6 +269,8 @@ class StreamSpec:
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
         self.poison_threshold = int(poison_threshold)
+        self.guard_ring = int(guard_ring)
+        self.guard_recover_s = float(guard_recover_s)
 
     def to_wire(self) -> Dict[str, Any]:
         return {field: getattr(self, field) for field in self._FIELDS}
@@ -355,6 +372,12 @@ class Stream:
         self._dl_dirty = False  # records newer than the on-disk file (disk fault)
         self._dl_write_lock = threading.Lock()
         self._load_deadletter()
+        # --- StateGuard known-good rollback ring -------------------------
+        self._guard_metric: Optional[Any] = None  # the guarded target, re-resolved per incarnation
+        self._guard_ring: "deque[Tuple[int, Dict[str, Any], int]]" = deque(maxlen=spec.guard_ring)
+        self._guard_rollback_times: "deque[float]" = deque()  # monotonic, pruned to guard_recover_s
+        self.guard_rollbacks = 0
+        self.guard_poisoned_total = 0  # poison detections (the latch itself resets on rollback)
         # --- durability degradation --------------------------------------
         self._durable = True
         self._store_ref: Optional[CheckpointStore] = None  # parked store while degraded
@@ -419,6 +442,7 @@ class Stream:
                 self.evaluator.store = None
         self._snap_seen_t = self.evaluator._last_snapshot_t
         self._last_snap_step = start
+        self._guard_open()
         if self.spec.use_feed:
             # a superseded staging thread may still be draining the OLD
             # queue; give its in-flight op hand-off a beat to land before we
@@ -481,6 +505,8 @@ class Stream:
                         faults.fire("serve.worker.crash")
                     self._step_guarded(item)
                     self._applying = False
+                    if self._guard_metric is not None:
+                        self._guard_after_apply(item)
                     self._note_applied()
                 self._after_apply()
             # the source ended: a drain (or abandon) op asked for the close
@@ -625,6 +651,96 @@ class Stream:
             compute = evaluator.metric.compute_all if evaluator._is_plan else evaluator.metric.compute
             return evaluator._bounded(compute, "compute")
 
+    # ----------------------------------------------- StateGuard rollback ring
+    def _guard_open(self) -> None:
+        """Per-incarnation guard wiring: resolve whether this evaluator's
+        target is a guarded plain Metric, point the runner's cadence-snapshot
+        gate at the poison probe (a just-corrupted state must not reach disk
+        in the window between the apply and the rollback), and seed the
+        rollback ring with the just-restored — hence verified — state.
+
+        Ring entries are ``(cursor, state dict, update_count)``;
+        ``_copy_state_dict`` holds array REFERENCES, so a deep ring costs
+        pointers per batch, not state copies."""
+        self._guard_ring.clear()
+        self._guard_metric = None
+        evaluator = self.evaluator
+        if self.spec.fused or self.spec.window is not None or evaluator._is_plan:
+            return  # ring rollback needs a plain Metric target owning its own states
+        metric = getattr(evaluator, "metric", None)
+        if metric is None or getattr(metric, "_guard_policy", None) is None:
+            return
+        self._guard_metric = metric
+        evaluator.snapshot_gate = self._guard_snapshot_gate
+        self._guard_capture()
+
+    def _guard_snapshot_gate(self) -> bool:
+        metric = self._guard_metric
+        return metric is None or int(metric.guard_poisoned) == 0
+
+    def _guard_capture(self) -> None:
+        metric = self._guard_metric
+        self._guard_ring.append(
+            (int(self.evaluator.cursor), metric._copy_state_dict(), metric._update_count)
+        )
+
+    def _guard_after_apply(self, item: Any) -> None:
+        """Poison-probe checkpoint after every applied batch: clean → retain
+        the post-batch state in the ring; tripped → restore the newest
+        known-good entry (the state BEFORE the offending batch), quarantine
+        the batch to the dead-letter ledger with its guard verdict, and skip
+        past it — no disk restore, no client replay (later batches are still
+        queued; the skip moves the watermark exactly one seq)."""
+        metric = self._guard_metric
+        if int(metric.guard_poisoned) == 0:
+            self._guard_capture()
+            return
+        evaluator = self.evaluator
+        culprit = int(evaluator.cursor) - 1
+        if not self._guard_ring:
+            raise _Unrecoverable(
+                f"poison probe tripped at seq {culprit} with an empty rollback ring"
+            )
+        cursor0, state, count = self._guard_ring[-1]
+        metric._install_state_tree(state)
+        metric._update_count = count
+        metric._computed = None
+        evaluator.cursor = cursor0
+        with self._lock:
+            self.guard_rollbacks += 1
+            self.guard_poisoned_total += 1
+            self._guard_rollback_times.append(time.monotonic())
+        _obs_counters.inc("serve.guard_rollbacks")
+        from torchmetrics_tpu.robustness.guard import batch_verdict_host
+
+        verdict = batch_verdict_host(metric, item if isinstance(item, tuple) else (item,))
+        err = RuntimeError(f"StateGuard poison probe: state went non-finite applying seq {culprit}")
+        self._quarantine(culprit, err, guard=verdict)
+        # advance the watermark past the quarantined batch; the cadence
+        # snapshot inside the skip persists the ROLLED-BACK truth (the latch
+        # is down again, so the gate passes)
+        cursor_before = evaluator.cursor
+        try:
+            evaluator.serve_skip()
+        except OSError as skip_err:
+            if _is_disk_error(skip_err) and evaluator.cursor > cursor_before:
+                self._handle_disk_fault(skip_err)
+            else:
+                raise
+        self._guard_capture()
+
+    def _guard_health_code(self) -> int:
+        """0 ok / 1 stalling / 2 degraded from rollbacks inside the sliding
+        ``guard_recover_s`` window — the ``guard.<name>.health_state`` gauge
+        the live plane floors ``/healthz`` with (one recent rollback is an
+        incident; repeats mean the upstream is actively feeding poison)."""
+        with self._lock:
+            horizon = time.monotonic() - self.spec.guard_recover_s
+            while self._guard_rollback_times and self._guard_rollback_times[0] < horizon:
+                self._guard_rollback_times.popleft()
+            recent = len(self._guard_rollback_times)
+        return 2 if recent >= 2 else (1 if recent == 1 else 0)
+
     def _after_apply(self) -> None:
         """Post-item housekeeping on the worker: retained-buffer pruning when
         a snapshot lands, and the degraded-mode durability recovery probe."""
@@ -731,10 +847,10 @@ class Stream:
                         delay *= 2
             self._dl_dirty = True
 
-    def _quarantine(self, seq: int, err: BaseException) -> None:
+    def _quarantine(self, seq: int, err: BaseException, guard: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
             entry = self._retained.pop(seq, None)
-            self._deadletter[seq] = {
+            record = {
                 "seq": seq,
                 "stream": self.spec.name,
                 "batch": entry[0] if entry is not None else None,
@@ -742,6 +858,11 @@ class Stream:
                 "attempts": self._crash_count,
                 "quarantined_at": time.time(),
             }
+            if guard is not None:
+                # the StateGuard verdict for the poisoned batch: nan/inf/
+                # domain row counts — metricdoctor pretty-prints these
+                record["guard"] = guard
+            self._deadletter[seq] = record
             self._quarantined.add(seq)
         _obs_counters.inc("serve.deadletter")
         self._persist_deadletter()
@@ -1301,7 +1422,21 @@ class Stream:
                 info["last_failure"] = self.last_failure
             if self.result is not None:
                 info["results"] = self.result
-            return info
+            guard_metric = self._guard_metric
+        if guard_metric is not None:
+            guard_info: Dict[str, Any] = {"policy": getattr(guard_metric, "_guard_policy", None)}
+            try:
+                from torchmetrics_tpu.robustness.guard import guard_counters
+
+                guard_info.update(guard_counters(guard_metric))
+            except Exception:
+                pass  # a mid-trace read must never take status down
+            # cumulative stream-side counts LAST: guard_counters' "poisoned"
+            # is the latch (always 0 again after a successful rollback)
+            guard_info["rollbacks"] = self.guard_rollbacks
+            guard_info["poisoned"] = self.guard_poisoned_total
+            info["guard"] = guard_info
+        return info
 
     def health_code(self) -> int:
         """0 ok … 3 stalled (the ``serve.<name>.health_state`` gauge): a
@@ -1346,5 +1481,22 @@ class Stream:
                 for key, val in serve_fn().items():
                     out[f"drift.{self.spec.name}.{key}"] = float(val)
             except Exception:  # a gauge read must never take the probe down
+                _obs_counters.inc("serve.gauge_read_failures")
+        guard_metric = self._guard_metric
+        if guard_metric is not None:
+            gp = f"guard.{self.spec.name}."
+            try:
+                from torchmetrics_tpu.robustness.guard import guard_counters
+
+                counters = guard_counters(guard_metric)
+                out[gp + "masked"] = float(counters["masked_rows"])
+                out[gp + "rejected"] = float(counters["rejected_batches"])
+                out[gp + "nan_rows"] = float(counters["nan_rows"])
+                out[gp + "inf_rows"] = float(counters["inf_rows"])
+                out[gp + "domain_rows"] = float(counters["domain_rows"])
+                out[gp + "rollbacks"] = float(self.guard_rollbacks)
+                out[gp + "poisoned"] = float(self.guard_poisoned_total)
+                out[gp + "health_state"] = float(self._guard_health_code())
+            except Exception:  # ditto: counters read device scalars
                 _obs_counters.inc("serve.gauge_read_failures")
         return out
